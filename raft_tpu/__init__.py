@@ -1,0 +1,26 @@
+"""raft_tpu — a TPU-native (JAX/XLA/Pallas) optical-flow framework.
+
+A ground-up re-design of the capabilities of zhaoyuzhi/PyTorch-RAFT
+(RAFT, Teed & Deng, ECCV 2020) for TPU hardware:
+
+- NHWC layouts and bf16 compute feeding the MXU,
+- the iterative refinement loop as `lax.scan` (single trace, remat-friendly),
+- correlation volumes as einsum + gather (oracle) and a Pallas on-demand
+  lookup kernel (the memory-efficient path, replacing alt_cuda_corr/),
+- parallelism as `jax.sharding.Mesh` + shard_map with XLA collectives
+  (replacing torch.nn.DataParallel),
+- a host-side data pipeline with threaded prefetch to device.
+
+Reference layer map: /root/repo/SURVEY.md.
+"""
+
+from raft_tpu.config import RAFTConfig, TrainConfig, DataConfig, ParallelConfig
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "RAFTConfig",
+    "TrainConfig",
+    "DataConfig",
+    "ParallelConfig",
+]

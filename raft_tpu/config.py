@@ -1,0 +1,163 @@
+"""Typed configuration for raft_tpu.
+
+Replaces the reference's argparse-flag soup (train.py:218-239, evaluate.py:170-175,
+raft.py:29-45) and the stage hyperparameters embedded in shell scripts
+(train_standard.sh:3-6, train_mixed.sh:3-6) with dataclass sections plus
+stage presets as data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RAFTConfig:
+    """Model hyperparameters.
+
+    Mirrors the derived config the reference injects into ``args`` at model
+    build time (raft.py:29-45): small/large variants fix hidden/context dims
+    and the correlation pyramid shape.
+    """
+
+    small: bool = False
+    dropout: float = 0.0
+    alternate_corr: bool = False  # on-demand (Pallas) corr lookup instead of all-pairs
+    # Mixed precision: compute dtype for encoders + update block; the corr
+    # volume and the loss stay float32 (matching the autocast boundaries at
+    # raft.py:99-127 and corr.py:50).
+    compute_dtype: str = "float32"  # "float32" | "bfloat16"
+
+    @property
+    def hidden_dim(self) -> int:
+        return 96 if self.small else 128
+
+    @property
+    def context_dim(self) -> int:
+        return 64 if self.small else 128
+
+    @property
+    def corr_levels(self) -> int:
+        return 4
+
+    @property
+    def corr_radius(self) -> int:
+        return 3 if self.small else 4
+
+    @property
+    def fnet_dim(self) -> int:
+        return 128 if self.small else 256
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset + augmentation config (datasets.py:199-234 equivalents)."""
+
+    stage: str = "chairs"  # chairs | things | sintel | kitti
+    root: str = "datasets"
+    image_size: Tuple[int, int] = (368, 496)
+    batch_size: int = 10
+    num_workers: int = 4
+    prefetch: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Optimization schedule (train.py:79-86, 136-214 equivalents)."""
+
+    name: str = "raft"
+    lr: float = 4e-4
+    num_steps: int = 100000
+    wdecay: float = 1e-4
+    epsilon: float = 1e-8
+    clip: float = 1.0
+    gamma: float = 0.8          # sequence-loss decay (train.py:47)
+    max_flow: float = 400.0     # loss valid-mask threshold (train.py:42)
+    iters: int = 12
+    add_noise: bool = False
+    freeze_bn: bool = False     # frozen for every stage after chairs (train.py:147-148)
+    val_freq: int = 5000
+    log_freq: int = 100
+    seed: int = 1234
+    restore_ckpt: Optional[str] = None
+    validation: Sequence[str] = ()
+    checkpoint_dir: str = "checkpoints"
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Device-mesh layout.
+
+    The reference's only strategy is single-process ``torch.nn.DataParallel``
+    (train.py:138). Here parallelism is a named-axis mesh: ``data`` for batch
+    sharding (gradient psum over ICI) and ``spatial`` for sharding the H1*W1
+    query axis of the correlation volume at high resolution.
+    """
+
+    data_axis: int = 1      # number of devices along the data axis
+    spatial_axis: int = 1   # devices along the corr-query/spatial axis
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: RAFTConfig = dataclasses.field(default_factory=RAFTConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+
+
+def _stage(model: RAFTConfig, data: DataConfig, train: TrainConfig) -> Config:
+    return Config(model=model, data=data, train=train)
+
+
+# Stage presets replacing train_standard.sh:3-6 (2-GPU fp32 recipe) and
+# train_mixed.sh:3-6 (1-GPU bf16 recipe). Keys: f"{stage}" and f"{stage}_mixed".
+STAGE_PRESETS = {
+    "chairs": _stage(
+        RAFTConfig(),
+        DataConfig(stage="chairs", image_size=(368, 496), batch_size=10),
+        TrainConfig(name="raft-chairs", lr=4e-4, num_steps=100000, wdecay=1e-4),
+    ),
+    "things": _stage(
+        RAFTConfig(),
+        DataConfig(stage="things", image_size=(400, 720), batch_size=6),
+        TrainConfig(name="raft-things", lr=1.25e-4, num_steps=100000, wdecay=1e-4,
+                    freeze_bn=True),
+    ),
+    "sintel": _stage(
+        RAFTConfig(),
+        DataConfig(stage="sintel", image_size=(368, 768), batch_size=6),
+        TrainConfig(name="raft-sintel", lr=1.25e-4, num_steps=100000, wdecay=1e-5,
+                    gamma=0.85, freeze_bn=True),
+    ),
+    "kitti": _stage(
+        RAFTConfig(),
+        DataConfig(stage="kitti", image_size=(288, 960), batch_size=6),
+        TrainConfig(name="raft-kitti", lr=1e-4, num_steps=50000, wdecay=1e-5,
+                    gamma=0.85, freeze_bn=True),
+    ),
+    "chairs_mixed": _stage(
+        RAFTConfig(compute_dtype="bfloat16"),
+        DataConfig(stage="chairs", image_size=(368, 496), batch_size=8),
+        TrainConfig(name="raft-chairs", lr=2.5e-4, num_steps=120000, wdecay=1e-4),
+    ),
+    "things_mixed": _stage(
+        RAFTConfig(compute_dtype="bfloat16"),
+        DataConfig(stage="things", image_size=(400, 720), batch_size=5),
+        TrainConfig(name="raft-things", lr=1e-4, num_steps=120000, wdecay=1e-4,
+                    freeze_bn=True),
+    ),
+    "sintel_mixed": _stage(
+        RAFTConfig(compute_dtype="bfloat16"),
+        DataConfig(stage="sintel", image_size=(368, 768), batch_size=5),
+        TrainConfig(name="raft-sintel", lr=1e-4, num_steps=120000, wdecay=1e-5,
+                    gamma=0.85, freeze_bn=True),
+    ),
+    "kitti_mixed": _stage(
+        RAFTConfig(compute_dtype="bfloat16"),
+        DataConfig(stage="kitti", image_size=(288, 960), batch_size=5),
+        TrainConfig(name="raft-kitti", lr=1e-4, num_steps=50000, wdecay=1e-5,
+                    gamma=0.85, freeze_bn=True),
+    ),
+}

@@ -1,0 +1,32 @@
+from raft_tpu.ops.grid import (
+    bilinear_sample,
+    coords_grid,
+    upflow8,
+    upsample2x,
+    convex_upsample,
+    avg_pool2x,
+)
+from raft_tpu.ops.corr import (
+    all_pairs_correlation,
+    build_corr_pyramid,
+    corr_lookup,
+    alternate_corr_lookup,
+)
+from raft_tpu.ops.pad import InputPadder
+from raft_tpu.ops.warp import backward_warp, forward_interpolate
+
+__all__ = [
+    "bilinear_sample",
+    "coords_grid",
+    "upflow8",
+    "upsample2x",
+    "convex_upsample",
+    "avg_pool2x",
+    "all_pairs_correlation",
+    "build_corr_pyramid",
+    "corr_lookup",
+    "alternate_corr_lookup",
+    "InputPadder",
+    "backward_warp",
+    "forward_interpolate",
+]

@@ -1,0 +1,137 @@
+"""Correlation volume ops — the heart of RAFT.
+
+Two functionally identical paths, mirroring the reference's pair
+(core/corr.py:12-60 ``CorrBlock`` and core/corr.py:63-91 + alt_cuda_corr/
+``AlternateCorrBlock``):
+
+- **All-pairs**: materialize the full 4D volume with one big matmul (MXU
+  food), average-pool a 4-level pyramid over the target axes, and gather
+  bilinear windows per refinement iteration.  O((H*W)^2) memory.
+- **On-demand**: keep only the fmap2 pyramid and recompute each (2r+1)^2
+  window dot-product at lookup time.  O(H*W) memory.  Because pooling and
+  bilinear sampling are linear in fmap2, this is exactly equal to the
+  all-pairs path (a property the test suite asserts).  The Pallas kernel in
+  ``corr_pallas.py`` is the fused fast version of this path.
+
+Window-channel ordering quirk (kept for checkpoint compatibility): the
+reference builds its lookup offsets as meshgrid(dy, dx) stacked onto (x, y)
+centroids (corr.py:37-44), so flat window index k = a*(2r+1)+b corresponds
+to offset (dx = a-r applied to x, dy = b-r applied to y) — x-major.  The
+1x1 conv that consumes these channels (update.py:66,82) learns whatever
+order it is fed, but imports of reference weights require matching it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.grid import avg_pool2x, bilinear_sample
+
+
+def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
+    """Full correlation volume (core/corr.py:52-60).
+
+    Args:
+      fmap1, fmap2: (B, H, W, C) feature maps (any float dtype; the matmul
+        accumulates in float32 for parity with corr.py:50's .float()).
+
+    Returns:
+      (B, H*W, H, W) float32 volume, query axis flattened row-major,
+      normalized by sqrt(C).
+    """
+    B, H, W, C = fmap1.shape
+    f1 = fmap1.reshape(B, H * W, C).astype(jnp.float32)
+    f2 = fmap2.reshape(B, H * W, C).astype(jnp.float32)
+    corr = jnp.einsum("bqc,btc->bqt", f1, f2,
+                      preferred_element_type=jnp.float32)
+    corr = corr / jnp.sqrt(jnp.float32(C))
+    return corr.reshape(B, H * W, H, W)
+
+
+def build_corr_pyramid(corr: jax.Array, num_levels: int = 4) -> List[jax.Array]:
+    """Average-pool pyramid over the target (last two) axes (corr.py:24-27)."""
+    pyramid = [corr]
+    x = corr
+    for _ in range(num_levels - 1):
+        B, Q = x.shape[0], x.shape[1]
+        img = x.reshape(B * Q, x.shape[2], x.shape[3], 1)
+        img = avg_pool2x(img)
+        x = img.reshape(B, Q, img.shape[1], img.shape[2])
+        pyramid.append(x)
+    return pyramid
+
+
+def _window_offsets(radius: int, dtype=jnp.float32) -> jax.Array:
+    """(2r+1)^2 lookup offsets, flattened in the reference's x-major order.
+
+    Returns (K, 2) with [..., 0] = offset applied to x, [..., 1] = to y.
+    """
+    r = radius
+    d = jnp.arange(-r, r + 1, dtype=dtype)
+    dx, dy = jnp.meshgrid(d, d, indexing="ij")  # dx varies over rows: x-major
+    return jnp.stack([dx, dy], axis=-1).reshape(-1, 2)
+
+
+def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
+                radius: int) -> jax.Array:
+    """Gather bilinear correlation windows at each pyramid level
+    (core/corr.py:29-50).
+
+    Args:
+      pyramid: list of (B, Q, H_l, W_l) volumes, Q = H1*W1.
+      coords: (B, H1, W1, 2) query coordinates at level 0, (x, y).
+      radius: window radius r.
+
+    Returns:
+      (B, H1, W1, L*(2r+1)^2) float32, levels concatenated level-major.
+    """
+    B, H1, W1, _ = coords.shape
+    Q = H1 * W1
+    offsets = _window_offsets(radius, coords.dtype)  # (K, 2)
+    out = []
+    for i, corr in enumerate(pyramid):
+        centroid = coords.reshape(B * Q, 1, 2) / (2.0 ** i)
+        coords_lvl = centroid + offsets[None]  # (B*Q, K, 2)
+        img = corr.reshape(B * Q, corr.shape[2], corr.shape[3], 1)
+        sampled = bilinear_sample(img, coords_lvl)  # (B*Q, K, 1)
+        out.append(sampled.reshape(B, H1, W1, -1))
+    return jnp.concatenate(out, axis=-1).astype(jnp.float32)
+
+
+def build_fmap_pyramid(fmap: jax.Array, num_levels: int = 4) -> List[jax.Array]:
+    """fmap2 average-pool pyramid for the on-demand path (corr.py:68-72)."""
+    pyr = [fmap]
+    for _ in range(num_levels - 1):
+        pyr.append(avg_pool2x(pyr[-1]))
+    return pyr
+
+
+def alternate_corr_lookup(fmap1: jax.Array, fmap2_pyramid: Sequence[jax.Array],
+                          coords: jax.Array, radius: int) -> jax.Array:
+    """On-demand correlation lookup, lax reference implementation.
+
+    Functionally identical to ``corr_lookup(build_corr_pyramid(
+    all_pairs_correlation(f1, f2)), coords, r)`` without materializing the
+    O((H*W)^2) volume: for each query pixel, bilinearly sample the (2r+1)^2
+    window of the pooled fmap2 and dot with the fmap1 vector.  This is the
+    oracle for the fused Pallas kernel (corr_pallas.py), and replaces
+    alt_cuda_corr/correlation_kernel.cu:19-119.
+
+    Returns the same shape/ordering as ``corr_lookup``.
+    """
+    B, H1, W1, C = fmap1.shape
+    f1 = fmap1.astype(jnp.float32)
+    offsets = _window_offsets(radius, coords.dtype)  # (K, 2)
+    scale = 1.0 / jnp.sqrt(jnp.float32(C))
+    out = []
+    for i, f2 in enumerate(fmap2_pyramid):
+        centroid = coords[..., None, :] / (2.0 ** i)        # (B, H1, W1, 1, 2)
+        coords_lvl = centroid + offsets[None, None, None]   # (B, H1, W1, K, 2)
+        win = bilinear_sample(f2.astype(jnp.float32), coords_lvl)  # (B,H1,W1,K,C)
+        corr = jnp.einsum("bhwkc,bhwc->bhwk", win, f1,
+                          preferred_element_type=jnp.float32) * scale
+        out.append(corr)
+    return jnp.concatenate(out, axis=-1).astype(jnp.float32)

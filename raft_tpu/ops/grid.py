@@ -1,0 +1,162 @@
+"""Pure sampling / resampling ops (NHWC, channels-last for TPU lanes).
+
+Covers the semantics of the reference's grid utilities
+(core/utils/utils.py:57-82) and the convex-combination upsampler
+(core/raft.py:72-83), re-designed as gather + lerp so the sampling
+convention (align_corners=True, zero padding out-of-bounds) is explicit
+rather than inherited from F.grid_sample.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def coords_grid(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jax.Array:
+    """Pixel-coordinate grid, shape (batch, ht, wd, 2) with [..., 0]=x, [..., 1]=y.
+
+    Reference: core/utils/utils.py:74-77 (channel-first there; channels-last here).
+    """
+    y, x = jnp.meshgrid(jnp.arange(ht, dtype=dtype), jnp.arange(wd, dtype=dtype),
+                        indexing="ij")
+    grid = jnp.stack([x, y], axis=-1)
+    return jnp.broadcast_to(grid[None], (batch, ht, wd, 2))
+
+
+def _sample_one(img: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
+    """Bilinear taps of one (H, W, C) image at float pixel coords, zero OOB."""
+    H, W = img.shape[0], img.shape[1]
+    x0f = jnp.floor(x)
+    y0f = jnp.floor(y)
+    wx = x - x0f
+    wy = y - y0f
+    x0 = x0f.astype(jnp.int32)
+    y0 = y0f.astype(jnp.int32)
+
+    def tap(ix, iy):
+        valid = (ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1)
+        ixc = jnp.clip(ix, 0, W - 1)
+        iyc = jnp.clip(iy, 0, H - 1)
+        vals = img[iyc, ixc]  # gather, shape coords.shape + (C,)
+        return jnp.where(valid[..., None], vals, 0.0)
+
+    v00 = tap(x0, y0)
+    v01 = tap(x0 + 1, y0)
+    v10 = tap(x0, y0 + 1)
+    v11 = tap(x0 + 1, y0 + 1)
+
+    wx = wx[..., None]
+    wy = wy[..., None]
+    top = v00 * (1.0 - wx) + v01 * wx
+    bot = v10 * (1.0 - wx) + v11 * wx
+    return top * (1.0 - wy) + bot * wy
+
+
+def bilinear_sample(img: jax.Array, coords: jax.Array,
+                    return_mask: bool = False):
+    """Bilinear sampling at float pixel coordinates.
+
+    Matches torch ``F.grid_sample(..., align_corners=True,
+    padding_mode='zeros')`` as wrapped by the reference's ``bilinear_sampler``
+    (core/utils/utils.py:57-71): integer coordinate k lands exactly on pixel
+    k, and out-of-bounds taps contribute zero to the interpolation.
+
+    Args:
+      img: (B, H, W, C).
+      coords: (B, ..., 2) pixel coordinates, [..., 0]=x, [..., 1]=y.
+      return_mask: also return the reference's in-bounds mask
+        (strictly inside (0, W-1) x (0, H-1); utils.py:67-69).
+
+    Returns:
+      (B, ..., C) samples, and optionally the (B, ..., 1) float mask.
+    """
+    x = coords[..., 0]
+    y = coords[..., 1]
+    out = jax.vmap(_sample_one)(img, x, y)
+    if return_mask:
+        H, W = img.shape[1], img.shape[2]
+        mask = (x > 0) & (x < W - 1) & (y > 0) & (y < H - 1)
+        return out, mask[..., None].astype(img.dtype)
+    return out
+
+
+def _resize_align_corners(img: jax.Array, out_h: int, out_w: int) -> jax.Array:
+    """Bilinear resize with align_corners=True semantics, NHWC.
+
+    (jax.image.resize implements half-pixel centers only, so this maps output
+    pixel i to input coordinate i*(H_in-1)/(H_out-1) and reuses the sampler.)
+    """
+    B, H, W, _ = img.shape
+    sy = (H - 1) / (out_h - 1) if out_h > 1 else 0.0
+    sx = (W - 1) / (out_w - 1) if out_w > 1 else 0.0
+    # Coordinates always in float32: bf16 can't represent integer pixel
+    # indices above 256, which would shift sample points by up to 1 px.
+    y = jnp.arange(out_h, dtype=jnp.float32) * sy
+    x = jnp.arange(out_w, dtype=jnp.float32) * sx
+    yy, xx = jnp.meshgrid(y, x, indexing="ij")
+    coords = jnp.broadcast_to(jnp.stack([xx, yy], axis=-1)[None],
+                              (B, out_h, out_w, 2))
+    return bilinear_sample(img, coords)
+
+
+def upflow8(flow: jax.Array) -> jax.Array:
+    """8x bilinear upsample of a flow field, values scaled by 8.
+
+    Reference: core/utils/utils.py:80-82 (align_corners=True interpolate).
+    flow: (B, H, W, 2) -> (B, 8H, 8W, 2).
+    """
+    B, H, W, _ = flow.shape
+    return 8.0 * _resize_align_corners(flow, 8 * H, 8 * W)
+
+
+def upsample2x(x: jax.Array) -> jax.Array:
+    """2x align_corners=True bilinear upsample (no value scaling)."""
+    B, H, W, _ = x.shape
+    return _resize_align_corners(x, 2 * H, 2 * W)
+
+
+def avg_pool2x(x: jax.Array) -> jax.Array:
+    """2x2 stride-2 average pool, NHWC (floor division of odd dims, matching
+    torch F.avg_pool2d(x, 2, stride=2) used for the corr pyramid, corr.py:25)."""
+    B, H, W, C = x.shape
+    Hc, Wc = H // 2, W // 2
+    x = x[:, : 2 * Hc, : 2 * Wc, :]
+    x = x.reshape(B, Hc, 2, Wc, 2, C)
+    return x.mean(axis=(2, 4))
+
+
+def convex_upsample(flow: jax.Array, mask: jax.Array) -> jax.Array:
+    """Convex-combination 8x upsampling of flow (core/raft.py:72-83).
+
+    Each fine pixel is a softmax-weighted combination of the 3x3 coarse
+    neighborhood of (8 * flow). Implemented as shift-stack + einsum; no
+    unfold needed.
+
+    Args:
+      flow: (B, H, W, 2) coarse flow.
+      mask: (B, H, W, 576) logits, laid out as (9, 8, 8) =
+        (neighbor k row-major over (dy, dx), subpixel-y, subpixel-x) — the
+        same channel order as the reference's mask.view(N, 1, 9, 8, 8, H, W),
+        so imported checkpoints line up.
+
+    Returns:
+      (B, 8H, 8W, 2) upsampled flow.
+    """
+    B, H, W, _ = flow.shape
+    mask = mask.reshape(B, H, W, 9, 8, 8)
+    mask = jax.nn.softmax(mask, axis=3)
+
+    up = 8.0 * flow
+    up_pad = jnp.pad(up, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    # 3x3 neighborhood, row-major over (dy, dx) to match F.unfold ordering.
+    neighbors = jnp.stack(
+        [up_pad[:, dy : dy + H, dx : dx + W, :] for dy in range(3) for dx in range(3)],
+        axis=3,
+    )  # (B, H, W, 9, 2)
+
+    # out[b,h,w,sy,sx,c] = sum_k mask[b,h,w,k,sy,sx] * neighbors[b,h,w,k,c]
+    out = jnp.einsum("bhwkyx,bhwkc->bhwyxc", mask, neighbors)
+    # (B, H, 8, W, 8, 2) -> (B, 8H, 8W, 2)
+    out = out.transpose(0, 1, 3, 2, 4, 5)
+    return out.reshape(B, 8 * H, 8 * W, 2)

@@ -1,0 +1,36 @@
+"""Input padding to /8 resolution (core/utils/utils.py:7-24)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class InputPadder:
+    """Pads NHWC images so H and W are divisible by 8.
+
+    'sintel' mode centers the padding; 'kitti' pads only the top
+    (utils.py:12-16).  Replicate (edge) padding, matching F.pad(mode=
+    'replicate').
+    """
+
+    def __init__(self, dims, mode: str = "sintel"):
+        self.ht, self.wd = dims[-3], dims[-2]  # NHWC
+        pad_ht = (((self.ht // 8) + 1) * 8 - self.ht) % 8
+        pad_wd = (((self.wd // 8) + 1) * 8 - self.wd) % 8
+        if mode == "sintel":
+            # (left, right, top, bottom)
+            self._pad = (pad_wd // 2, pad_wd - pad_wd // 2,
+                         pad_ht // 2, pad_ht - pad_ht // 2)
+        else:
+            self._pad = (pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht)
+
+    def pad(self, *inputs):
+        l, r, t, b = self._pad
+        out = [jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge")
+               for x in inputs]
+        return out if len(out) > 1 else out[0]
+
+    def unpad(self, x):
+        l, r, t, b = self._pad
+        ht, wd = x.shape[-3], x.shape[-2]
+        return x[..., t : ht - b, l : wd - r, :]

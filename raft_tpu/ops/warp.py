@@ -1,0 +1,88 @@
+"""Flow-based warping and warm-start interpolation.
+
+Covers the demo warp semantics (demo_warp.py:27-73) and the
+forward-splat warm start used for video sequences
+(core/utils/utils.py:26-54, consumed at evaluate.py:37-41).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops.grid import bilinear_sample, coords_grid
+
+
+def backward_warp(img: jax.Array, flow: jax.Array,
+                  align_corners: bool = False,
+                  mask_threshold: float = 0.999):
+    """Warp ``img`` backwards by ``flow``: out(p) = img(p + flow(p)).
+
+    Two sampling conventions exist in the reference and both are supported:
+
+    - ``align_corners=False`` reproduces demo_warp.py:27-56 exactly — the
+      demo normalizes absolute coords by (W-1)/(H-1) but samples with
+      grid_sample's default half-pixel convention, so the effective sample
+      point is ((x+fx) * W/(W-1)) - 0.5 (a deliberate parity quirk).
+    - ``align_corners=True`` is the clean convention used everywhere else in
+      the model (utils.py:57-71).
+
+    Returns (warped, mask): mask is the 0.999-thresholded validity mask from
+    warping an all-ones image (demo_warp.py:50-54); warped is pre-multiplied
+    by it, matching the demo.
+    """
+    B, H, W, C = img.shape
+    # float32 coordinates regardless of flow dtype (bf16 can't represent
+    # pixel indices > 256 exactly).
+    grid = coords_grid(B, H, W, dtype=jnp.float32)
+    target = grid + flow.astype(jnp.float32)
+    if not align_corners:
+        # normalized = 2*target/(dim-1) - 1; half-pixel unnormalize:
+        # pix = ((normalized + 1) * dim - 1) / 2
+        x = (2.0 * target[..., 0] / max(W - 1, 1) * W - 1.0) / 2.0
+        y = (2.0 * target[..., 1] / max(H - 1, 1) * H - 1.0) / 2.0
+        target = jnp.stack([x, y], axis=-1)
+    warped = bilinear_sample(img, target)
+    ones = jnp.ones((B, H, W, 1), dtype=img.dtype)
+    mask = bilinear_sample(ones, target)
+    mask = jnp.where(mask < mask_threshold, 0.0, 1.0)
+    return warped * mask, mask
+
+
+def forward_interpolate(flow: np.ndarray) -> np.ndarray:
+    """Forward-splat a flow field and fill by nearest neighbor (host-side).
+
+    Warm-start initializer for video: pushes each flow vector to its target
+    location, then fills the full grid by nearest-neighbor interpolation
+    (utils.py:26-54; scipy griddata there).  Host numpy/scipy on purpose —
+    this runs once per frame on the eval path, between device steps.
+
+    Args:
+      flow: (H, W, 2) numpy array.
+
+    Returns:
+      (H, W, 2) numpy array.
+    """
+    from scipy import interpolate as scipy_interpolate
+
+    flow = np.asarray(flow)
+    dx, dy = flow[..., 0], flow[..., 1]
+    ht, wd = dx.shape
+    x0, y0 = np.meshgrid(np.arange(wd), np.arange(ht))
+
+    x1 = (x0 + dx).reshape(-1)
+    y1 = (y0 + dy).reshape(-1)
+    dxf = dx.reshape(-1)
+    dyf = dy.reshape(-1)
+
+    valid = (x1 > 0) & (x1 < wd) & (y1 > 0) & (y1 < ht)
+    x1, y1, dxf, dyf = x1[valid], y1[valid], dxf[valid], dyf[valid]
+    if x1.size == 0:
+        return np.zeros_like(flow)
+
+    flow_x = scipy_interpolate.griddata((x1, y1), dxf, (x0, y0),
+                                        method="nearest", fill_value=0)
+    flow_y = scipy_interpolate.griddata((x1, y1), dyf, (x0, y0),
+                                        method="nearest", fill_value=0)
+    return np.stack([flow_x, flow_y], axis=-1).astype(np.float32)

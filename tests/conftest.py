@@ -1,0 +1,24 @@
+"""Test configuration: run on CPU with 8 virtual XLA devices.
+
+Set BEFORE jax is imported anywhere, so multi-device sharding tests
+(the capability the reference never had — SURVEY.md §4) run without TPU
+hardware.
+"""
+
+import os
+
+# The image pins JAX_PLATFORMS=axon (the tunneled TPU); tests must run on
+# CPU, so override rather than setdefault, and force 8 virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The env var alone does not beat the axon plugin registration; the config
+# update does.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,112 @@
+"""Correlation-volume tests: einsum volume vs torch oracle, lookup vs the
+reference CorrBlock (re-expressed in torch), and all-pairs vs on-demand
+equivalence."""
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from raft_tpu.ops import (
+    all_pairs_correlation,
+    alternate_corr_lookup,
+    build_corr_pyramid,
+    corr_lookup,
+)
+from raft_tpu.ops.corr import build_fmap_pyramid
+
+RNG = np.random.default_rng(42)
+
+
+def ref_corrblock(fmap1_nchw, fmap2_nchw, coords_xy_last, num_levels, radius):
+    """The reference CorrBlock (core/corr.py:12-60) in torch, as oracle."""
+    batch, dim, ht, wd = fmap1_nchw.shape
+    f1 = fmap1_nchw.view(batch, dim, ht * wd)
+    f2 = fmap2_nchw.view(batch, dim, ht * wd)
+    corr = torch.matmul(f1.transpose(1, 2), f2).view(batch, ht, wd, 1, ht, wd)
+    corr = corr / torch.sqrt(torch.tensor(dim).float())
+    corr = corr.reshape(batch * ht * wd, 1, ht, wd)
+    pyramid = [corr]
+    for _ in range(num_levels - 1):
+        corr = F.avg_pool2d(corr, 2, stride=2)
+        pyramid.append(corr)
+
+    r = radius
+    coords = coords_xy_last
+    b, h1, w1, _ = coords.shape
+    out_pyramid = []
+    for i in range(num_levels):
+        c = pyramid[i]
+        dx = torch.linspace(-r, r, 2 * r + 1)
+        dy = torch.linspace(-r, r, 2 * r + 1)
+        delta = torch.stack(torch.meshgrid(dy, dx, indexing="ij"), axis=-1)
+        centroid = coords.reshape(b * h1 * w1, 1, 1, 2) / 2 ** i
+        coords_lvl = centroid + delta.view(1, 2 * r + 1, 2 * r + 1, 2)
+        H, W = c.shape[-2:]
+        xg, yg = coords_lvl.split([1, 1], dim=-1)
+        xg = 2 * xg / (W - 1) - 1
+        yg = 2 * yg / (H - 1) - 1
+        sampled = F.grid_sample(c, torch.cat([xg, yg], dim=-1),
+                                align_corners=True)
+        out_pyramid.append(sampled.view(b, h1, w1, -1))
+    return torch.cat(out_pyramid, dim=-1)
+
+
+def test_all_pairs_volume_matches_matmul_oracle():
+    B, H, W, C = 2, 4, 5, 8
+    f1 = RNG.standard_normal((B, H, W, C)).astype(np.float32)
+    f2 = RNG.standard_normal((B, H, W, C)).astype(np.float32)
+    vol = np.asarray(all_pairs_correlation(jnp.asarray(f1), jnp.asarray(f2)))
+    assert vol.shape == (B, H * W, H, W)
+    t1 = torch.from_numpy(f1).permute(0, 3, 1, 2)
+    t2 = torch.from_numpy(f2).permute(0, 3, 1, 2)
+    ref = torch.matmul(
+        t1.reshape(B, C, H * W).transpose(1, 2), t2.reshape(B, C, H * W)
+    ) / np.sqrt(C)
+    np.testing.assert_allclose(vol.reshape(B, H * W, H * W), ref.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_corr_lookup_matches_reference_corrblock():
+    # Keep every pyramid level >= 2 px — the reference's normalized-coords
+    # sampler divides by (dim-1) and NaNs on size-1 levels (degenerate shape
+    # real configs never reach).
+    B, H, W, C = 1, 8, 8, 16
+    levels, radius = 3, 2
+    f1 = RNG.standard_normal((B, H, W, C)).astype(np.float32)
+    f2 = RNG.standard_normal((B, H, W, C)).astype(np.float32)
+    coords = (RNG.uniform(0, [W - 1, H - 1], size=(B, H, W, 2))
+              .astype(np.float32))
+
+    pyr = build_corr_pyramid(
+        all_pairs_correlation(jnp.asarray(f1), jnp.asarray(f2)), levels)
+    ours = np.asarray(corr_lookup(pyr, jnp.asarray(coords), radius))
+
+    ref = ref_corrblock(
+        torch.from_numpy(f1).permute(0, 3, 1, 2),
+        torch.from_numpy(f2).permute(0, 3, 1, 2),
+        torch.from_numpy(coords), levels, radius,
+    ).numpy()
+    assert ours.shape == (B, H, W, levels * (2 * radius + 1) ** 2)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_alternate_equals_all_pairs():
+    """Pooling/sampling are linear in fmap2, so the O(HW) on-demand path must
+    agree exactly with the materialized volume (SURVEY.md §2 #5)."""
+    B, H, W, C = 2, 8, 8, 8
+    levels, radius = 4, 3
+    f1 = RNG.standard_normal((B, H, W, C)).astype(np.float32)
+    f2 = RNG.standard_normal((B, H, W, C)).astype(np.float32)
+    coords = (RNG.uniform(-1, max(H, W), size=(B, H, W, 2))
+              .astype(np.float32))
+
+    pyr = build_corr_pyramid(
+        all_pairs_correlation(jnp.asarray(f1), jnp.asarray(f2)), levels)
+    dense = np.asarray(corr_lookup(pyr, jnp.asarray(coords), radius))
+
+    fpyr = build_fmap_pyramid(jnp.asarray(f2), levels)
+    ondemand = np.asarray(
+        alternate_corr_lookup(jnp.asarray(f1), fpyr, jnp.asarray(coords),
+                              radius))
+    np.testing.assert_allclose(ondemand, dense, rtol=1e-4, atol=1e-4)

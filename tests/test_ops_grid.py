@@ -1,0 +1,172 @@
+"""Parity tests for sampling ops against torch CPU as the semantics oracle.
+
+The reference's behavior is defined by F.grid_sample / F.interpolate /
+F.unfold; torch (CPU build) is available in this image, so we assert exact
+agreement rather than re-deriving edge cases by hand.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from raft_tpu.ops import (
+    avg_pool2x,
+    bilinear_sample,
+    convex_upsample,
+    coords_grid,
+    upflow8,
+)
+from raft_tpu.ops.pad import InputPadder
+
+RNG = np.random.default_rng(0)
+
+
+def torch_bilinear_sampler(img_nchw, coords_xy):
+    """The reference bilinear_sampler (core/utils/utils.py:57-71), verbatim
+    semantics via torch."""
+    H, W = img_nchw.shape[-2:]
+    xgrid, ygrid = coords_xy.split([1, 1], dim=-1)
+    xgrid = 2 * xgrid / (W - 1) - 1
+    ygrid = 2 * ygrid / (H - 1) - 1
+    grid = torch.cat([xgrid, ygrid], dim=-1)
+    return F.grid_sample(img_nchw, grid, align_corners=True)
+
+
+def test_coords_grid():
+    g = coords_grid(2, 3, 4)
+    assert g.shape == (2, 3, 4, 2)
+    np.testing.assert_array_equal(np.asarray(g[0, :, :, 0]),
+                                  np.tile(np.arange(4), (3, 1)))
+    np.testing.assert_array_equal(np.asarray(g[1, :, :, 1]),
+                                  np.tile(np.arange(3)[:, None], (1, 4)))
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_bilinear_sample_matches_grid_sample(seed):
+    rng = np.random.default_rng(seed)
+    B, H, W, C = 2, 5, 7, 3
+    img = rng.standard_normal((B, H, W, C)).astype(np.float32)
+    # Coords spanning in-bounds, OOB negative, OOB past the edge, and exact
+    # integers (the silent-off-by-half-pixel traps from SURVEY.md §7).
+    coords = rng.uniform(-2.5, max(H, W) + 1.5, size=(B, 6, 4, 2)).astype(np.float32)
+    coords[0, 0, 0] = [0.0, 0.0]
+    coords[0, 0, 1] = [W - 1, H - 1]
+    coords[0, 0, 2] = [3.0, 2.0]
+    coords[0, 0, 3] = [-1.0, -1.0]
+    coords[0, 1, 0] = [W - 0.5, H - 0.5]
+
+    ours = np.asarray(bilinear_sample(jnp.asarray(img), jnp.asarray(coords)))
+
+    t_img = torch.from_numpy(img).permute(0, 3, 1, 2)
+    t_coords = torch.from_numpy(coords)
+    ref = torch_bilinear_sampler(t_img, t_coords).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_sample_mask():
+    img = jnp.ones((1, 4, 6, 1))
+    coords = jnp.asarray(
+        [[[[0.5, 0.5], [0.0, 1.0], [5.0, 3.0], [4.9, 2.9], [-0.1, 1.0]]]])
+    _, mask = bilinear_sample(img, coords, return_mask=True)
+    # strictly-inside test (utils.py:67-69): edges and OOB are masked out
+    np.testing.assert_array_equal(np.asarray(mask[0, 0, :, 0]),
+                                  [1.0, 0.0, 0.0, 1.0, 0.0])
+
+
+def test_upflow8_matches_interpolate():
+    flow = RNG.standard_normal((2, 4, 6, 2)).astype(np.float32)
+    ours = np.asarray(upflow8(jnp.asarray(flow)))
+    t = torch.from_numpy(flow).permute(0, 3, 1, 2)
+    ref = 8 * F.interpolate(t, size=(32, 48), mode="bilinear", align_corners=True)
+    np.testing.assert_allclose(ours, ref.permute(0, 2, 3, 1).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_avg_pool2x_matches_torch():
+    x = RNG.standard_normal((2, 5, 7, 3)).astype(np.float32)  # odd dims
+    ours = np.asarray(avg_pool2x(jnp.asarray(x)))
+    ref = F.avg_pool2d(torch.from_numpy(x).permute(0, 3, 1, 2), 2, stride=2)
+    np.testing.assert_allclose(ours, ref.permute(0, 2, 3, 1).numpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def torch_convex_upsample(flow_nchw, mask_nchw):
+    """Reference upsample_flow (core/raft.py:72-83) via torch."""
+    N, _, H, W = flow_nchw.shape
+    mask = mask_nchw.view(N, 1, 9, 8, 8, H, W)
+    mask = torch.softmax(mask, dim=2)
+    up_flow = F.unfold(8 * flow_nchw, [3, 3], padding=1)
+    up_flow = up_flow.view(N, 2, 9, 1, 1, H, W)
+    up_flow = torch.sum(mask * up_flow, dim=2)
+    up_flow = up_flow.permute(0, 1, 4, 2, 5, 3)
+    return up_flow.reshape(N, 2, 8 * H, 8 * W)
+
+
+def test_convex_upsample_matches_reference():
+    B, H, W = 2, 3, 4
+    flow = RNG.standard_normal((B, H, W, 2)).astype(np.float32)
+    mask = RNG.standard_normal((B, H, W, 576)).astype(np.float32)
+    ours = np.asarray(convex_upsample(jnp.asarray(flow), jnp.asarray(mask)))
+    ref = torch_convex_upsample(
+        torch.from_numpy(flow).permute(0, 3, 1, 2),
+        torch.from_numpy(mask).permute(0, 3, 1, 2),
+    ).permute(0, 2, 3, 1).numpy()
+    assert ours.shape == (B, 8 * H, 8 * W, 2)
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode,hw", [("sintel", (5, 7)), ("kitti", (5, 7)),
+                                     ("sintel", (8, 16))])
+def test_input_padder(mode, hw):
+    H, W = hw
+    x = jnp.asarray(RNG.standard_normal((1, H, W, 3)).astype(np.float32))
+    padder = InputPadder(x.shape, mode=mode)
+    padded = padder.pad(x)
+    assert padded.shape[1] % 8 == 0 and padded.shape[2] % 8 == 0
+    unpadded = padder.unpad(padded)
+    np.testing.assert_array_equal(np.asarray(unpadded), np.asarray(x))
+    # replicate-pad parity with F.pad(mode='replicate')
+    t = torch.from_numpy(np.asarray(x)).permute(0, 3, 1, 2)
+    ref = F.pad(t, padder._pad, mode="replicate").permute(0, 2, 3, 1).numpy()
+    np.testing.assert_array_equal(np.asarray(padded), ref)
+
+
+def test_backward_warp_matches_demo():
+    """demo_warp.py:27-56 semantics, incl. the align_corners=False quirk."""
+    from raft_tpu.ops import backward_warp
+
+    B, H, W, C = 1, 6, 9, 3
+    img = RNG.standard_normal((B, H, W, C)).astype(np.float32)
+    flow = (2.0 * RNG.standard_normal((B, H, W, 2))).astype(np.float32)
+
+    warped, _ = backward_warp(jnp.asarray(img), jnp.asarray(flow))
+
+    # torch reference replicating demo_warp.py
+    t_img = torch.from_numpy(img).permute(0, 3, 1, 2)
+    t_flow = torch.from_numpy(flow).permute(0, 3, 1, 2)
+    xx = torch.arange(W).view(1, -1).repeat(H, 1).view(1, 1, H, W).float()
+    yy = torch.arange(H).view(-1, 1).repeat(1, W).view(1, 1, H, W).float()
+    grid = torch.cat((xx, yy), 1) + t_flow
+    vgrid = grid.clone()
+    vgrid[:, 0] = 2.0 * grid[:, 0] / max(W - 1, 1) - 1.0
+    vgrid[:, 1] = 2.0 * grid[:, 1] / max(H - 1, 1) - 1.0
+    vgrid = vgrid.permute(0, 2, 3, 1)
+    out = F.grid_sample(t_img, vgrid)
+    mask = F.grid_sample(torch.ones_like(t_img[:, :1]), vgrid)
+    mask[mask < 0.999] = 0
+    mask[mask > 0] = 1
+    ref = (out * mask).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(np.asarray(warped), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_forward_interpolate_identity_on_zero_flow_interior():
+    from raft_tpu.ops import forward_interpolate
+
+    flow = np.zeros((5, 6, 2), dtype=np.float32)
+    flow[..., 0] = 1.5
+    out = forward_interpolate(flow)
+    assert out.shape == (5, 6, 2)
+    # splatted values are 1.5 everywhere nearest-filled
+    assert np.allclose(out[..., 0], 1.5)
