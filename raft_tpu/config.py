@@ -28,6 +28,9 @@ class RAFTConfig:
     # volume and the loss stay float32 (matching the autocast boundaries at
     # raft.py:99-127 and corr.py:50).
     compute_dtype: str = "float32"  # "float32" | "bfloat16"
+    # Rematerialize each refinement step in the backward pass (trade FLOPs
+    # for activation memory across the scan).
+    remat: bool = False
 
     @property
     def hidden_dim(self) -> int:

@@ -1,0 +1,11 @@
+from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
+from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
+from raft_tpu.models.raft import RAFT
+
+__all__ = [
+    "BasicEncoder",
+    "SmallEncoder",
+    "BasicUpdateBlock",
+    "SmallUpdateBlock",
+    "RAFT",
+]

@@ -1,0 +1,139 @@
+"""Feature / context encoders (stride-8 CNNs).
+
+TPU-first re-design of the reference encoders (core/extractor.py:118-267):
+NHWC layout, parameters float32 with a bf16 compute option, and both input
+images encoded as one 2B batch (the reference's batch-concat trick,
+extractor.py:170-174, which is also the right shape for the MXU).
+
+Architecture parity:
+- BasicEncoder: 7x7/s2 conv (64) -> 3 stages of 2 residual blocks
+  (64/s1, 96/s2, 128/s2) -> 1x1 conv to output_dim.
+- SmallEncoder: 7x7/s2 conv (32) -> 3 stages of 2 bottleneck blocks
+  (32/s1, 64/s2, 96/s2) -> 1x1 conv to output_dim.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from raft_tpu.models.layers import conv, make_norm
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs + skip (extractor.py:6-56)."""
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Any = jnp.float32
+    train: bool = True
+    norm_train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        y = conv(self.planes, 3, self.stride, dtype=self.dtype, name="conv1")(x)
+        y = nn.relu(make_norm(self.norm_fn, self.planes, dtype=self.dtype,
+                              train=self.norm_train, name="norm1")(y))
+        y = conv(self.planes, 3, dtype=self.dtype, name="conv2")(y)
+        y = nn.relu(make_norm(self.norm_fn, self.planes, dtype=self.dtype,
+                              train=self.norm_train, name="norm2")(y))
+        if self.stride != 1:
+            x = conv(self.planes, 1, self.stride, dtype=self.dtype,
+                     name="downsample")(x)
+            x = make_norm(self.norm_fn, self.planes, dtype=self.dtype,
+                          train=self.norm_train, name="norm3")(x)
+        return nn.relu(x + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck + skip (extractor.py:60-116)."""
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Any = jnp.float32
+    train: bool = True
+    norm_train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        p4 = self.planes // 4
+        y = conv(p4, 1, dtype=self.dtype, name="conv1")(x)
+        y = nn.relu(make_norm(self.norm_fn, p4, dtype=self.dtype,
+                              train=self.norm_train, name="norm1")(y))
+        y = conv(p4, 3, self.stride, dtype=self.dtype, name="conv2")(y)
+        y = nn.relu(make_norm(self.norm_fn, p4, dtype=self.dtype,
+                              train=self.norm_train, name="norm2")(y))
+        y = conv(self.planes, 1, dtype=self.dtype, name="conv3")(y)
+        y = nn.relu(make_norm(self.norm_fn, self.planes, dtype=self.dtype,
+                              train=self.norm_train, name="norm3")(y))
+        if self.stride != 1:
+            x = conv(self.planes, 1, self.stride, dtype=self.dtype,
+                     name="downsample")(x)
+            x = make_norm(self.norm_fn, self.planes, dtype=self.dtype,
+                          train=self.norm_train, name="norm4")(x)
+        return nn.relu(x + y)
+
+
+class _Encoder(nn.Module):
+    """Shared stride-8 trunk; block type and widths differ per variant."""
+
+    output_dim: int
+    norm_fn: str
+    dropout: float
+    dtype: Any
+    train: bool
+    stem_dim: int
+    stage_dims: tuple
+    block_cls: type
+    # BN-only switch: False = frozen BN using running stats while the rest
+    # of the net trains (the reference's freeze_bn, train.py:147-148).
+    norm_train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        x = conv(self.stem_dim, 7, 2, dtype=self.dtype, name="conv1")(x)
+        x = make_norm(self.norm_fn, self.stem_dim, dtype=self.dtype,
+                      train=self.norm_train, name="norm1")(x)
+        x = nn.relu(x)
+
+        for i, dim in enumerate(self.stage_dims):
+            stride = 1 if i == 0 else 2
+            x = self.block_cls(dim, self.norm_fn, stride, dtype=self.dtype,
+                               train=self.train, norm_train=self.norm_train,
+                               name=f"layer{i + 1}_0")(x)
+            x = self.block_cls(dim, self.norm_fn, 1, dtype=self.dtype,
+                               train=self.train, norm_train=self.norm_train,
+                               name=f"layer{i + 1}_1")(x)
+
+        x = conv(self.output_dim, 1, dtype=self.dtype, name="conv2")(x)
+
+        if self.dropout > 0:
+            # torch Dropout2d zeroes whole channels (extractor.py:159-161)
+            x = nn.Dropout(rate=self.dropout,
+                           broadcast_dims=(1, 2),
+                           deterministic=not self.train)(x)
+        return x
+
+
+def BasicEncoder(output_dim: int = 128, norm_fn: str = "batch",
+                 dropout: float = 0.0, dtype: Any = jnp.float32,
+                 train: bool = True, norm_train: bool = True,
+                 name: str = None) -> _Encoder:
+    return _Encoder(output_dim=output_dim, norm_fn=norm_fn, dropout=dropout,
+                    dtype=dtype, train=train, norm_train=norm_train,
+                    stem_dim=64, stage_dims=(64, 96, 128),
+                    block_cls=ResidualBlock, name=name)
+
+
+def SmallEncoder(output_dim: int = 128, norm_fn: str = "batch",
+                 dropout: float = 0.0, dtype: Any = jnp.float32,
+                 train: bool = True, norm_train: bool = True,
+                 name: str = None) -> _Encoder:
+    return _Encoder(output_dim=output_dim, norm_fn=norm_fn, dropout=dropout,
+                    dtype=dtype, train=train, norm_train=norm_train,
+                    stem_dim=32, stage_dims=(32, 64, 96),
+                    block_cls=BottleneckBlock, name=name)

@@ -1,0 +1,71 @@
+"""Shared NN building blocks: convs with Kaiming init and the four
+normalization options of the reference encoders (extractor.py:16-38).
+
+Parameters are always float32; ``dtype`` controls compute precision
+(bf16 on TPU).  Norm statistics are computed in float32 by flax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# torch nn.init.kaiming_normal_(mode='fan_out', nonlinearity='relu'):
+# N(0, sqrt(2 / fan_out)) — extractor.py:150-157.
+kaiming_out = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def conv(features: int, kernel: Union[int, Tuple[int, int]], stride: int = 1,
+         *, dtype=jnp.float32, name: Optional[str] = None,
+         padding: Optional[Sequence[Tuple[int, int]]] = None) -> nn.Conv:
+    """3x3/7x7/1x1 conv with torch-style symmetric padding (kernel//2)."""
+    if isinstance(kernel, int):
+        kernel = (kernel, kernel)
+    if padding is None:
+        padding = [(k // 2, k // 2) for k in kernel]
+    return nn.Conv(features, kernel, strides=(stride, stride), padding=padding,
+                   kernel_init=kaiming_out, dtype=dtype, name=name)
+
+
+class InstanceNorm(nn.Module):
+    """Per-sample, per-channel spatial normalization.
+
+    Matches torch nn.InstanceNorm2d defaults: affine=False,
+    track_running_stats=False, eps=1e-5 (extractor.py:29-32 instantiates it
+    with defaults, so there are no learnable parameters).
+    """
+
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        orig_dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        mean = x32.mean(axis=(1, 2), keepdims=True)
+        var = x32.var(axis=(1, 2), keepdims=True)
+        y = (x32 - mean) / jnp.sqrt(var + self.epsilon)
+        return y.astype(orig_dtype)
+
+
+def make_norm(norm_fn: str, channels: int, *, dtype=jnp.float32,
+              train: bool = True, name: str = "norm") -> Callable:
+    """Normalization factory for the encoder's norm_fn option
+    (extractor.py:16-38): group | batch | instance | none.
+
+    For 'batch', ``train=False`` means use running averages (the reference's
+    freeze_bn eval()-mode BN, raft.py:58-61 / train.py:147-148).
+    """
+    if norm_fn == "group":
+        return nn.GroupNorm(num_groups=max(channels // 8, 1), epsilon=1e-5,
+                            dtype=dtype, name=name)
+    if norm_fn == "batch":
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                            epsilon=1e-5, dtype=dtype, name=name)
+    if norm_fn == "instance":
+        return InstanceNorm(dtype=dtype, name=name)
+    if norm_fn == "none":
+        return lambda x: x
+    raise ValueError(f"unknown norm_fn: {norm_fn}")

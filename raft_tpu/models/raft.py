@@ -1,0 +1,160 @@
+"""RAFT: Recurrent All-Pairs Field Transforms, TPU-native.
+
+Re-design of core/raft.py:24-144 as a functional flax module:
+
+- the iterative refinement loop is a single `nn.scan` (one XLA trace for
+  any iteration count, optionally rematerialized) instead of a Python
+  loop over 12+ unrolled graph copies;
+- the per-iteration `coords1.detach()` (raft.py:123) becomes
+  `lax.stop_gradient` on the scanned carry;
+- mixed precision is a compute-dtype policy: encoders + update block run
+  in bf16, the correlation volume and flow arithmetic stay float32
+  (matching the autocast boundaries at raft.py:99-127);
+- both images are encoded as one 2B batch (extractor.py:170-174).
+
+Call convention: NHWC uint8/float images in [0, 255].
+Train mode returns all `iters` upsampled flow iterates, stacked
+(iters, B, H, W, 2); test mode returns (flow_low, flow_up) like
+raft.py:141-142.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
+from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
+from raft_tpu.ops.corr import (
+    all_pairs_correlation,
+    alternate_corr_lookup,
+    build_corr_pyramid,
+    build_fmap_pyramid,
+    corr_lookup,
+)
+from raft_tpu.ops.grid import convex_upsample, coords_grid, upflow8
+
+
+def _compute_dtype(cfg: RAFTConfig):
+    return jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+
+
+class RefinementStep(nn.Module):
+    """One GRU refinement iteration — the body of the scan (raft.py:122-139)."""
+
+    cfg: RAFTConfig
+
+    @nn.compact
+    def __call__(self, carry, inp, corr_state, coords0):
+        cfg = self.cfg
+        dtype = _compute_dtype(cfg)
+        net, coords1 = carry
+
+        # Per-iteration gradient cut on the coordinate chain (raft.py:123).
+        coords1 = jax.lax.stop_gradient(coords1)
+
+        if cfg.alternate_corr:
+            fmap1, fmap2_pyr = corr_state
+            corr = alternate_corr_lookup(fmap1, fmap2_pyr, coords1,
+                                         cfg.corr_radius)
+        else:
+            corr = corr_lookup(corr_state, coords1, cfg.corr_radius)
+
+        flow = coords1 - coords0
+        corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1) ** 2
+        if cfg.small:
+            block = SmallUpdateBlock(corr_ch, cfg.hidden_dim, dtype=dtype,
+                                     name="update_block")
+        else:
+            block = BasicUpdateBlock(corr_ch, cfg.hidden_dim, dtype=dtype,
+                                     name="update_block")
+        net, mask, delta = block(net, inp, corr.astype(dtype),
+                                 flow.astype(dtype))
+
+        coords1 = coords1 + delta.astype(jnp.float32)
+        new_flow = coords1 - coords0
+
+        if mask is None:
+            flow_up = upflow8(new_flow)
+        else:
+            flow_up = convex_upsample(new_flow, mask.astype(jnp.float32))
+        return (net, coords1), flow_up
+
+
+class RAFT(nn.Module):
+    """Top-level model (core/raft.py:24-144)."""
+
+    cfg: RAFTConfig = RAFTConfig()
+
+    @nn.compact
+    def __call__(self, image1, image2, iters: int = 12,
+                 flow_init: Optional[jax.Array] = None,
+                 train: bool = False, freeze_bn: bool = False,
+                 test_mode: bool = False):
+        cfg = self.cfg
+        dtype = _compute_dtype(cfg)
+        hdim, cdim = cfg.hidden_dim, cfg.context_dim
+        # freeze_bn: BN runs in eval mode (running stats) while the rest
+        # trains — every stage after chairs (train.py:147-148).
+        norm_train = train and not freeze_bn
+
+        image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
+        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+
+        # Feature network over both images as one 2B batch.
+        if cfg.small:
+            fnet = SmallEncoder(cfg.fnet_dim, "instance", cfg.dropout,
+                                dtype=dtype, train=train, name="fnet")
+            cnet = SmallEncoder(hdim + cdim, "none", cfg.dropout,
+                                dtype=dtype, train=train, name="cnet")
+        else:
+            fnet = BasicEncoder(cfg.fnet_dim, "instance", cfg.dropout,
+                                dtype=dtype, train=train, name="fnet")
+            cnet = BasicEncoder(hdim + cdim, "batch", cfg.dropout,
+                                dtype=dtype, train=train,
+                                norm_train=norm_train, name="cnet")
+
+        fmaps = fnet(jnp.concatenate([image1, image2], axis=0).astype(dtype))
+        fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+        # Correlation in float32 (raft.py:102-103, corr.py:50).
+        fmap1 = fmap1.astype(jnp.float32)
+        fmap2 = fmap2.astype(jnp.float32)
+
+        if cfg.alternate_corr:
+            corr_state = (fmap1, tuple(build_fmap_pyramid(fmap2,
+                                                          cfg.corr_levels)))
+        else:
+            vol = all_pairs_correlation(fmap1, fmap2)
+            corr_state = tuple(build_corr_pyramid(vol, cfg.corr_levels))
+
+        # Context network on image1 only; split into GRU state + input.
+        ctx = cnet(image1.astype(dtype))
+        net, inp = jnp.split(ctx, [hdim], axis=-1)
+        net = jnp.tanh(net)
+        inp = nn.relu(inp)
+
+        B, H8, W8 = fmap1.shape[0], fmap1.shape[1], fmap1.shape[2]
+        coords0 = coords_grid(B, H8, W8)
+        coords1 = coords0
+        if flow_init is not None:
+            coords1 = coords1 + flow_init
+
+        step_cls = RefinementStep
+        if cfg.remat:
+            step_cls = nn.remat(step_cls)
+        scan = nn.scan(step_cls,
+                       variable_broadcast="params",
+                       split_rngs={"params": False},
+                       in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                       out_axes=0,
+                       length=iters)
+        (net, coords1), flow_predictions = scan(cfg, name="refine")(
+            (net, coords1), inp, corr_state, coords0)
+
+        if test_mode:
+            return coords1 - coords0, flow_predictions[-1]
+        return flow_predictions

@@ -1,0 +1,138 @@
+"""Recurrent update blocks: motion encoders, ConvGRUs, flow/mask heads.
+
+Parity targets: core/update.py:6-136.  NHWC; the SepConvGRU's 1x5/5x1
+factorized convs are the large model's throughput trick and map well to the
+MXU as two skinny matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from raft_tpu.models.layers import conv
+
+
+class FlowHead(nn.Module):
+    """conv3x3 -> relu -> conv3x3 to 2 channels (update.py:6-14)."""
+
+    hidden_dim: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(conv(self.hidden_dim, 3, dtype=self.dtype, name="conv1")(x))
+        return conv(2, 3, dtype=self.dtype, name="conv2")(x)
+
+
+class ConvGRU(nn.Module):
+    """3x3 convolutional GRU (update.py:16-31)."""
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, x):
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(conv(self.hidden_dim, 3, dtype=self.dtype, name="convz")(hx))
+        r = nn.sigmoid(conv(self.hidden_dim, 3, dtype=self.dtype, name="convr")(hx))
+        q = nn.tanh(conv(self.hidden_dim, 3, dtype=self.dtype, name="convq")(
+            jnp.concatenate([r * h, x], axis=-1)))
+        return (1 - z) * h + z * q
+
+
+class SepConvGRU(nn.Module):
+    """Factorized 1x5 + 5x1 GRU (update.py:33-60)."""
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, x):
+        # horizontal pass (1x5)
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(conv(self.hidden_dim, (1, 5), dtype=self.dtype, name="convz1")(hx))
+        r = nn.sigmoid(conv(self.hidden_dim, (1, 5), dtype=self.dtype, name="convr1")(hx))
+        q = nn.tanh(conv(self.hidden_dim, (1, 5), dtype=self.dtype, name="convq1")(
+            jnp.concatenate([r * h, x], axis=-1)))
+        h = (1 - z) * h + z * q
+        # vertical pass (5x1)
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(conv(self.hidden_dim, (5, 1), dtype=self.dtype, name="convz2")(hx))
+        r = nn.sigmoid(conv(self.hidden_dim, (5, 1), dtype=self.dtype, name="convr2")(hx))
+        q = nn.tanh(conv(self.hidden_dim, (5, 1), dtype=self.dtype, name="convq2")(
+            jnp.concatenate([r * h, x], axis=-1)))
+        return (1 - z) * h + z * q
+
+
+class SmallMotionEncoder(nn.Module):
+    """Corr+flow feature mixer for the small model (update.py:62-77)."""
+
+    corr_channels: int  # corr_levels * (2r+1)^2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(conv(96, 1, dtype=self.dtype, name="convc1")(corr))
+        flo = nn.relu(conv(64, 7, dtype=self.dtype, name="convf1")(flow))
+        flo = nn.relu(conv(32, 3, dtype=self.dtype, name="convf2")(flo))
+        out = nn.relu(conv(80, 3, dtype=self.dtype, name="conv")(
+            jnp.concatenate([cor, flo], axis=-1)))
+        return jnp.concatenate([out, flow], axis=-1)  # 80 + 2 = 82 channels
+
+
+class BasicMotionEncoder(nn.Module):
+    """Corr+flow feature mixer for the large model (update.py:79-97)."""
+
+    corr_channels: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(conv(256, 1, dtype=self.dtype, name="convc1")(corr))
+        cor = nn.relu(conv(192, 3, dtype=self.dtype, name="convc2")(cor))
+        flo = nn.relu(conv(128, 7, dtype=self.dtype, name="convf1")(flow))
+        flo = nn.relu(conv(64, 3, dtype=self.dtype, name="convf2")(flo))
+        out = nn.relu(conv(126, 3, dtype=self.dtype, name="conv")(
+            jnp.concatenate([cor, flo], axis=-1)))
+        return jnp.concatenate([out, flow], axis=-1)  # 126 + 2 = 128 channels
+
+
+class SmallUpdateBlock(nn.Module):
+    """Motion encoder + ConvGRU + flow head; no upsample mask
+    (update.py:99-112 — mask is None, so the model bilinearly upsamples)."""
+
+    corr_channels: int
+    hidden_dim: int = 96
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net, inp, corr, flow):
+        motion = SmallMotionEncoder(self.corr_channels, dtype=self.dtype,
+                                    name="encoder")(flow, corr)
+        x = jnp.concatenate([inp, motion], axis=-1)
+        net = ConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
+        delta = FlowHead(128, dtype=self.dtype, name="flow_head")(net)
+        return net, None, delta
+
+
+class BasicUpdateBlock(nn.Module):
+    """Motion encoder + SepConvGRU + flow head + convex-upsample mask head
+    (update.py:114-136; the 0.25 mask scale balances gradients)."""
+
+    corr_channels: int
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net, inp, corr, flow):
+        motion = BasicMotionEncoder(self.corr_channels, dtype=self.dtype,
+                                    name="encoder")(flow, corr)
+        x = jnp.concatenate([inp, motion], axis=-1)
+        net = SepConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
+        delta = FlowHead(256, dtype=self.dtype, name="flow_head")(net)
+        mask = nn.relu(conv(256, 3, dtype=self.dtype, name="mask_conv1")(net))
+        mask = 0.25 * conv(576, 1, dtype=self.dtype, name="mask_conv2")(mask)
+        return net, mask, delta

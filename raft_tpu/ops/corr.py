@@ -53,6 +53,7 @@ def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
 
 def build_corr_pyramid(corr: jax.Array, num_levels: int = 4) -> List[jax.Array]:
     """Average-pool pyramid over the target (last two) axes (corr.py:24-27)."""
+    _check_pyramid_depth(corr.shape[2], corr.shape[3], num_levels)
     pyramid = [corr]
     x = corr
     for _ in range(num_levels - 1):
@@ -62,6 +63,15 @@ def build_corr_pyramid(corr: jax.Array, num_levels: int = 4) -> List[jax.Array]:
         x = img.reshape(B, Q, img.shape[1], img.shape[2])
         pyramid.append(x)
     return pyramid
+
+
+def _check_pyramid_depth(h: int, w: int, num_levels: int) -> None:
+    """Every pyramid level must be >= 1 px (floor-halving num_levels-1 times)."""
+    need = 2 ** (num_levels - 1)
+    if min(h, w) < need:
+        raise ValueError(
+            f"feature map {h}x{w} too small for a {num_levels}-level "
+            f"pyramid; need >= {need} px per side")
 
 
 def _window_offsets(radius: int, dtype=jnp.float32) -> jax.Array:
@@ -103,6 +113,7 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
 
 def build_fmap_pyramid(fmap: jax.Array, num_levels: int = 4) -> List[jax.Array]:
     """fmap2 average-pool pyramid for the on-demand path (corr.py:68-72)."""
+    _check_pyramid_depth(fmap.shape[1], fmap.shape[2], num_levels)
     pyr = [fmap]
     for _ in range(num_levels - 1):
         pyr.append(avg_pool2x(pyr[-1]))

@@ -1,0 +1,12 @@
+from raft_tpu.training.loss import sequence_loss, flow_metrics
+from raft_tpu.training.optim import make_optimizer, onecycle_linear_schedule
+from raft_tpu.training.state import TrainState, create_train_state
+
+__all__ = [
+    "sequence_loss",
+    "flow_metrics",
+    "make_optimizer",
+    "onecycle_linear_schedule",
+    "TrainState",
+    "create_train_state",
+]

@@ -1,0 +1,64 @@
+"""Sequence loss and flow metrics (train.py:47-72)."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
+                  valid: jax.Array, gamma: float = 0.8,
+                  max_flow: float = 400.0) -> Tuple[jax.Array, Dict]:
+    """Exponentially weighted L1 over all refinement iterates.
+
+    The i-th of N predictions is weighted gamma**(N - i - 1) (train.py:58),
+    and pixels are masked by the dataset valid mask AND |flow_gt| < max_flow
+    (train.py:54-55).
+
+    Args:
+      flow_preds: (iters, B, H, W, 2) stacked iterates (scan output).
+      flow_gt: (B, H, W, 2).
+      valid: (B, H, W) 0/1 mask.
+      gamma: decay.
+      max_flow: magnitude cutoff for supervision.
+
+    Returns:
+      (scalar loss, metrics dict with epe/1px/3px/5px computed from the
+      final iterate, train.py:62-70).
+    """
+    n = flow_preds.shape[0]
+    mag = jnp.sqrt(jnp.sum(flow_gt.astype(jnp.float32) ** 2, axis=-1))
+    valid = (valid >= 0.5) & (mag < max_flow)  # (B, H, W)
+    vw = valid.astype(jnp.float32)[None, ..., None]  # (1, B, H, W, 1)
+
+    weights = gamma ** (n - 1 - jnp.arange(n, dtype=jnp.float32))
+    abs_err = jnp.abs(flow_preds.astype(jnp.float32) - flow_gt[None])
+    # mean over everything per-iterate (the reference takes .mean() of the
+    # masked per-pixel loss, i.e. including masked zeros in the denominator:
+    # (valid[:, None] * i_loss).mean(), train.py:59)
+    per_iter = jnp.mean(vw * abs_err, axis=(1, 2, 3, 4))
+    loss = jnp.sum(weights * per_iter)
+
+    metrics = flow_metrics(flow_preds[-1], flow_gt, valid)
+    return loss, metrics
+
+
+def flow_metrics(flow: jax.Array, flow_gt: jax.Array,
+                 valid: jax.Array) -> Dict[str, jax.Array]:
+    """EPE and 1/3/5px outlier rates over valid pixels (train.py:62-70)."""
+    epe = jnp.sqrt(jnp.sum((flow.astype(jnp.float32)
+                            - flow_gt.astype(jnp.float32)) ** 2, axis=-1))
+    v = valid.astype(jnp.float32)
+    denom = jnp.maximum(v.sum(), 1.0)
+
+    def masked_mean(x):
+        return (x * v).sum() / denom
+
+    return {
+        "epe": masked_mean(epe),
+        "1px": masked_mean((epe < 1.0).astype(jnp.float32)),
+        "3px": masked_mean((epe < 3.0).astype(jnp.float32)),
+        "5px": masked_mean((epe < 5.0).astype(jnp.float32)),
+    }

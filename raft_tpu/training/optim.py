@@ -1,0 +1,42 @@
+"""Optimizer: AdamW + linear one-cycle schedule + global-norm clip.
+
+Parity target: fetch_optimizer (train.py:79-86) — AdamW(lr, wdecay, eps)
+with OneCycleLR(max_lr=lr, total_steps=num_steps+100, pct_start=0.05,
+anneal_strategy='linear') and clip_grad_norm_(1.0) (train.py:177).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def onecycle_linear_schedule(peak_lr: float, total_steps: int,
+                             pct_start: float = 0.05,
+                             div_factor: float = 25.0,
+                             final_div_factor: float = 1e4):
+    """Linear warmup to peak, then linear decay — torch OneCycleLR with
+    anneal_strategy='linear' (initial = peak/25, final = initial/1e4)."""
+    init_lr = peak_lr / div_factor
+    final_lr = init_lr / final_div_factor
+    warmup = max(int(pct_start * total_steps), 1)
+    return optax.join_schedules(
+        [optax.linear_schedule(init_lr, peak_lr, warmup),
+         optax.linear_schedule(peak_lr, final_lr, total_steps - warmup)],
+        [warmup],
+    )
+
+
+def make_optimizer(lr: float, num_steps: int, wdecay: float,
+                   epsilon: float = 1e-8, clip: float = 1.0):
+    """Gradient transform chain: global-norm clip -> AdamW(one-cycle).
+
+    Weight decay applies to every parameter, matching torch AdamW over
+    model.parameters() (train.py:81) — no mask for norms/biases.
+    """
+    schedule = onecycle_linear_schedule(lr, num_steps + 100)
+    tx = optax.chain(
+        optax.clip_by_global_norm(clip),
+        optax.adamw(schedule, b1=0.9, b2=0.999, eps=epsilon,
+                    weight_decay=wdecay),
+    )
+    return tx, schedule

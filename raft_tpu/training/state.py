@@ -1,0 +1,97 @@
+"""Train state + checkpointing.
+
+Superset of the reference's checkpointing (train.py:185-187 saves only the
+model state_dict; optimizer/scheduler/step are lost on resume — SURVEY.md §5).
+Here the full state (params, batch_stats, optimizer state, step, PRNG key)
+is saved, so resume continues the schedule exactly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    batch_stats: Any = None
+    rng: Any = None
+
+
+def create_train_state(model, tx, rng, sample_batch, iters: int = 12):
+    """Initialize parameters with a sample batch and build the TrainState."""
+    init_rng, state_rng = jax.random.split(rng)
+    variables = model.init(init_rng, sample_batch["image1"],
+                           sample_batch["image2"], iters=iters, train=True)
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        tx=tx,
+        batch_stats=variables.get("batch_stats", {}),
+        rng=state_rng,
+    )
+
+
+# ----------------------------------------------------------------------------
+# Checkpoint I/O (msgpack via flax serialization; host-side, device-agnostic)
+# ----------------------------------------------------------------------------
+
+def save_checkpoint(path: str, state: TrainState) -> str:
+    """Serialize full train state to ``path`` (msgpack)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+        "opt_state": jax.device_get(state.opt_state),
+        "step": jax.device_get(state.step),
+        "rng": jax.device_get(state.rng),
+    }
+    # optax states are NamedTuples; convert to plain dicts for msgpack
+    payload = flax.serialization.to_state_dict(payload)
+    with open(path, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(payload))
+    return path
+
+
+def restore_checkpoint(path: str, state: TrainState,
+                       params_only: bool = False) -> TrainState:
+    """Restore a checkpoint.
+
+    ``params_only=True`` mirrors the reference's strict=False stage-transfer
+    restore (train.py:141-142): take params (+ batch_stats) but keep the
+    fresh optimizer/schedule state.
+    """
+    with open(path, "rb") as f:
+        payload = flax.serialization.msgpack_restore(f.read())
+
+    params = flax.serialization.from_state_dict(state.params, payload["params"])
+    batch_stats = flax.serialization.from_state_dict(
+        state.batch_stats, payload.get("batch_stats", {}))
+    if params_only:
+        return state.replace(params=params, batch_stats=batch_stats)
+    opt_state = flax.serialization.from_state_dict(
+        state.opt_state, payload["opt_state"])
+    return state.replace(
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=opt_state,
+        step=jnp.asarray(payload["step"]),
+        rng=jnp.asarray(payload["rng"]),
+    )
+
+
+def latest_checkpoint(ckpt_dir: str, prefix: str = "") -> Optional[str]:
+    """Most recently modified checkpoint in a directory (for auto-resume
+    after preemption — the failure-recovery mechanism the reference lacks)."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    cands = [os.path.join(ckpt_dir, f) for f in os.listdir(ckpt_dir)
+             if f.endswith(".msgpack") and f.startswith(prefix)]
+    if not cands:
+        return None
+    return max(cands, key=os.path.getmtime)

@@ -1,0 +1,76 @@
+"""The jitted training step (single-device; the sharded version lives in
+raft_tpu/parallel/).
+
+Replaces the reference's hot loop body (train.py:161-181): forward through
+all refinement iterates, gamma-weighted sequence loss, global-norm clip,
+AdamW update.  No GradScaler — bf16 needs no loss scaling on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.training.loss import sequence_loss
+from raft_tpu.training.state import TrainState
+
+
+def make_train_step(model, iters: int, gamma: float, max_flow: float,
+                    freeze_bn: bool = False, add_noise: bool = False):
+    """Build a jit-compiled train step for ``model``.
+
+    The optional noise augmentation matches train.py:167-170: N(0, sigma)
+    with sigma ~ U(0, 5), clipped back to [0, 255], applied on device.
+    """
+
+    @jax.jit
+    def train_step(state: TrainState,
+                   batch: Dict[str, jax.Array]) -> Tuple[TrainState, Dict]:
+        rng, step_rng, noise_rng = jax.random.split(state.rng, 3)
+
+        image1, image2 = batch["image1"], batch["image2"]
+        if add_noise:
+            k1, k2, ks = jax.random.split(noise_rng, 3)
+            stdv = jax.random.uniform(ks) * 5.0
+            image1 = jnp.clip(
+                image1 + stdv * jax.random.normal(k1, image1.shape), 0.0, 255.0)
+            image2 = jnp.clip(
+                image2 + stdv * jax.random.normal(k2, image2.shape), 0.0, 255.0)
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            out = model.apply(
+                variables, image1, image2, iters=iters, train=True,
+                freeze_bn=freeze_bn,
+                mutable=["batch_stats"] if state.batch_stats else [],
+                rngs={"dropout": step_rng})
+            preds, new_model_state = out
+            loss, metrics = sequence_loss(preds, batch["flow"], batch["valid"],
+                                          gamma=gamma, max_flow=max_flow)
+            return loss, (metrics, new_model_state)
+
+        (loss, (metrics, new_model_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+
+        new_state = state.apply_gradients(grads=grads)
+        new_state = new_state.replace(
+            rng=rng,
+            batch_stats=new_model_state.get("batch_stats",
+                                            state.batch_stats))
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+        metrics["grad_norm"] = optax_global_norm(grads)
+        return new_state, metrics
+
+    return train_step
+
+
+def optax_global_norm(tree) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves))

@@ -1,0 +1,118 @@
+"""Import reference PyTorch RAFT checkpoints (.pth) into raft_tpu params.
+
+Maps the reference's state_dict naming (core/raft.py module tree, with the
+DataParallel ``module.`` prefix from the wrap-before-save at train.py:138,187)
+onto this package's flax param/batch_stats trees:
+
+- conv weights  (O, I, kH, kW) -> (kH, kW, I, O)
+- BatchNorm     weight/bias -> scale/bias; running_mean/var -> batch_stats
+- GroupNorm     weight/bias -> scale/bias
+- InstanceNorm  no parameters on either side
+- torch Sequential indices -> named modules:
+    layerN.M            -> layerN_M
+    downsample.0/.1     -> downsample / norm3 (residual) or norm4 (bottleneck)
+    update_block.mask.0/.2 -> mask_conv1 / mask_conv2
+- update_block.* lives under the scan scope: refine/update_block/*
+
+Zoo checkpoints (raft-things.pth etc., download_models.sh) load through
+this shim for EPE-parity evaluation.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+def _assign(tree: Dict, path, value: np.ndarray):
+    node = tree
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = value
+
+
+def _map_torch_key(key: str) -> Tuple[Tuple[str, ...], str, str]:
+    """Map a torch state_dict key to (flax path, kind, param name).
+
+    kind: 'params' or 'batch_stats'. Returns (None, None, None) for entries
+    to skip (num_batches_tracked).
+    """
+    key = re.sub(r"^module\.", "", key)
+    parts = key.split(".")
+    leaf = parts[-1]
+
+    if leaf == "num_batches_tracked":
+        return None, None, None
+
+    # Sequential index renames
+    out = []
+    i = 0
+    while i < len(parts) - 1:
+        p = parts[i]
+        if p.startswith("layer") and i + 1 < len(parts) and parts[i + 1].isdigit():
+            out.append(f"{p}_{parts[i + 1]}")
+            i += 2
+        elif p == "downsample" and parts[i + 1].isdigit():
+            # .0 = conv, .1 = norm; norm name resolved by caller (norm3/norm4)
+            out.append("downsample" if parts[i + 1] == "0" else "__dsnorm__")
+            i += 2
+        elif p == "mask" and parts[i + 1].isdigit():
+            idx = parts[i + 1]
+            out.append({"0": "mask_conv1", "2": "mask_conv2"}[idx])
+            i += 2
+        elif p == "update_block":
+            out.extend(["refine", "update_block"])
+            i += 1
+        else:
+            out.append(p)
+            i += 1
+
+    if leaf in ("running_mean", "running_var"):
+        name = "mean" if leaf == "running_mean" else "var"
+        return tuple(out), "batch_stats", name
+    if leaf == "weight":
+        return tuple(out), "params", "weight"
+    if leaf == "bias":
+        return tuple(out), "params", "bias"
+    raise ValueError(f"unhandled torch key: {key}")
+
+
+def convert_state_dict(state_dict: Dict[str, Any], small: bool = False
+                       ) -> Tuple[Dict, Dict]:
+    """Convert a torch state_dict to (params, batch_stats) nested dicts."""
+    params: Dict = {}
+    batch_stats: Dict = {}
+    dsnorm = "norm4" if small else "norm3"  # bottleneck vs residual blocks
+
+    for key, value in state_dict.items():
+        path, kind, name = _map_torch_key(key)
+        if path is None:
+            continue
+        path = tuple(dsnorm if p == "__dsnorm__" else p for p in path)
+        v = np.asarray(value.detach().cpu().numpy() if hasattr(value, "detach")
+                       else value)
+
+        if kind == "batch_stats":
+            _assign(batch_stats, path + (name,), v)
+            continue
+
+        is_conv = v.ndim == 4
+        if is_conv and name == "weight":
+            # (O, I, kH, kW) -> (kH, kW, I, O)
+            _assign(params, path + ("kernel",), v.transpose(2, 3, 1, 0))
+        elif name == "weight":
+            # norm affine weight -> flax 'scale'
+            _assign(params, path + ("scale",), v)
+        else:
+            _assign(params, path + ("bias",), v)
+    return params, batch_stats
+
+
+def load_torch_checkpoint(path: str, small: bool = False) -> Tuple[Dict, Dict]:
+    """Load a reference .pth and convert (requires torch, CPU map)."""
+    import torch
+
+    state_dict = torch.load(path, map_location="cpu", weights_only=True)
+    return convert_state_dict(state_dict, small=small)

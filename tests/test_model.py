@@ -1,0 +1,135 @@
+"""Model-level tests: shapes, iterate evolution, variants, determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+
+RNG = np.random.default_rng(7)
+
+
+def make_inputs(B=1, H=64, W=96):
+    img1 = RNG.uniform(0, 255, (B, H, W, 3)).astype(np.float32)
+    img2 = RNG.uniform(0, 255, (B, H, W, 3)).astype(np.float32)
+    return jnp.asarray(img1), jnp.asarray(img2)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = RAFTConfig(small=True)
+    model = RAFT(cfg)
+    img1, img2 = make_inputs()
+    variables = model.init(jax.random.PRNGKey(0), img1, img2, iters=2)
+    return model, variables
+
+
+def test_small_forward_shapes(small_model):
+    model, variables = small_model
+    img1, img2 = make_inputs()
+    flows = model.apply(variables, img1, img2, iters=3)
+    assert flows.shape == (3, 1, 64, 96, 2)
+    assert flows.dtype == jnp.float32
+
+
+def test_test_mode_returns_low_and_up(small_model):
+    model, variables = small_model
+    img1, img2 = make_inputs()
+    flow_low, flow_up = model.apply(variables, img1, img2, iters=3,
+                                    test_mode=True)
+    assert flow_low.shape == (1, 8, 12, 2)
+    assert flow_up.shape == (1, 64, 96, 2)
+
+
+def test_iterates_evolve(small_model):
+    """Each refinement iteration must actually change the estimate."""
+    model, variables = small_model
+    img1, img2 = make_inputs()
+    flows = np.asarray(model.apply(variables, img1, img2, iters=4))
+    diffs = [np.abs(flows[i + 1] - flows[i]).max() for i in range(3)]
+    assert all(d > 0 for d in diffs)
+
+
+def test_warm_start_changes_result(small_model):
+    model, variables = small_model
+    img1, img2 = make_inputs()
+    init = jnp.ones((1, 8, 12, 2)) * 2.0
+    f0 = model.apply(variables, img1, img2, iters=2)
+    f1 = model.apply(variables, img1, img2, iters=2, flow_init=init)
+    assert np.abs(np.asarray(f0) - np.asarray(f1)).max() > 1e-3
+
+
+def test_large_model_params_and_shapes():
+    cfg = RAFTConfig(small=False)
+    model = RAFT(cfg)
+    img1, img2 = make_inputs(H=64, W=64)
+    variables = model.init(jax.random.PRNGKey(0), img1, img2, iters=1,
+                           train=True)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(variables["params"]))
+    # Reference RAFT-large is ~5.26M params (paper/README; count_parameters
+    # train.py:76).  Architecture parity should land within 1%.
+    assert 5.0e6 < n_params < 5.5e6, n_params
+    assert "batch_stats" in variables  # cnet uses BN (raft.py:55)
+    flows, _ = model.apply(variables, img1, img2, iters=2, train=True,
+                           mutable=["batch_stats"],
+                           rngs={"dropout": jax.random.PRNGKey(1)})
+    assert flows.shape == (2, 1, 64, 64, 2)
+
+
+def test_small_model_param_count(small_model):
+    _, variables = small_model
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(variables["params"]))
+    # Reference RAFT-small is ~0.99M params.
+    assert 0.9e6 < n_params < 1.1e6, n_params
+
+
+def test_alternate_corr_matches_all_pairs(small_model):
+    """--alternate_corr must be a pure memory/perf switch (corr.py:63-91),
+    not a numerics change."""
+    model, variables = small_model
+    img1, img2 = make_inputs()
+    dense = model.apply(variables, img1, img2, iters=2)
+
+    alt_model = RAFT(RAFTConfig(small=True, alternate_corr=True))
+    alt = alt_model.apply(variables, img1, img2, iters=2)
+    # The two paths are bit-identical only at the corr op level (see
+    # test_ops_corr.test_alternate_equals_all_pairs); through the recurrent
+    # update their ~1e-7 summation-order difference amplifies, so the model
+    # check is a loose agreement, not bit parity.
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(alt),
+                               rtol=1e-2, atol=1e-1)
+
+
+def test_bfloat16_policy_runs(small_model):
+    """bf16 is the TPU compute policy; with an untrained net on noise inputs
+    the recurrence is chaotic, so closeness to f32 is not a meaningful check
+    here — training convergence under bf16 is covered by the train tests."""
+    _, variables = small_model
+    img1, img2 = make_inputs()
+    bf_model = RAFT(RAFTConfig(small=True, compute_dtype="bfloat16"))
+    bf = bf_model.apply(variables, img1, img2, iters=2)
+    assert bf.dtype == jnp.float32  # outputs always f32
+    assert np.isfinite(np.asarray(bf)).all()
+
+
+def test_remat_matches(small_model):
+    model, variables = small_model
+    img1, img2 = make_inputs()
+    base = model.apply(variables, img1, img2, iters=2)
+    rm_model = RAFT(RAFTConfig(small=True, remat=True))
+    rm = rm_model.apply(variables, img1, img2, iters=2)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(rm),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_jit_and_determinism(small_model):
+    model, variables = small_model
+    img1, img2 = make_inputs()
+    fwd = jax.jit(lambda v, a, b: model.apply(v, a, b, iters=2))
+    f1 = fwd(variables, img1, img2)
+    f2 = fwd(variables, img1, img2)
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))

@@ -1,0 +1,176 @@
+"""Training-layer tests: loss parity vs a torch oracle, schedule shape,
+end-to-end overfit on a synthetic pair, checkpoint round-trip."""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+from raft_tpu.training import (
+    TrainState,
+    create_train_state,
+    make_optimizer,
+    onecycle_linear_schedule,
+    sequence_loss,
+)
+from raft_tpu.training.state import (latest_checkpoint, restore_checkpoint,
+                                     save_checkpoint)
+from raft_tpu.training.step import make_train_step
+
+RNG = np.random.default_rng(11)
+
+
+def torch_sequence_loss(flow_preds, flow_gt, valid, gamma=0.8, max_flow=400):
+    """Reference sequence_loss (train.py:47-72) via torch, NCHW."""
+    n_predictions = len(flow_preds)
+    flow_loss = 0.0
+    mag = torch.sum(flow_gt ** 2, dim=1).sqrt()
+    valid = (valid >= 0.5) & (mag < max_flow)
+    for i in range(n_predictions):
+        i_weight = gamma ** (n_predictions - i - 1)
+        i_loss = (flow_preds[i] - flow_gt).abs()
+        flow_loss += i_weight * (valid[:, None] * i_loss).mean()
+    epe = torch.sum((flow_preds[-1] - flow_gt) ** 2, dim=1).sqrt()
+    epe = epe.view(-1)[valid.view(-1)]
+    return flow_loss, {
+        "epe": epe.mean().item(),
+        "1px": (epe < 1).float().mean().item(),
+        "3px": (epe < 3).float().mean().item(),
+        "5px": (epe < 5).float().mean().item(),
+    }
+
+
+def test_sequence_loss_matches_reference():
+    iters, B, H, W = 3, 2, 8, 10
+    preds = RNG.standard_normal((iters, B, H, W, 2)).astype(np.float32) * 5
+    gt = RNG.standard_normal((B, H, W, 2)).astype(np.float32) * 5
+    valid = (RNG.uniform(size=(B, H, W)) > 0.3).astype(np.float32)
+    # make some gt exceed max_flow to exercise the magnitude cutoff
+    gt[0, 0, 0] = [500.0, 0.0]
+
+    loss, metrics = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
+                                  jnp.asarray(valid), gamma=0.8,
+                                  max_flow=400.0)
+
+    t_preds = [torch.from_numpy(preds[i]).permute(0, 3, 1, 2)
+               for i in range(iters)]
+    t_gt = torch.from_numpy(gt).permute(0, 3, 1, 2)
+    t_valid = torch.from_numpy(valid)
+    ref_loss, ref_metrics = torch_sequence_loss(t_preds, t_gt, t_valid)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in ["epe", "1px", "3px", "5px"]:
+        np.testing.assert_allclose(float(metrics[k]), ref_metrics[k],
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_onecycle_schedule_shape():
+    sched = onecycle_linear_schedule(4e-4, 1000, pct_start=0.05)
+    lrs = np.array([float(sched(i)) for i in range(0, 1001, 10)])
+    peak_idx = lrs.argmax()
+    assert abs(peak_idx * 10 - 50) <= 10           # peak at ~5%
+    np.testing.assert_allclose(lrs[0], 4e-4 / 25, rtol=1e-3)
+    np.testing.assert_allclose(lrs.max(), 4e-4, rtol=1e-2)
+    assert lrs[-1] < 1e-6                          # decays ~to zero
+    # monotone up then monotone down
+    assert (np.diff(lrs[:peak_idx]) > 0).all()
+    assert (np.diff(lrs[peak_idx:]) < 0).all()
+
+
+def _tiny_batch(B=2, H=64, W=64, shift=1.0):
+    """Synthetic pair: image2 is image1 shifted by `shift` px in x."""
+    base = RNG.uniform(0, 255, (B, H + 8, W + 8, 3)).astype(np.float32)
+    # smooth it so subpixel structure is learnable
+    k = np.ones((3, 3, 1)) / 9.0
+    from scipy.signal import convolve
+    base = np.stack([convolve(b, k, mode="same") for b in base])
+    img1 = base[:, 4:-4, 4:-4]
+    img2 = np.roll(base, int(shift), axis=2)[:, 4:-4, 4:-4]
+    flow = np.zeros((B, H, W, 2), np.float32)
+    flow[..., 0] = shift
+    return {
+        "image1": jnp.asarray(img1),
+        "image2": jnp.asarray(img2),
+        "flow": jnp.asarray(flow),
+        "valid": jnp.ones((B, H, W), np.float32),
+    }
+
+
+def test_train_step_overfits_synthetic_shift():
+    """A few steps on one synthetic pair must reduce the loss — the
+    end-to-end 'it trains' check (reference has no equivalent; SURVEY.md §4)."""
+    batch = _tiny_batch()
+    model = RAFT(RAFTConfig(small=True))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=50, wdecay=1e-5)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=4)
+    step = make_train_step(model, iters=4, gamma=0.8, max_flow=400.0)
+
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert np.isfinite(losses).all()
+
+
+def test_checkpoint_roundtrip_and_params_only():
+    batch = _tiny_batch(B=1, H=64, W=64)
+    model = RAFT(RAFTConfig(small=True))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=50, wdecay=1e-5)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=2)
+    step = make_train_step(model, iters=2, gamma=0.8, max_flow=400.0)
+    state, _ = step(state, batch)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ckpt_1.msgpack")
+        save_checkpoint(path, state)
+        assert latest_checkpoint(d) == path
+
+        fresh = create_train_state(model, tx, jax.random.PRNGKey(1), batch,
+                                   iters=2)
+        restored = restore_checkpoint(path, fresh)
+        assert int(restored.step) == int(state.step)
+        for a, b in zip(jax.tree.leaves(restored.params),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # full restore continues identically
+        s1, m1 = step(state, batch)
+        s2, m2 = step(restored, batch)
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                                   rtol=1e-6)
+
+        # params-only restore (stage transfer, train.py:141-142) keeps step 0
+        partial = restore_checkpoint(path, fresh, params_only=True)
+        assert int(partial.step) == 0
+
+
+def test_bn_freeze_keeps_stats():
+    """freeze_bn: batch_stats must not change during training steps
+    (train.py:147-148,201-202)."""
+    batch = _tiny_batch(B=1, H=64, W=64)
+    model = RAFT(RAFTConfig(small=False))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=50, wdecay=1e-5)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=2)
+    frozen_step = make_train_step(model, iters=2, gamma=0.8, max_flow=400.0,
+                                  freeze_bn=True)
+    new_state, _ = frozen_step(state, batch)
+    for a, b in zip(jax.tree.leaves(state.batch_stats),
+                    jax.tree.leaves(new_state.batch_stats)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    live_step = make_train_step(model, iters=2, gamma=0.8, max_flow=400.0,
+                                freeze_bn=False)
+    live_state, _ = live_step(state, batch)
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(state.batch_stats),
+                        jax.tree.leaves(live_state.batch_stats)))
+    assert changed
