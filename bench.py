@@ -1,0 +1,75 @@
+"""Benchmark runner: FlyingChairs-config training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": "image-pairs/sec/chip", "value": N, "unit": "pairs/s",
+   "vs_baseline": N}
+
+Measured config mirrors the reference's mixed-precision chairs recipe
+(train_mixed.sh:3: batch 8, crop 368x496, 12 refinement iterations,
+bf16 compute) — the primary metric named in BASELINE.json.
+
+Baseline: the reference repo publishes no numbers (BASELINE.md).  The
+denominator used here is 7.0 pairs/s — an A100 estimate derived from the
+RAFT paper's training-time claim (chairs 100k steps, batch 10, ~10 h on
+two 2080 Ti => ~2.8 pairs/s/GPU, scaled by the ~2.5x A100/2080Ti training
+speedup).  vs_baseline = measured / 7.0, so 2.0 meets the north-star
+"2x A100 pairs/sec/chip" target.
+"""
+
+import json
+import time
+
+import numpy as np
+
+A100_BASELINE_PAIRS_PER_S = 7.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.training import create_train_state, make_optimizer
+    from raft_tpu.training.step import make_train_step
+
+    B, H, W = 8, 368, 496
+    iters = 12
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32)),
+        "image2": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32)),
+        "flow": jnp.asarray((rng.standard_normal((B, H, W, 2)) * 5).astype(np.float32)),
+        "valid": jnp.ones((B, H, W), np.float32),
+    }
+
+    cfg = RAFTConfig(small=False, compute_dtype="bfloat16", remat=True)
+    model = RAFT(cfg)
+    tx, _ = make_optimizer(lr=4e-4, num_steps=1000, wdecay=1e-4)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=iters)
+    step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0)
+
+    # warmup / compile
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    pairs_per_s = B * n_steps / dt
+    print(json.dumps({
+        "metric": "image-pairs/sec/chip",
+        "value": round(pairs_per_s, 3),
+        "unit": "pairs/s",
+        "vs_baseline": round(pairs_per_s / A100_BASELINE_PAIRS_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,10 @@ class RAFTConfig:
     # Rematerialize each refinement step in the backward pass (trade FLOPs
     # for activation memory across the scan).
     remat: bool = False
+    # Shard the correlation volume's H1*W1 query axis over the mesh's
+    # 'spatial' axis (high-res configs where the O((HW)^2) volume exceeds
+    # one chip's HBM).  No-op without an active mesh.
+    corr_shard: bool = False
 
     @property
     def hidden_dim(self) -> int:

@@ -1,0 +1,29 @@
+from raft_tpu.data.frame_utils import (
+    read_flow,
+    write_flow,
+    read_pfm,
+    read_flow_kitti,
+    write_flow_kitti,
+    read_disp_kitti,
+    read_gen,
+)
+from raft_tpu.data.flow_viz import flow_to_image
+from raft_tpu.data.augmentor import FlowAugmentor, SparseFlowAugmentor
+from raft_tpu.data.datasets import (
+    FlowDataset,
+    FlyingChairs,
+    FlyingThings3D,
+    MpiSintel,
+    KITTI,
+    HD1K,
+    fetch_dataset,
+)
+from raft_tpu.data.loader import DataLoader
+
+__all__ = [
+    "read_flow", "write_flow", "read_pfm", "read_flow_kitti",
+    "write_flow_kitti", "read_disp_kitti", "read_gen", "flow_to_image",
+    "FlowAugmentor", "SparseFlowAugmentor", "FlowDataset", "FlyingChairs",
+    "FlyingThings3D", "MpiSintel", "KITTI", "HD1K", "fetch_dataset",
+    "DataLoader",
+]

@@ -1,0 +1,279 @@
+"""Host-side data augmentation (numpy/cv2, branchy and size-dynamic by
+design — this never enters XLA).
+
+Behavioral parity with core/utils/augmentor.py:15-246:
+
+- FlowAugmentor (dense GT): photometric jitter (asymmetric with prob 0.2),
+  occlusion eraser on img2, random scale 2^U(min,max) with independent x/y
+  stretch, h/v flips, random crop.
+- SparseFlowAugmentor (KITTI/HD1K): symmetric photometric only, sparse-
+  flow-aware resize by coordinate scatter, h-flip only, margin-biased crop.
+
+Differences by design:
+- explicit ``np.random.Generator`` instead of global numpy state, so worker
+  pipelines are reproducible per seed;
+- color jitter is implemented directly in numpy/cv2 (brightness/contrast/
+  saturation/hue in random order, torchvision-ColorJitter-style factors)
+  rather than through PIL round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import cv2
+import numpy as np
+
+cv2.setNumThreads(0)
+cv2.ocl.setUseOpenCL(False)
+
+
+def _apply_brightness(img: np.ndarray, f: float) -> np.ndarray:
+    return np.clip(img * f, 0, 255)
+
+
+def _apply_contrast(img: np.ndarray, f: float) -> np.ndarray:
+    gray_mean = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+                 + 0.114 * img[..., 2]).mean()
+    return np.clip(gray_mean + f * (img - gray_mean), 0, 255)
+
+
+def _apply_saturation(img: np.ndarray, f: float) -> np.ndarray:
+    gray = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+            + 0.114 * img[..., 2])[..., None]
+    return np.clip(gray + f * (img - gray), 0, 255)
+
+
+def _apply_hue(img: np.ndarray, shift: float) -> np.ndarray:
+    """shift in [-0.5, 0.5] turns of the hue circle."""
+    hsv = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_RGB2HSV)
+    h = hsv[..., 0].astype(np.int32)  # cv2 hue range: [0, 180)
+    hsv[..., 0] = ((h + int(round(shift * 180))) % 180).astype(hsv.dtype)
+    return cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB).astype(np.float32)
+
+
+class ColorJitter:
+    """torchvision-ColorJitter-compatible sampling: each factor drawn
+    uniformly, the four ops applied in random order."""
+
+    def __init__(self, brightness: float, contrast: float, saturation: float,
+                 hue: float):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def __call__(self, img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        img = img.astype(np.float32)
+        ops = []
+        b = rng.uniform(max(0, 1 - self.brightness), 1 + self.brightness)
+        c = rng.uniform(max(0, 1 - self.contrast), 1 + self.contrast)
+        s = rng.uniform(max(0, 1 - self.saturation), 1 + self.saturation)
+        h = rng.uniform(-self.hue, self.hue)
+        ops = [lambda x: _apply_brightness(x, b),
+               lambda x: _apply_contrast(x, c),
+               lambda x: _apply_saturation(x, s),
+               lambda x: _apply_hue(x, h)]
+        for i in rng.permutation(4):
+            img = ops[i](img)
+        return img.astype(np.uint8)
+
+
+class FlowAugmentor:
+    """Dense-ground-truth augmentor (augmentor.py:15-120)."""
+
+    def __init__(self, crop_size: Tuple[int, int], min_scale: float = -0.2,
+                 max_scale: float = 0.5, do_flip: bool = True,
+                 seed: Optional[int] = None):
+        self.crop_size = crop_size
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.spatial_aug_prob = 0.8
+        self.stretch_prob = 0.8
+        self.max_stretch = 0.2
+        self.do_flip = do_flip
+        self.h_flip_prob = 0.5
+        self.v_flip_prob = 0.1
+        self.photo_aug = ColorJitter(0.4, 0.4, 0.4, 0.5 / 3.14)
+        self.asymmetric_color_aug_prob = 0.2
+        self.eraser_aug_prob = 0.5
+        self.rng = np.random.default_rng(seed)
+
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+
+    def color_transform(self, img1, img2):
+        if self.rng.random() < self.asymmetric_color_aug_prob:
+            return self.photo_aug(img1, self.rng), self.photo_aug(img2, self.rng)
+        stack = np.concatenate([img1, img2], axis=0)
+        stack = self.photo_aug(stack, self.rng)
+        i1, i2 = np.split(stack, 2, axis=0)
+        return i1, i2
+
+    def eraser_transform(self, img1, img2, bounds=(50, 100)):
+        ht, wd = img1.shape[:2]
+        if self.rng.random() < self.eraser_aug_prob:
+            img2 = img2.copy()
+            mean_color = img2.reshape(-1, 3).mean(axis=0)
+            for _ in range(self.rng.integers(1, 3)):
+                x0 = self.rng.integers(0, wd)
+                y0 = self.rng.integers(0, ht)
+                dx = self.rng.integers(bounds[0], bounds[1])
+                dy = self.rng.integers(bounds[0], bounds[1])
+                img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
+        return img1, img2
+
+    def spatial_transform(self, img1, img2, flow):
+        ht, wd = img1.shape[:2]
+        min_scale = max((self.crop_size[0] + 8) / float(ht),
+                        (self.crop_size[1] + 8) / float(wd))
+
+        scale = 2 ** self.rng.uniform(self.min_scale, self.max_scale)
+        scale_x = scale_y = scale
+        if self.rng.random() < self.stretch_prob:
+            scale_x *= 2 ** self.rng.uniform(-self.max_stretch, self.max_stretch)
+            scale_y *= 2 ** self.rng.uniform(-self.max_stretch, self.max_stretch)
+        scale_x = max(scale_x, min_scale)
+        scale_y = max(scale_y, min_scale)
+
+        if self.rng.random() < self.spatial_aug_prob:
+            img1 = cv2.resize(img1, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            img2 = cv2.resize(img2, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            flow = cv2.resize(flow, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            flow = flow * [scale_x, scale_y]
+
+        if self.do_flip:
+            if self.rng.random() < self.h_flip_prob:
+                img1 = img1[:, ::-1]
+                img2 = img2[:, ::-1]
+                flow = flow[:, ::-1] * [-1.0, 1.0]
+            if self.rng.random() < self.v_flip_prob:
+                img1 = img1[::-1, :]
+                img2 = img2[::-1, :]
+                flow = flow[::-1, :] * [1.0, -1.0]
+
+        y0 = self.rng.integers(0, img1.shape[0] - self.crop_size[0])
+        x0 = self.rng.integers(0, img1.shape[1] - self.crop_size[1])
+        img1 = img1[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        img2 = img2[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        flow = flow[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        return img1, img2, flow
+
+    def __call__(self, img1, img2, flow):
+        img1, img2 = self.color_transform(img1, img2)
+        img1, img2 = self.eraser_transform(img1, img2)
+        img1, img2, flow = self.spatial_transform(img1, img2, flow)
+        return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
+                np.ascontiguousarray(flow))
+
+
+class SparseFlowAugmentor:
+    """Sparse-ground-truth augmentor for KITTI/HD1K (augmentor.py:122-246)."""
+
+    def __init__(self, crop_size: Tuple[int, int], min_scale: float = -0.2,
+                 max_scale: float = 0.5, do_flip: bool = False,
+                 seed: Optional[int] = None):
+        self.crop_size = crop_size
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.spatial_aug_prob = 0.8
+        self.do_flip = do_flip
+        self.h_flip_prob = 0.5
+        self.photo_aug = ColorJitter(0.3, 0.3, 0.3, 0.3 / 3.14)
+        self.eraser_aug_prob = 0.5
+        self.rng = np.random.default_rng(seed)
+
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+
+    def color_transform(self, img1, img2):
+        stack = np.concatenate([img1, img2], axis=0)
+        stack = self.photo_aug(stack, self.rng)
+        i1, i2 = np.split(stack, 2, axis=0)
+        return i1, i2
+
+    def eraser_transform(self, img1, img2):
+        ht, wd = img1.shape[:2]
+        if self.rng.random() < self.eraser_aug_prob:
+            img2 = img2.copy()
+            mean_color = img2.reshape(-1, 3).mean(axis=0)
+            for _ in range(self.rng.integers(1, 3)):
+                x0 = self.rng.integers(0, wd)
+                y0 = self.rng.integers(0, ht)
+                dx = self.rng.integers(50, 100)
+                dy = self.rng.integers(50, 100)
+                img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
+        return img1, img2
+
+    @staticmethod
+    def resize_sparse_flow_map(flow, valid, fx=1.0, fy=1.0):
+        """Scatter valid flow vectors onto the rescaled grid — linear
+        interpolation would bleed invalid pixels (augmentor.py:161-193)."""
+        ht, wd = flow.shape[:2]
+        xx, yy = np.meshgrid(np.arange(wd), np.arange(ht))
+        coords = np.stack([xx, yy], axis=-1).reshape(-1, 2).astype(np.float32)
+        flow_flat = flow.reshape(-1, 2).astype(np.float32)
+        valid_flat = valid.reshape(-1) >= 1
+
+        coords0 = coords[valid_flat]
+        flow0 = flow_flat[valid_flat]
+
+        ht1 = int(round(ht * fy))
+        wd1 = int(round(wd * fx))
+        coords1 = coords0 * [fx, fy]
+        flow1 = flow0 * [fx, fy]
+
+        xi = np.round(coords1[:, 0]).astype(np.int32)
+        yi = np.round(coords1[:, 1]).astype(np.int32)
+        keep = (xi > 0) & (xi < wd1) & (yi > 0) & (yi < ht1)
+
+        flow_img = np.zeros([ht1, wd1, 2], np.float32)
+        valid_img = np.zeros([ht1, wd1], np.int32)
+        flow_img[yi[keep], xi[keep]] = flow1[keep]
+        valid_img[yi[keep], xi[keep]] = 1
+        return flow_img, valid_img
+
+    def spatial_transform(self, img1, img2, flow, valid):
+        ht, wd = img1.shape[:2]
+        min_scale = max((self.crop_size[0] + 1) / float(ht),
+                        (self.crop_size[1] + 1) / float(wd))
+        scale = 2 ** self.rng.uniform(self.min_scale, self.max_scale)
+        scale_x = scale_y = max(scale, min_scale)
+
+        if self.rng.random() < self.spatial_aug_prob:
+            img1 = cv2.resize(img1, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            img2 = cv2.resize(img2, None, fx=scale_x, fy=scale_y,
+                              interpolation=cv2.INTER_LINEAR)
+            flow, valid = self.resize_sparse_flow_map(flow, valid,
+                                                      fx=scale_x, fy=scale_y)
+
+        if self.do_flip and self.rng.random() < self.h_flip_prob:
+            img1 = img1[:, ::-1]
+            img2 = img2[:, ::-1]
+            flow = flow[:, ::-1] * [-1.0, 1.0]
+            valid = valid[:, ::-1]
+
+        margin_y, margin_x = 20, 50
+        y0 = self.rng.integers(0, img1.shape[0] - self.crop_size[0] + margin_y)
+        x0 = self.rng.integers(-margin_x,
+                               img1.shape[1] - self.crop_size[1] + margin_x)
+        y0 = int(np.clip(y0, 0, img1.shape[0] - self.crop_size[0]))
+        x0 = int(np.clip(x0, 0, img1.shape[1] - self.crop_size[1]))
+
+        img1 = img1[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        img2 = img2[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        flow = flow[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        valid = valid[y0:y0 + self.crop_size[0], x0:x0 + self.crop_size[1]]
+        return img1, img2, flow, valid
+
+    def __call__(self, img1, img2, flow, valid):
+        img1, img2 = self.color_transform(img1, img2)
+        img1, img2 = self.eraser_transform(img1, img2)
+        img1, img2, flow, valid = self.spatial_transform(img1, img2, flow,
+                                                         valid)
+        return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
+                np.ascontiguousarray(flow), np.ascontiguousarray(valid))

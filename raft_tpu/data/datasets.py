@@ -1,0 +1,293 @@
+"""Flow dataset index builders and stage mixtures.
+
+Parity targets: core/datasets.py:18-234.  Datasets here are plain Python
+index objects returning numpy NHWC sample dicts; batching/prefetch/device
+transfer live in loader.py.
+
+Improvements over the reference (documented deviations):
+- per-sample deterministic augmentation: the PRNG is derived from
+  (seed, epoch, index), so any worker schedule reproduces the same stream
+  (the reference reseeds per worker process, datasets.py:45-51);
+- the FlyingChairs split file path is explicit (the reference reads
+  'chairs_split.txt' from the CWD, datasets.py:129 — a known footgun);
+  a copy ships in raft_tpu/data/splits/.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import os.path as osp
+from glob import glob
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.data import frame_utils
+from raft_tpu.data.augmentor import FlowAugmentor, SparseFlowAugmentor
+
+SPLITS_DIR = osp.join(osp.dirname(__file__), "splits")
+
+
+class FlowDataset:
+    """Base dataset: image pair + dense or sparse flow (datasets.py:18-99)."""
+
+    def __init__(self, aug_params: Optional[dict] = None,
+                 sparse: bool = False, seed: int = 0):
+        self.sparse = sparse
+        self.seed = seed
+        self.epoch = 0
+        self.augmentor = None
+        if aug_params is not None:
+            cls = SparseFlowAugmentor if sparse else FlowAugmentor
+            self.augmentor = cls(**aug_params)
+        self.is_test = False
+        self.flow_list: List[str] = []
+        self.image_list: List[List[str]] = []
+        self.extra_info: List = []
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _load_image(self, path: str) -> np.ndarray:
+        img = np.array(frame_utils.read_gen(path)).astype(np.uint8)
+        if img.ndim == 2:  # grayscale -> 3 channels (datasets.py:67-73)
+            img = np.tile(img[..., None], (1, 1, 3))
+        else:
+            img = img[..., :3]
+        return img
+
+    def __getitem__(self, index) -> Dict[str, np.ndarray]:
+        if self.is_test:
+            img1 = self._load_image(self.image_list[index][0])
+            img2 = self._load_image(self.image_list[index][1])
+            return {"image1": img1.astype(np.float32),
+                    "image2": img2.astype(np.float32),
+                    "extra_info": self.extra_info[index]}
+
+        index = index % len(self.image_list)
+        valid = None
+        if self.sparse:
+            flow, valid = frame_utils.read_flow_kitti(self.flow_list[index])
+        else:
+            flow = frame_utils.read_gen(self.flow_list[index])
+        flow = np.array(flow).astype(np.float32)
+
+        img1 = self._load_image(self.image_list[index][0])
+        img2 = self._load_image(self.image_list[index][1])
+
+        if self.augmentor is not None:
+            # thread-safe deterministic stream: fresh rng per sample
+            aug = copy.copy(self.augmentor)
+            aug.reseed(abs(hash((self.seed, self.epoch, index))) % (2 ** 31))
+            if self.sparse:
+                img1, img2, flow, valid = aug(img1, img2, flow, valid)
+            else:
+                img1, img2, flow = aug(img1, img2, flow)
+
+        if valid is None:
+            # dense GT: valid where |flow| < 1000 (datasets.py:88)
+            valid = ((np.abs(flow[..., 0]) < 1000)
+                     & (np.abs(flow[..., 1]) < 1000))
+
+        return {"image1": np.ascontiguousarray(img1, np.float32),
+                "image2": np.ascontiguousarray(img2, np.float32),
+                "flow": np.ascontiguousarray(flow, np.float32),
+                "valid": np.ascontiguousarray(valid, np.float32)}
+
+    def __rmul__(self, v: int) -> "CombinedDataset":
+        return CombinedDataset([(self, v)])
+
+    def __add__(self, other) -> "CombinedDataset":
+        return CombinedDataset([(self, 1)]) + other
+
+    def __len__(self) -> int:
+        return len(self.image_list)
+
+
+class CombinedDataset:
+    """Concatenation with integer oversampling (datasets.py:93-96 __rmul__;
+    index-composed instead of materialized)."""
+
+    def __init__(self, parts: Sequence[Tuple[FlowDataset, int]]):
+        self.parts = list(parts)
+
+    def __add__(self, other) -> "CombinedDataset":
+        if isinstance(other, CombinedDataset):
+            return CombinedDataset(self.parts + other.parts)
+        return CombinedDataset(self.parts + [(other, 1)])
+
+    def __rmul__(self, v: int) -> "CombinedDataset":
+        return CombinedDataset([(d, c * v) for d, c in self.parts])
+
+    def set_epoch(self, epoch: int) -> None:
+        for d, _ in self.parts:
+            d.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return sum(len(d) * c for d, c in self.parts)
+
+    def __getitem__(self, index):
+        for d, c in self.parts:
+            n = len(d) * c
+            if index < n:
+                return d[index % len(d)]
+            index -= n
+        raise IndexError(index)
+
+
+class MpiSintel(FlowDataset):
+    """root/{split}/{dstype}/{scene}/*.png + root/{split}/flow/{scene}/*.flo
+    (datasets.py:102-118)."""
+
+    def __init__(self, aug_params=None, split="training",
+                 root="datasets/Sintel", dstype="clean", seed: int = 0):
+        super().__init__(aug_params, seed=seed)
+        flow_root = osp.join(root, split, "flow")
+        image_root = osp.join(root, split, dstype)
+        if split == "test":
+            self.is_test = True
+
+        for scene in sorted(os.listdir(image_root)):
+            images = sorted(glob(osp.join(image_root, scene, "*.png")))
+            for i in range(len(images) - 1):
+                self.image_list.append([images[i], images[i + 1]])
+                self.extra_info.append((scene, i))
+            if split != "test":
+                self.flow_list += sorted(glob(osp.join(flow_root, scene,
+                                                       "*.flo")))
+
+
+class FlyingChairs(FlowDataset):
+    """Paired *.ppm + *.flo with a 1/2 train/val split list
+    (datasets.py:121-134)."""
+
+    def __init__(self, aug_params=None, split="train",
+                 root="datasets/FlyingChairs_release/data",
+                 split_file: Optional[str] = None, seed: int = 0):
+        super().__init__(aug_params, seed=seed)
+        images = sorted(glob(osp.join(root, "*.ppm")))
+        flows = sorted(glob(osp.join(root, "*.flo")))
+        assert len(images) // 2 == len(flows), (len(images), len(flows))
+
+        if split_file is None:
+            split_file = osp.join(SPLITS_DIR, "chairs_split.txt")
+        split_list = np.loadtxt(split_file, dtype=np.int32)
+        for i in range(len(flows)):
+            xid = split_list[i]
+            if (split == "training" and xid == 1) or \
+               (split == "validation" and xid == 2):
+                self.flow_list.append(flows[i])
+                self.image_list.append([images[2 * i], images[2 * i + 1]])
+
+
+class FlyingThings3D(FlowDataset):
+    """TRAIN split, left camera, into_future + into_past directions
+    (datasets.py:137-158)."""
+
+    def __init__(self, aug_params=None, root="datasets/FlyingThings3D",
+                 dstype="frames_cleanpass", seed: int = 0):
+        super().__init__(aug_params, seed=seed)
+        for cam in ["left"]:
+            for direction in ["into_future", "into_past"]:
+                image_dirs = sorted(glob(osp.join(root, dstype, "TRAIN/*/*")))
+                image_dirs = sorted(osp.join(f, cam) for f in image_dirs)
+                flow_dirs = sorted(glob(osp.join(root,
+                                                 "optical_flow/TRAIN/*/*")))
+                flow_dirs = sorted(osp.join(f, direction, cam)
+                                   for f in flow_dirs)
+                for idir, fdir in zip(image_dirs, flow_dirs):
+                    images = sorted(glob(osp.join(idir, "*.png")))
+                    flows = sorted(glob(osp.join(fdir, "*.pfm")))
+                    for i in range(len(flows) - 1):
+                        if direction == "into_future":
+                            self.image_list.append([images[i], images[i + 1]])
+                            self.flow_list.append(flows[i])
+                        else:
+                            self.image_list.append([images[i + 1], images[i]])
+                            self.flow_list.append(flows[i + 1])
+
+
+class KITTI(FlowDataset):
+    """image_2/*_10.png,*_11.png pairs with sparse flow_occ GT
+    (datasets.py:161-177)."""
+
+    def __init__(self, aug_params=None, split="training",
+                 root="datasets/KITTI", seed: int = 0):
+        super().__init__(aug_params, sparse=True, seed=seed)
+        if split == "testing":
+            self.is_test = True
+        root = osp.join(root, split)
+        images1 = sorted(glob(osp.join(root, "image_2/*_10.png")))
+        images2 = sorted(glob(osp.join(root, "image_2/*_11.png")))
+        for img1, img2 in zip(images1, images2):
+            self.extra_info.append([osp.basename(img1)])
+            self.image_list.append([img1, img2])
+        if split == "training":
+            self.flow_list = sorted(glob(osp.join(root, "flow_occ/*_10.png")))
+
+
+class HD1K(FlowDataset):
+    """Sequential frames with sparse GT (datasets.py:180-196)."""
+
+    def __init__(self, aug_params=None, root="datasets/HD1k", seed: int = 0):
+        super().__init__(aug_params, sparse=True, seed=seed)
+        seq_ix = 0
+        while True:
+            flows = sorted(glob(osp.join(root, "hd1k_flow_gt",
+                                         "flow_occ/%06d_*.png" % seq_ix)))
+            images = sorted(glob(osp.join(root, "hd1k_input",
+                                          "image_2/%06d_*.png" % seq_ix)))
+            if len(flows) == 0:
+                break
+            for i in range(len(flows) - 1):
+                self.flow_list.append(flows[i])
+                self.image_list.append([images[i], images[i + 1]])
+            seq_ix += 1
+
+
+def fetch_dataset(stage: str, image_size, root: str = "datasets",
+                  train_ds: str = "C+T+K+S+H", seed: int = 0):
+    """Stage mixture construction (datasets.py:199-228).
+
+    chairs -> FlyingChairs;  things -> clean+final passes;
+    sintel -> 100*clean + 100*final + 200*kitti + 5*hd1k + things;
+    kitti -> sparse KITTI only.
+    """
+    crop = tuple(image_size)
+    if stage == "chairs":
+        aug = dict(crop_size=crop, min_scale=-0.1, max_scale=1.0, do_flip=True)
+        return FlyingChairs(aug, split="training",
+                            root=osp.join(root, "FlyingChairs_release/data"),
+                            seed=seed)
+    if stage == "things":
+        aug = dict(crop_size=crop, min_scale=-0.4, max_scale=0.8, do_flip=True)
+        t_root = osp.join(root, "FlyingThings3D")
+        return (FlyingThings3D(aug, root=t_root, dstype="frames_cleanpass",
+                               seed=seed)
+                + FlyingThings3D(aug, root=t_root, dstype="frames_finalpass",
+                                 seed=seed))
+    if stage == "sintel":
+        aug = dict(crop_size=crop, min_scale=-0.2, max_scale=0.6, do_flip=True)
+        things = FlyingThings3D(aug, root=osp.join(root, "FlyingThings3D"),
+                                dstype="frames_cleanpass", seed=seed)
+        clean = MpiSintel(aug, split="training", dstype="clean",
+                          root=osp.join(root, "Sintel"), seed=seed)
+        final = MpiSintel(aug, split="training", dstype="final",
+                          root=osp.join(root, "Sintel"), seed=seed)
+        if train_ds == "C+T+K+S+H":
+            kitti = KITTI(dict(crop_size=crop, min_scale=-0.3, max_scale=0.5,
+                               do_flip=True),
+                          root=osp.join(root, "KITTI"), seed=seed)
+            hd1k = HD1K(dict(crop_size=crop, min_scale=-0.5, max_scale=0.2,
+                             do_flip=True),
+                        root=osp.join(root, "HD1k"), seed=seed)
+            return (100 * clean + 100 * final + 200 * kitti + 5 * hd1k
+                    + things)
+        return 100 * clean + 100 * final + things
+    if stage == "kitti":
+        aug = dict(crop_size=crop, min_scale=-0.2, max_scale=0.4,
+                   do_flip=False)
+        return KITTI(aug, split="training", root=osp.join(root, "KITTI"),
+                     seed=seed)
+    raise ValueError(f"unknown stage: {stage}")

@@ -1,0 +1,66 @@
+"""Optical-flow visualization with the standard Middlebury color wheel.
+
+Same output convention as core/utils/flow_viz.py:109-132 (based on the
+Baker et al. "A Database and Evaluation Methodology for Optical Flow"
+color coding): hue encodes direction, saturation encodes magnitude
+normalized by the maximum radius in the field.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _color_wheel() -> np.ndarray:
+    """55-entry RGB color wheel: RY(15) YG(6) GC(4) CB(11) BM(13) MR(6)."""
+    transitions = [
+        (15, (255, 0, 0), (255, 255, 0)),   # red -> yellow
+        (6, (255, 255, 0), (0, 255, 0)),    # yellow -> green
+        (4, (0, 255, 0), (0, 255, 255)),    # green -> cyan
+        (11, (0, 255, 255), (0, 0, 255)),   # cyan -> blue
+        (13, (0, 0, 255), (255, 0, 255)),   # blue -> magenta
+        (6, (255, 0, 255), (255, 0, 0)),    # magenta -> red
+    ]
+    rows = []
+    for n, c0, c1 in transitions:
+        t = np.arange(n)[:, None] / n
+        rows.append(np.asarray(c0)[None] * (1 - t) + np.asarray(c1)[None] * t)
+    return np.concatenate(rows, axis=0)  # (55, 3)
+
+
+_WHEEL = _color_wheel()
+
+
+def flow_uv_to_colors(u: np.ndarray, v: np.ndarray,
+                      convert_to_bgr: bool = False) -> np.ndarray:
+    """Map normalized (u, v) in the unit disk to wheel colors, uint8."""
+    ncols = _WHEEL.shape[0]
+    rad = np.sqrt(u ** 2 + v ** 2)
+    angle = np.arctan2(-v, -u) / np.pi          # [-1, 1]
+    fk = (angle + 1) / 2 * (ncols - 1)          # fractional wheel index
+    k0 = np.floor(fk).astype(np.int32)
+    k1 = (k0 + 1) % ncols
+    f = (fk - k0)[..., None]
+
+    col = (1 - f) * _WHEEL[k0] / 255.0 + f * _WHEEL[k1] / 255.0
+    in_disk = rad[..., None] <= 1
+    # inside the disk: desaturate toward white by (1 - rad); outside: dim 25%
+    col = np.where(in_disk, 1 - rad[..., None] * (1 - col), col * 0.75)
+    img = np.floor(255 * col).astype(np.uint8)
+    return img[..., ::-1] if convert_to_bgr else img
+
+
+def flow_to_image(flow_uv: np.ndarray, clip_flow: float = None,
+                  convert_to_bgr: bool = False) -> np.ndarray:
+    """(H, W, 2) flow -> (H, W, 3) uint8 visualization, normalized by the
+    field's maximum radius (flow_viz.py:109-132)."""
+    assert flow_uv.ndim == 3 and flow_uv.shape[2] == 2, flow_uv.shape
+    flow_uv = np.asarray(flow_uv, np.float32)
+    if clip_flow is not None:
+        flow_uv = np.clip(flow_uv, 0, clip_flow)
+    u, v = flow_uv[..., 0], flow_uv[..., 1]
+    rad_max = np.sqrt(u ** 2 + v ** 2).max()
+    eps = 1e-5
+    u = u / (rad_max + eps)
+    v = v / (rad_max + eps)
+    return flow_uv_to_colors(u, v, convert_to_bgr)

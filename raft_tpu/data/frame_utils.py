@@ -1,0 +1,109 @@
+"""Flow/image file I/O: Middlebury .flo, PFM, KITTI 16-bit PNG.
+
+Format parity with core/utils/frame_utils.py:12-137 (same magic numbers,
+encodings, and extension dispatch); implementation is plain numpy/cv2.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+FLO_MAGIC = 202021.25  # Middlebury sanity-check value (frame_utils.py:10)
+
+
+def read_flow(path: str) -> np.ndarray:
+    """Read a Middlebury .flo file -> (H, W, 2) float32."""
+    with open(path, "rb") as f:
+        magic = np.fromfile(f, np.float32, count=1)
+        if magic.size == 0 or magic[0] != FLO_MAGIC:
+            raise ValueError(f"{path}: bad .flo magic {magic}")
+        w = int(np.fromfile(f, np.int32, count=1)[0])
+        h = int(np.fromfile(f, np.int32, count=1)[0])
+        data = np.fromfile(f, np.float32, count=2 * w * h)
+    return data.reshape(h, w, 2)
+
+
+def write_flow(path: str, flow: np.ndarray) -> None:
+    """Write (H, W, 2) float32 flow as Middlebury .flo."""
+    flow = np.asarray(flow, dtype=np.float32)
+    h, w = flow.shape[:2]
+    with open(path, "wb") as f:
+        np.float32(FLO_MAGIC).tofile(f)
+        np.int32(w).tofile(f)
+        np.int32(h).tofile(f)
+        flow.tofile(f)
+
+
+def read_pfm(path: str) -> np.ndarray:
+    """Read a PFM file -> float32 array (H, W) or (H, W, 3), bottom-up
+    flipped to top-down (frame_utils.py:33-68 semantics)."""
+    with open(path, "rb") as f:
+        header = f.readline().rstrip()
+        if header == b"PF":
+            color = True
+        elif header == b"Pf":
+            color = False
+        else:
+            raise ValueError(f"{path}: not a PFM file")
+        dims = f.readline()
+        m = re.match(rb"^(\d+)\s(\d+)\s$", dims)
+        if not m:
+            raise ValueError(f"{path}: malformed PFM header")
+        w, h = map(int, m.groups())
+        scale = float(f.readline().rstrip())
+        endian = "<" if scale < 0 else ">"
+        data = np.fromfile(f, endian + "f")
+    shape = (h, w, 3) if color else (h, w)
+    return np.flipud(data.reshape(shape)).copy()
+
+
+def read_flow_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Read KITTI 16-bit PNG flow -> ((H, W, 2) float32, (H, W) valid).
+
+    Encoding: u16 = flow * 64 + 2^15; third channel is validity
+    (frame_utils.py:102-107).
+    """
+    import cv2
+
+    raw = cv2.imread(path, cv2.IMREAD_ANYDEPTH | cv2.IMREAD_COLOR)
+    raw = raw[:, :, ::-1].astype(np.float32)  # BGR -> RGB = (u, v, valid)
+    flow, valid = raw[:, :, :2], raw[:, :, 2]
+    flow = (flow - 2 ** 15) / 64.0
+    return flow, valid
+
+
+def write_flow_kitti(path: str, flow: np.ndarray) -> None:
+    import cv2
+
+    flow = 64.0 * np.asarray(flow, np.float64) + 2 ** 15
+    valid = np.ones((flow.shape[0], flow.shape[1], 1), flow.dtype)
+    out = np.concatenate([flow, valid], axis=-1).astype(np.uint16)
+    cv2.imwrite(path, out[..., ::-1])
+
+
+def read_disp_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    import cv2
+
+    disp = cv2.imread(path, cv2.IMREAD_ANYDEPTH) / 256.0
+    return disp, (disp > 0.0).astype(np.float32)
+
+
+def read_gen(path: str, pil: bool = False
+             ) -> Union[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
+    """Extension dispatch (frame_utils.py:123-137): images as PIL-compatible
+    arrays, .flo/.pfm as flow arrays."""
+    from PIL import Image
+
+    ext = os.path.splitext(path)[-1].lower()
+    if ext in (".png", ".jpeg", ".ppm", ".jpg"):
+        return Image.open(path)
+    if ext == ".flo":
+        return read_flow(path).astype(np.float32)
+    if ext == ".pfm":
+        flow = read_pfm(path).astype(np.float32)
+        return flow if flow.ndim == 2 else flow[:, :, :-1]
+    raise ValueError(f"unsupported extension: {path}")

@@ -1,0 +1,130 @@
+"""Threaded prefetching batch loader (replaces torch DataLoader,
+datasets.py:230-231).
+
+Pure numpy host pipeline: worker threads decode/augment samples (cv2 and
+numpy release the GIL for the heavy parts), whole batches are prefetched
+ahead, and ``prefetch_to_device`` overlaps host->HBM transfer with compute
+— the piece that keeps the TPU fed (SURVEY.md §7 hard-part #6).
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import itertools
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+def _stack_batch(samples) -> Dict[str, np.ndarray]:
+    out = {}
+    for key in samples[0]:
+        if key == "extra_info":
+            out[key] = [s[key] for s in samples]
+        else:
+            out[key] = np.stack([s[key] for s in samples])
+    return out
+
+
+class DataLoader:
+    """Shuffled, batched, threaded loader over a FlowDataset/CombinedDataset.
+
+    drop_last=True matches the reference (datasets.py:230); epoch-seeded
+    shuffling is deterministic given (seed, epoch).
+    """
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = True,
+                 num_workers: int = 4, drop_last: bool = True,
+                 seed: int = 0, prefetch: int = 2,
+                 pad_remainder: bool = False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.num_workers = max(num_workers, 1)
+        self.drop_last = drop_last
+        self.seed = seed
+        self.prefetch = max(prefetch, 1)
+        # pad_remainder: repeat-pad the final short batch up to batch_size
+        # (with a 'pad_mask' entry) so every batch divides a device mesh —
+        # needed when drop_last=False feeds a data-parallel step.
+        self.pad_remainder = pad_remainder
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _load_batch(self, idxs) -> Dict[str, np.ndarray]:
+        batch = _stack_batch([self.dataset[int(i)] for i in idxs])
+        n = len(idxs)
+        if self.pad_remainder and n < self.batch_size:
+            pad = self.batch_size - n
+            for k, v in list(batch.items()):
+                if isinstance(v, np.ndarray):
+                    reps = np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                    batch[k] = reps
+            mask = np.zeros(self.batch_size, np.float32)
+            mask[:n] = 1.0
+            batch["pad_mask"] = mask
+        return batch
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        n = len(self.dataset)
+        rng = np.random.default_rng((self.seed, self.epoch))
+        order = rng.permutation(n) if self.shuffle else np.arange(n)
+        stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
+        batches = [order[i:i + self.batch_size]
+                   for i in range(0, stop, self.batch_size)]
+
+        with concurrent.futures.ThreadPoolExecutor(self.num_workers) as ex:
+            pending = collections.deque()
+            batch_iter = iter(batches)
+            for idxs in itertools.islice(batch_iter, self.prefetch):
+                pending.append(ex.submit(self._load_batch, idxs))
+            while pending:
+                result = pending.popleft().result()
+                nxt = next(batch_iter, None)
+                if nxt is not None:
+                    pending.append(ex.submit(self._load_batch, nxt))
+                yield result
+
+    def epochs(self, start_epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        """Endless sample stream across epochs (the reference's
+        should_keep_training loop re-enters its loader, train.py:161-163)."""
+        for epoch in itertools.count(start_epoch):
+            self.set_epoch(epoch)
+            yield from self
+
+
+def prefetch_to_device(iterator, size: int = 2, sharding=None):
+    """Move batches to device ahead of compute.
+
+    With ``sharding`` (a jax.sharding.Sharding), batches land already laid
+    out for the mesh (data-parallel batch axis).
+    """
+    import jax
+
+    queue = collections.deque()
+
+    def _put(batch):
+        arrays = {k: v for k, v in batch.items() if isinstance(v, np.ndarray)}
+        rest = {k: v for k, v in batch.items() if not isinstance(v, np.ndarray)}
+        if sharding is not None:
+            placed = {k: jax.device_put(v, sharding) for k, v in arrays.items()}
+        else:
+            placed = {k: jax.device_put(v) for k, v in arrays.items()}
+        placed.update(rest)
+        return placed
+
+    for batch in iterator:
+        queue.append(_put(batch))
+        if len(queue) >= size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

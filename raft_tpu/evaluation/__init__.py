@@ -1,0 +1,17 @@
+from raft_tpu.evaluation.evaluate import (
+    Evaluator,
+    validate_chairs,
+    validate_sintel,
+    validate_kitti,
+    create_sintel_submission,
+    create_kitti_submission,
+)
+
+__all__ = [
+    "Evaluator",
+    "validate_chairs",
+    "validate_sintel",
+    "validate_kitti",
+    "create_sintel_submission",
+    "create_kitti_submission",
+]

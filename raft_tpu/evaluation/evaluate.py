@@ -1,0 +1,179 @@
+"""Validation and benchmark-submission harness.
+
+Parity targets: evaluate.py:21-166 — validate_chairs (iters=24),
+validate_sintel (iters=32, centered /8 padding), validate_kitti (iters=24,
+top padding, F1-all = epe>3 AND epe/mag>0.05), and the Sintel/KITTI
+submission writers including the warm-start flow propagation
+(evaluate.py:28-41).
+
+Known reference quirk handled: validate_sintel averages per-frame means of
+ragged arrays (evaluate.py:118-125); here EPE is the mean over all pixels
+(the epe_all statistics the reference also computes), which is the
+well-defined version (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.data import datasets, frame_utils
+from raft_tpu.ops import InputPadder, forward_interpolate
+
+
+class Evaluator:
+    """Shape-bucketed jitted forward for eval (batch=1, test_mode).
+
+    Eval-time inputs vary in size (KITTI especially), so the jitted forward
+    is cached per padded shape; each unique shape compiles once.
+    """
+
+    def __init__(self, model, variables):
+        self.model = model
+        self.variables = variables
+        self._cache: Dict = {}
+
+    def __call__(self, image1: np.ndarray, image2: np.ndarray, iters: int,
+                 flow_init: Optional[np.ndarray] = None):
+        warm = flow_init is not None
+        key = (image1.shape, iters, warm)
+        if key not in self._cache:
+            model = self.model
+            if warm:
+                fn = jax.jit(lambda v, a, b, f: model.apply(
+                    v, a, b, iters=iters, flow_init=f, test_mode=True))
+            else:
+                fn = jax.jit(lambda v, a, b: model.apply(
+                    v, a, b, iters=iters, test_mode=True))
+            self._cache[key] = fn
+        fn = self._cache[key]
+        if warm:
+            return fn(self.variables, image1, image2, flow_init)
+        return fn(self.variables, image1, image2)
+
+
+def validate_chairs(evaluator: Evaluator, root: str = "datasets",
+                    iters: int = 24) -> Dict[str, float]:
+    """FlyingChairs validation split EPE (evaluate.py:75-92)."""
+    ds = datasets.FlyingChairs(
+        None, split="validation",
+        root=os.path.join(root, "FlyingChairs_release/data"))
+    epes = []
+    for i in range(len(ds)):
+        s = ds[i]
+        img1 = s["image1"][None]
+        img2 = s["image2"][None]
+        _, flow_up = evaluator(img1, img2, iters)
+        epe = np.sqrt(((np.asarray(flow_up)[0] - s["flow"]) ** 2).sum(-1))
+        epes.append(epe.reshape(-1))
+    epe = float(np.concatenate(epes).mean())
+    print(f"Validation Chairs EPE: {epe:.3f}")
+    return {"chairs": epe}
+
+
+def validate_sintel(evaluator: Evaluator, root: str = "datasets",
+                    iters: int = 32) -> Dict[str, float]:
+    """Sintel-train clean+final EPE (evaluate.py:95-127)."""
+    results = {}
+    for dstype in ["clean", "final"]:
+        ds = datasets.MpiSintel(None, split="training", dstype=dstype,
+                                root=os.path.join(root, "Sintel"))
+        epes = []
+        for i in range(len(ds)):
+            s = ds[i]
+            padder = InputPadder(s["image1"][None].shape)
+            im1, im2 = padder.pad(jnp.asarray(s["image1"][None]),
+                                  jnp.asarray(s["image2"][None]))
+            _, flow_up = evaluator(np.asarray(im1), np.asarray(im2), iters)
+            flow = np.asarray(padder.unpad(flow_up))[0]
+            epe = np.sqrt(((flow - s["flow"]) ** 2).sum(-1))
+            epes.append(epe.reshape(-1))
+        epe_all = np.concatenate(epes)
+        results[dstype] = float(epe_all.mean())
+        print(f"Validation ({dstype}) EPE: {results[dstype]:.3f}, "
+              f"1px: {(epe_all < 1).mean():.3f}, "
+              f"3px: {(epe_all < 3).mean():.3f}, "
+              f"5px: {(epe_all < 5).mean():.3f}")
+    return results
+
+
+def validate_kitti(evaluator: Evaluator, root: str = "datasets",
+                   iters: int = 24) -> Dict[str, float]:
+    """KITTI-15 train EPE + F1-all (evaluate.py:130-166)."""
+    ds = datasets.KITTI(None, split="training",
+                        root=os.path.join(root, "KITTI"))
+    epe_list, out_list = [], []
+    for i in range(len(ds)):
+        s = ds[i]
+        padder = InputPadder(s["image1"][None].shape, mode="kitti")
+        im1, im2 = padder.pad(jnp.asarray(s["image1"][None]),
+                              jnp.asarray(s["image2"][None]))
+        _, flow_up = evaluator(np.asarray(im1), np.asarray(im2), iters)
+        flow = np.asarray(padder.unpad(flow_up))[0]
+
+        epe = np.sqrt(((flow - s["flow"]) ** 2).sum(-1))
+        mag = np.sqrt((s["flow"] ** 2).sum(-1))
+        valid = s["valid"] >= 0.5
+        out = ((epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05))
+        epe_list.append(epe[valid].mean())
+        out_list.append(out[valid])
+
+    epe = float(np.mean(epe_list))
+    f1 = 100.0 * float(np.concatenate(out_list).mean())
+    print(f"Validation KITTI: EPE {epe:.3f}, F1-all {f1:.2f}")
+    return {"kitti-epe": epe, "kitti-f1": f1}
+
+
+def create_sintel_submission(evaluator: Evaluator, root: str = "datasets",
+                             iters: int = 32, warm_start: bool = False,
+                             output_path: str = "sintel_submission") -> None:
+    """Write Sintel test-split .flo files; optional warm start carries the
+    low-res flow forward through each scene (evaluate.py:21-50)."""
+    for dstype in ["clean", "final"]:
+        ds = datasets.MpiSintel(None, split="test", dstype=dstype,
+                                root=os.path.join(root, "Sintel"))
+        flow_prev, sequence_prev = None, None
+        for i in range(len(ds)):
+            s = ds[i]
+            sequence, frame = s["extra_info"]
+            if sequence != sequence_prev:
+                flow_prev = None
+
+            padder = InputPadder(s["image1"][None].shape)
+            im1, im2 = padder.pad(jnp.asarray(s["image1"][None]),
+                                  jnp.asarray(s["image2"][None]))
+            flow_low, flow_up = evaluator(np.asarray(im1), np.asarray(im2),
+                                          iters, flow_init=flow_prev)
+            flow = np.asarray(padder.unpad(flow_up))[0]
+
+            if warm_start:
+                flow_prev = forward_interpolate(np.asarray(flow_low)[0])[None]
+
+            out_dir = os.path.join(output_path, dstype, sequence)
+            os.makedirs(out_dir, exist_ok=True)
+            frame_utils.write_flow(
+                os.path.join(out_dir, f"frame{frame + 1:04d}.flo"), flow)
+            sequence_prev = sequence
+
+
+def create_kitti_submission(evaluator: Evaluator, root: str = "datasets",
+                            iters: int = 24,
+                            output_path: str = "kitti_submission") -> None:
+    """Write KITTI test-split 16-bit PNGs (evaluate.py:53-71)."""
+    ds = datasets.KITTI(None, split="testing",
+                        root=os.path.join(root, "KITTI"))
+    os.makedirs(output_path, exist_ok=True)
+    for i in range(len(ds)):
+        s = ds[i]
+        (frame_id,) = s["extra_info"]
+        padder = InputPadder(s["image1"][None].shape, mode="kitti")
+        im1, im2 = padder.pad(jnp.asarray(s["image1"][None]),
+                              jnp.asarray(s["image2"][None]))
+        _, flow_up = evaluator(np.asarray(im1), np.asarray(im2), iters)
+        flow = np.asarray(padder.unpad(flow_up))[0]
+        frame_utils.write_flow_kitti(os.path.join(output_path, frame_id),
+                                     flow)

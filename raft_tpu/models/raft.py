@@ -25,8 +25,10 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from raft_tpu.config import RAFTConfig
+from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
 from raft_tpu.ops.corr import (
@@ -62,7 +64,8 @@ class RefinementStep(nn.Module):
             corr = alternate_corr_lookup(fmap1, fmap2_pyr, coords1,
                                          cfg.corr_radius)
         else:
-            corr = corr_lookup(corr_state, coords1, cfg.corr_radius)
+            corr = corr_lookup(corr_state, coords1, cfg.corr_radius,
+                               shard=cfg.corr_shard)
 
         flow = coords1 - coords0
         corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1) ** 2
@@ -129,7 +132,14 @@ class RAFT(nn.Module):
                                                           cfg.corr_levels)))
         else:
             vol = all_pairs_correlation(fmap1, fmap2)
-            corr_state = tuple(build_corr_pyramid(vol, cfg.corr_levels))
+            pyramid = build_corr_pyramid(vol, cfg.corr_levels)
+            if cfg.corr_shard:
+                # batch stays sharded over 'data'; the H1*W1 query axis
+                # shards over 'spatial' (each device holds all of fmap2's
+                # targets for its slice of query pixels)
+                pyramid = [constrain(p, P(DATA_AXIS, SPATIAL_AXIS, None, None))
+                           for p in pyramid]
+            corr_state = tuple(pyramid)
 
         # Context network on image1 only; split into GRU state + input.
         ctx = cnet(image1.astype(dtype))

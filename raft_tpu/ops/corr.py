@@ -86,7 +86,7 @@ def _window_offsets(radius: int, dtype=jnp.float32) -> jax.Array:
 
 
 def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
-                radius: int) -> jax.Array:
+                radius: int, shard: bool = False) -> jax.Array:
     """Gather bilinear correlation windows at each pyramid level
     (core/corr.py:29-50).
 
@@ -94,6 +94,9 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
       pyramid: list of (B, Q, H_l, W_l) volumes, Q = H1*W1.
       coords: (B, H1, W1, 2) query coordinates at level 0, (x, y).
       radius: window radius r.
+      shard: re-pin the (batch, query)-axis mesh sharding through the
+        B*Q reshape (which would otherwise drop GSPMD's annotation inside
+        the refinement scan).  No-op without an active mesh.
 
     Returns:
       (B, H1, W1, L*(2r+1)^2) float32, levels concatenated level-major.
@@ -106,6 +109,14 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
         centroid = coords.reshape(B * Q, 1, 2) / (2.0 ** i)
         coords_lvl = centroid + offsets[None]  # (B*Q, K, 2)
         img = corr.reshape(B * Q, corr.shape[2], corr.shape[3], 1)
+        if shard:
+            from jax.sharding import PartitionSpec as P
+            from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
+            # merged B*Q axis: batch-major outer, query inner — expressible
+            # as a compound-axis sharding
+            img = constrain(img, P((DATA_AXIS, SPATIAL_AXIS), None, None, None))
+            coords_lvl = constrain(
+                coords_lvl, P((DATA_AXIS, SPATIAL_AXIS), None, None))
         sampled = bilinear_sample(img, coords_lvl)  # (B*Q, K, 1)
         out.append(sampled.reshape(B, H1, W1, -1))
     return jnp.concatenate(out, axis=-1).astype(jnp.float32)

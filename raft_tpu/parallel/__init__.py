@@ -1,0 +1,19 @@
+from raft_tpu.parallel.mesh import (
+    make_mesh,
+    batch_spec,
+    replicated_spec,
+    shard_batch,
+    constrain,
+)
+from raft_tpu.parallel.step import make_parallel_train_step
+from raft_tpu.parallel.dist import initialize_distributed
+
+__all__ = [
+    "make_mesh",
+    "batch_spec",
+    "replicated_spec",
+    "shard_batch",
+    "constrain",
+    "make_parallel_train_step",
+    "initialize_distributed",
+]

@@ -1,0 +1,35 @@
+"""Multi-host initialization.
+
+The reference has no distributed backend at all (no NCCL/Gloo/MPI process
+groups — SURVEY.md §2.3); scaling stops at single-process DataParallel.
+Here multi-host is jax.distributed: one process per host, XLA collectives
+over ICI within a slice and DCN across slices, with the same mesh code
+driving 1 chip or a pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Initialize jax.distributed when running multi-host.
+
+    No-ops on single-host (the common dev path).  On TPU pods the runtime
+    autodetects everything; explicit args support CPU/GPU fleets.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        return  # already initialized
+    if coordinator_address is None and "COORDINATOR_ADDRESS" in os.environ:
+        coordinator_address = os.environ["COORDINATOR_ADDRESS"]
+    if coordinator_address is None and num_processes is None:
+        # single host — nothing to do
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)

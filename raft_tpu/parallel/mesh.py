@@ -1,0 +1,76 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's only parallelism is single-process torch DataParallel
+(train.py:138) — replicate the module, scatter the batch, gather outputs.
+The TPU-native replacement is SPMD: one jitted program, arrays annotated
+with shardings over a named mesh, XLA inserting the collectives (psum for
+gradients) over ICI.
+
+Axes:
+- ``data``:    batch sharding (pure data parallelism);
+- ``spatial``: shards the H1*W1 query axis of the correlation volume for
+  high-res configs where the O((HW)^2) volume exceeds one chip's HBM
+  (BASELINE.json config 5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+
+
+def make_mesh(data: int = -1, spatial: int = 1,
+              devices=None) -> Mesh:
+    """Build a (data, spatial) mesh.  data=-1 uses all remaining devices.
+
+    Axis order puts ``spatial`` innermost so its collectives ride
+    neighboring ICI links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if data == -1:
+        assert n % spatial == 0, (n, spatial)
+        data = n // spatial
+    assert data * spatial <= n, (data, spatial, n)
+    mesh_devices = np.asarray(devices[: data * spatial]).reshape(data, spatial)
+    return Mesh(mesh_devices, (DATA_AXIS, SPATIAL_AXIS),
+                axis_types=(AxisType.Auto, AxisType.Auto))
+
+
+def batch_spec() -> P:
+    """Batch-axis sharding spec for NHWC inputs."""
+    return P(DATA_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def shard_batch(batch: Dict, mesh: Mesh) -> Dict:
+    """Place a host batch onto the mesh, batch axis sharded over ``data``."""
+    sharding = NamedSharding(mesh, batch_spec())
+    return {k: jax.device_put(v, sharding) if hasattr(v, "shape") else v
+            for k, v in batch.items()}
+
+
+def constrain(x: jax.Array, spec: P) -> jax.Array:
+    """with_sharding_constraint that is a no-op without a mesh context.
+
+    Lets model-internal sharding hints (e.g. the corr-volume query axis)
+    stay in the code path unconditionally; they only bind when the caller
+    runs under ``jax.set_mesh(mesh)``.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    if any(ax is not None and ax not in mesh.axis_names
+           for ax in jax.tree.leaves(tuple(spec))):
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)

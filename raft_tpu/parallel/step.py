@@ -1,0 +1,49 @@
+"""Sharded training step.
+
+"Computation follows data": the same jitted train step as
+training/step.py, with the TrainState replicated and the batch sharded
+over the ``data`` mesh axis.  XLA turns the parameter gradients into
+psum all-reduces over ICI automatically — the SPMD replacement for
+DataParallel's scatter/replicate/gather (train.py:138).
+
+Running under ``jax.set_mesh`` also binds the model-internal sharding
+constraints (corr-volume query axis over ``spatial``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.training.state import TrainState
+from raft_tpu.training.step import make_train_step
+from raft_tpu.parallel.mesh import batch_spec
+
+
+def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place every state leaf replicated across the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+
+
+def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
+                             max_flow: float, freeze_bn: bool = False,
+                             add_noise: bool = False):
+    """Build the mesh-aware train step.
+
+    Usage:
+        state = replicate_state(state, mesh)
+        step = make_parallel_train_step(model, mesh, ...)
+        for batch in loader:
+            state, metrics = step(state, shard_batch(batch, mesh))
+    """
+    base = make_train_step(model, iters=iters, gamma=gamma, max_flow=max_flow,
+                           freeze_bn=freeze_bn, add_noise=add_noise)
+
+    def step(state: TrainState, batch: Dict):
+        with jax.set_mesh(mesh):
+            return base(state, batch)
+
+    return step

@@ -1,0 +1,285 @@
+"""Data-layer tests: file I/O round-trips, augmentor invariants, dataset
+directory-layout parsing for all five dataset families, mixture weighting,
+loader determinism."""
+
+import os
+import os.path as osp
+
+import numpy as np
+import pytest
+
+from raft_tpu.data import (
+    DataLoader,
+    FlowAugmentor,
+    FlyingChairs,
+    FlyingThings3D,
+    HD1K,
+    KITTI,
+    MpiSintel,
+    SparseFlowAugmentor,
+    fetch_dataset,
+    flow_to_image,
+    read_flow,
+    read_flow_kitti,
+    read_gen,
+    read_pfm,
+    write_flow,
+    write_flow_kitti,
+)
+
+RNG = np.random.default_rng(5)
+
+
+# ---------------------------------------------------------------- file I/O
+
+def test_flo_roundtrip(tmp_path):
+    flow = RNG.standard_normal((13, 17, 2)).astype(np.float32) * 10
+    p = str(tmp_path / "x.flo")
+    write_flow(p, flow)
+    np.testing.assert_array_equal(read_flow(p), flow)
+    np.testing.assert_array_equal(np.asarray(read_gen(p)), flow)
+
+
+def test_flo_rejects_bad_magic(tmp_path):
+    p = str(tmp_path / "bad.flo")
+    with open(p, "wb") as f:
+        f.write(b"\x00" * 32)
+    with pytest.raises(ValueError, match="magic"):
+        read_flow(p)
+
+
+def test_kitti_png_roundtrip(tmp_path):
+    flow = (RNG.standard_normal((10, 12, 2)) * 30).astype(np.float32)
+    p = str(tmp_path / "f.png")
+    write_flow_kitti(p, flow)
+    back, valid = read_flow_kitti(p)
+    np.testing.assert_allclose(back, flow, atol=1 / 64)  # u16 quantization
+    assert (valid == 1).all()
+
+
+def test_pfm_read(tmp_path):
+    """Write a little-endian single-channel PFM by hand and read it."""
+    data = RNG.standard_normal((6, 8)).astype("<f4")
+    p = str(tmp_path / "x.pfm")
+    with open(p, "wb") as f:
+        f.write(b"Pf\n8 6\n-1.0\n")
+        np.flipud(data).tofile(f)
+    np.testing.assert_allclose(read_pfm(p), data, rtol=1e-6)
+
+
+def test_flow_viz():
+    flow = np.zeros((8, 8, 2), np.float32)
+    flow[:4, :, 0] = 5.0   # rightward
+    flow[4:, 1] = -5.0
+    img = flow_to_image(flow)
+    assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+    # zero flow (unit-disk center) renders ~white
+    assert (img[6, 6] > 200).all()
+
+
+# --------------------------------------------------------------- augmentor
+
+def test_dense_augmentor_shapes_and_determinism():
+    img1 = RNG.integers(0, 255, (120, 160, 3), dtype=np.uint8)
+    img2 = RNG.integers(0, 255, (120, 160, 3), dtype=np.uint8)
+    flow = RNG.standard_normal((120, 160, 2)).astype(np.float32)
+
+    aug = FlowAugmentor(crop_size=(96, 128), seed=3)
+    a1, a2, af = aug(img1, img2, flow)
+    assert a1.shape == (96, 128, 3) and af.shape == (96, 128, 2)
+    assert a1.dtype == np.uint8
+
+    aug.reseed(3)
+    b1, b2, bf = aug(img1, img2, flow)
+    np.testing.assert_array_equal(a1, b1)
+    np.testing.assert_array_equal(af, bf)
+
+
+def test_sparse_augmentor_preserves_valid_semantics():
+    img1 = RNG.integers(0, 255, (120, 160, 3), dtype=np.uint8)
+    img2 = RNG.integers(0, 255, (120, 160, 3), dtype=np.uint8)
+    flow = np.zeros((120, 160, 2), np.float32)
+    flow[..., 0] = 4.0
+    valid = np.zeros((120, 160), np.float32)
+    valid[30:90, 40:120] = 1
+
+    aug = SparseFlowAugmentor(crop_size=(96, 128), seed=1)
+    a1, a2, af, av = aug(img1, img2, flow, valid)
+    assert af.shape == (96, 128, 2) and av.shape == (96, 128)
+    # wherever valid survived, the (scaled) flow stays axis-aligned in x
+    assert av.sum() > 0
+    assert np.all(af[av > 0][:, 1] == 0.0)
+    assert np.all(af[av == 0] == 0.0)
+
+
+def test_sparse_resize_scatter_exact():
+    flow = np.zeros((10, 10, 2), np.float32)
+    valid = np.zeros((10, 10), np.float32)
+    flow[5, 5] = [2.0, 0.0]
+    valid[5, 5] = 1
+    out_flow, out_valid = SparseFlowAugmentor.resize_sparse_flow_map(
+        flow, valid, fx=2.0, fy=2.0)
+    assert out_flow.shape == (20, 20, 2)
+    assert out_valid[10, 10] == 1
+    np.testing.assert_allclose(out_flow[10, 10], [4.0, 0.0])
+    assert out_valid.sum() == 1
+
+
+# ----------------------------------------------------- dataset layouts
+
+def _write_ppm(path, arr):
+    from PIL import Image
+    Image.fromarray(arr).save(path)
+
+
+def _mk_img(path, h=64, w=96):
+    from PIL import Image
+    arr = RNG.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+def _mk_pfm(path, h=64, w=96):
+    data = RNG.standard_normal((h, w, 3)).astype("<f4")
+    with open(path, "wb") as f:
+        f.write(b"PF\n%d %d\n-1.0\n" % (w, h))
+        np.flipud(data).tofile(f)
+
+
+@pytest.fixture()
+def synth_root(tmp_path):
+    root = tmp_path / "datasets"
+
+    # FlyingChairs: data/*.ppm + *.flo + split file
+    chairs = root / "FlyingChairs_release" / "data"
+    chairs.mkdir(parents=True)
+    for i in range(1, 4):
+        _mk_img(chairs / f"{i:05d}_img1.ppm")
+        _mk_img(chairs / f"{i:05d}_img2.ppm")
+        write_flow(str(chairs / f"{i:05d}_flow.flo"),
+                   RNG.standard_normal((64, 96, 2)).astype(np.float32))
+    np.savetxt(tmp_path / "chairs_split.txt", [1, 2, 1], fmt="%d")
+
+    # Sintel: training/{clean,final,flow}/scene/
+    for dstype in ["clean", "final"]:
+        scene = root / "Sintel" / "training" / dstype / "alley_1"
+        scene.mkdir(parents=True)
+        for i in range(1, 4):
+            _mk_img(scene / f"frame_{i:04d}.png")
+    fscene = root / "Sintel" / "training" / "flow" / "alley_1"
+    fscene.mkdir(parents=True)
+    for i in range(1, 3):
+        write_flow(str(fscene / f"frame_{i:04d}.flo"),
+                   RNG.standard_normal((64, 96, 2)).astype(np.float32))
+
+    # FlyingThings3D: frames_cleanpass/TRAIN/A/0000/left + optical_flow
+    img_dir = root / "FlyingThings3D" / "frames_cleanpass" / "TRAIN" / "A" / "0000" / "left"
+    img_dir.mkdir(parents=True)
+    for i in range(3):
+        _mk_img(img_dir / f"{i:04d}.png")
+    for direction in ["into_future", "into_past"]:
+        fdir = (root / "FlyingThings3D" / "optical_flow" / "TRAIN" / "A"
+                / "0000" / direction / "left")
+        fdir.mkdir(parents=True)
+        for i in range(3):
+            _mk_pfm(fdir / f"{i:04d}.pfm")
+
+    # KITTI: training/image_2/*_10.png,*_11.png + flow_occ
+    kimg = root / "KITTI" / "training" / "image_2"
+    kflow = root / "KITTI" / "training" / "flow_occ"
+    kimg.mkdir(parents=True)
+    kflow.mkdir(parents=True)
+    for i in range(2):
+        _mk_img(kimg / f"{i:06d}_10.png", h=128, w=160)
+        _mk_img(kimg / f"{i:06d}_11.png", h=128, w=160)
+        write_flow_kitti(str(kflow / f"{i:06d}_10.png"),
+                         RNG.standard_normal((128, 160, 2)).astype(np.float32))
+
+    # HD1K: hd1k_input/image_2 + hd1k_flow_gt/flow_occ
+    himg = root / "HD1k" / "hd1k_input" / "image_2"
+    hflow = root / "HD1k" / "hd1k_flow_gt" / "flow_occ"
+    himg.mkdir(parents=True)
+    hflow.mkdir(parents=True)
+    for i in range(3):
+        _mk_img(himg / f"000000_{i:04d}.png", h=128, w=160)
+        write_flow_kitti(str(hflow / f"000000_{i:04d}.png"),
+                         RNG.standard_normal((128, 160, 2)).astype(np.float32))
+
+    return root
+
+
+def test_chairs_split(synth_root, tmp_path):
+    ds = FlyingChairs(None, split="training",
+                      root=str(synth_root / "FlyingChairs_release/data"),
+                      split_file=str(tmp_path / "chairs_split.txt"))
+    assert len(ds) == 2  # ids 1 and 3 are train
+    s = ds[0]
+    assert s["image1"].shape == (64, 96, 3)
+    assert s["flow"].shape == (64, 96, 2)
+    assert s["valid"].shape == (64, 96)
+    val = FlyingChairs(None, split="validation",
+                       root=str(synth_root / "FlyingChairs_release/data"),
+                       split_file=str(tmp_path / "chairs_split.txt"))
+    assert len(val) == 1
+
+
+def test_sintel_layout(synth_root):
+    ds = MpiSintel(None, split="training", dstype="clean",
+                   root=str(synth_root / "Sintel"))
+    assert len(ds) == 2  # 3 frames -> 2 pairs
+    assert ds.extra_info[0] == ("alley_1", 0)
+    s = ds[0]
+    assert s["flow"].shape == (64, 96, 2)
+
+
+def test_things_layout(synth_root):
+    ds = FlyingThings3D(None, root=str(synth_root / "FlyingThings3D"))
+    # into_future: pairs (0,1),(1,2) minus last flow → 2; into_past: 2
+    assert len(ds) == 4
+    s = ds[0]
+    assert s["flow"].shape == (64, 96, 2)
+
+
+def test_kitti_layout_sparse(synth_root):
+    ds = KITTI(None, split="training", root=str(synth_root / "KITTI"))
+    assert len(ds) == 2
+    s = ds[0]
+    assert s["flow"].shape == (128, 160, 2)
+    assert set(np.unique(s["valid"])) <= {0.0, 1.0}
+
+
+def test_hd1k_layout(synth_root):
+    ds = HD1K(None, root=str(synth_root / "HD1k"))
+    assert len(ds) == 2  # 3 frames -> 2 pairs
+    assert ds[1]["image1"].shape == (128, 160, 3)
+
+
+def test_mixture_weights(synth_root, tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        "raft_tpu.data.datasets.SPLITS_DIR", str(tmp_path))
+    ds = fetch_dataset("sintel", (48, 64), root=str(synth_root))
+    # 100*clean(2) + 100*final(2) + 200*kitti(2) + 5*hd1k(2) + things(4)
+    assert len(ds) == 100 * 2 + 100 * 2 + 200 * 2 + 5 * 2 + 4
+    # index composition reaches every part
+    first = ds[0]
+    last = ds[len(ds) - 1]
+    assert first["image1"].shape == (48, 64, 3)
+    assert last["image1"].shape == (48, 64, 3)
+
+
+def test_loader_determinism_and_shapes(synth_root, tmp_path):
+    ds = FlyingChairs(dict(crop_size=(48, 64), min_scale=-0.1, max_scale=0.5,
+                           do_flip=True),
+                      split="training",
+                      root=str(synth_root / "FlyingChairs_release/data"),
+                      split_file=str(tmp_path / "chairs_split.txt"))
+    loader = DataLoader(ds, batch_size=2, num_workers=2, seed=0)
+    loader.set_epoch(0)
+    b1 = next(iter(loader))
+    assert b1["image1"].shape == (2, 48, 64, 3)
+    assert b1["flow"].shape == (2, 48, 64, 2)
+    assert b1["valid"].shape == (2, 48, 64)
+    b2 = next(iter(loader))
+    np.testing.assert_array_equal(b1["image1"], b2["image1"])
+    loader.set_epoch(1)
+    b3 = next(iter(loader))
+    assert not np.array_equal(b1["image1"], b3["image1"])
