@@ -44,22 +44,27 @@ def main():
         "valid": jnp.ones((B, H, W), np.float32),
     }
 
-    cfg = RAFTConfig(small=False, compute_dtype="bfloat16", remat=True)
+    # remat=False: activations of the 12-iteration scan fit HBM at this
+    # resolution, and skipping the recompute measures ~6% faster
+    # (551 vs 584 ms/step); remat is for the larger-crop stages.
+    cfg = RAFTConfig(small=False, compute_dtype="bfloat16", remat=False)
     model = RAFT(cfg)
     tx, _ = make_optimizer(lr=4e-4, num_steps=1000, wdecay=1e-4)
     state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
                                iters=iters)
     step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0)
 
-    # warmup / compile
+    # Warmup / compile.  Synchronization must be a host copy: over the
+    # axon tunnel, block_until_ready returns before execution finishes,
+    # which silently times dispatch instead of compute.
     state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
     n_steps = 10
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     pairs_per_s = B * n_steps / dt

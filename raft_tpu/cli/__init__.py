@@ -1,0 +1,10 @@
+"""Command-line entry points.
+
+Parity surface (SURVEY.md §2 rows 15-26): train / evaluate / demo /
+warp demos / frame2video, replacing the reference's repo-root scripts
+(train.py:217-246, evaluate.py:169-195, demo.py:66-76, demo_warp*.py,
+frame2video.py:17-52) and the shell-script stage recipes
+(train_standard.sh, train_mixed.sh — now STAGE_PRESETS in config.py).
+
+Usage: ``python -m raft_tpu.cli.train --stage chairs ...``.
+"""

@@ -1,0 +1,49 @@
+"""Flow-visualization demo over a frame folder.
+
+Parity target: the reference's ``demo.py`` (demo.py:42-76): pairwise flow
+on consecutive frames, rendered with the Middlebury color wheel.  Output
+goes to ``--output`` as PNG collages (frame | flow) instead of a
+matplotlib window (headless TPU hosts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from raft_tpu.cli.demo_common import (flow_viz_image, infer_flow, list_frames,
+                                      load_image, load_model, save_image)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("raft_tpu flow demo")
+    p.add_argument("--model", required=True, help="checkpoint path")
+    p.add_argument("--path", required=True, help="folder of frames")
+    p.add_argument("--output", default="demo_out")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--iters", type=int, default=20)  # demo.py:62
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    _, _, evaluator = load_model(args.model, args.small,
+                                 args.mixed_precision, args.alternate_corr)
+    frames = list_frames(args.path)
+    for i, (p1, p2) in enumerate(zip(frames[:-1], frames[1:])):
+        image1 = load_image(p1)
+        image2 = load_image(p2)
+        _, flow = infer_flow(evaluator, image1, image2, iters=args.iters)
+        viz = flow_viz_image(flow).astype(np.float32)
+        out = np.concatenate([image1, viz], axis=0)  # demo.py:26-39 layout
+        save_image(os.path.join(args.output, f"flow_{i:04d}.png"), out)
+        print(f"{os.path.basename(p1)} -> {os.path.basename(p2)}: "
+              f"|flow| max {np.abs(flow).max():.1f}px")
+
+
+if __name__ == "__main__":
+    main()

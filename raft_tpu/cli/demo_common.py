@@ -1,0 +1,121 @@
+"""Shared machinery for the demo CLIs.
+
+Covers the plumbing every reference demo script repeats: model + ckpt
+loading (demo.py:43-48), image loading (demo.py:20-23), /8 padding
+(demo.py:59-60), pairwise inference (demo.py:62), warp visualization
+collages (demo_warp.py:76-121), and frame writing.
+"""
+
+from __future__ import annotations
+
+import os
+from glob import glob
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def load_model(ckpt: str, small: bool = False, mixed_precision: bool = False,
+               alternate_corr: bool = False):
+    """Build RAFT + load a checkpoint (demo.py:43-48 analogue).
+
+    Returns (model, variables, evaluator).
+    """
+    from raft_tpu.cli.evaluate import load_variables
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.evaluation.evaluate import Evaluator
+    from raft_tpu.models import RAFT
+
+    cfg = RAFTConfig(
+        small=small,
+        compute_dtype="bfloat16" if mixed_precision else "float32",
+        alternate_corr=alternate_corr)
+    model = RAFT(cfg)
+    variables = load_variables(ckpt, model)
+    return model, variables, Evaluator(model, variables)
+
+
+def load_image(path: str) -> np.ndarray:
+    """uint8 RGB HWC float32 image (demo.py:20-23)."""
+    from PIL import Image
+
+    img = np.asarray(Image.open(path).convert("RGB")).astype(np.uint8)
+    return img.astype(np.float32)
+
+
+def list_frames(folder: str, exts=("png", "jpg", "jpeg")) -> List[str]:
+    """Sorted frame paths in a folder (demo.py:51-53)."""
+    paths: List[str] = []
+    for e in exts:
+        paths += glob(os.path.join(folder, f"*.{e}"))
+    return sorted(paths)
+
+
+def infer_flow(evaluator, image1: np.ndarray, image2: np.ndarray,
+               iters: int = 20, flow_init=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Padded test-mode inference on one pair.
+
+    Returns (flow_low, flow_up) as numpy, flow_up unpadded to input size.
+    """
+    import jax.numpy as jnp
+
+    from raft_tpu.ops import InputPadder
+
+    padder = InputPadder(image1[None].shape)
+    im1, im2 = padder.pad(jnp.asarray(image1[None]),
+                          jnp.asarray(image2[None]))
+    flow_low, flow_up = evaluator(np.asarray(im1), np.asarray(im2), iters,
+                                  flow_init=flow_init)
+    return np.asarray(flow_low)[0], np.asarray(padder.unpad(flow_up))[0]
+
+
+def warp_image(image: np.ndarray, flow: np.ndarray,
+               use_cv2: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    """Backward-warp ``image`` by ``flow`` (demo_warp.py:27-73 semantics).
+
+    use_cv2 selects the cv2.remap-equivalent path (same math, host-side).
+    Returns (warped, valid_mask).
+    """
+    if use_cv2:
+        import cv2
+
+        h, w = flow.shape[:2]
+        gx, gy = np.meshgrid(np.arange(w), np.arange(h))
+        map_x = (gx + flow[..., 0]).astype(np.float32)
+        map_y = (gy + flow[..., 1]).astype(np.float32)
+        warped = cv2.remap(image, map_x, map_y, cv2.INTER_LINEAR)
+        mask = ((map_x >= 0) & (map_x <= w - 1)
+                & (map_y >= 0) & (map_y <= h - 1)).astype(np.float32)
+        return warped, mask[..., None]
+
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.warp import backward_warp
+
+    warped, mask = backward_warp(jnp.asarray(image[None]),
+                                 jnp.asarray(flow[None]))
+    return np.asarray(warped)[0], np.asarray(mask)[0]
+
+
+def flow_viz_image(flow: np.ndarray) -> np.ndarray:
+    """Middlebury color wheel rendering (flow_viz.py:109-132)."""
+    from raft_tpu.data import flow_to_image
+
+    return flow_to_image(flow)
+
+
+def save_image(path: str, img: np.ndarray) -> None:
+    from PIL import Image
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    Image.fromarray(np.clip(img, 0, 255).astype(np.uint8)).save(path)
+
+
+def warp_collage(image1: np.ndarray, image2: np.ndarray, flow: np.ndarray,
+                 warped: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """2x2 collage: [img1 | img2 ; flow viz | warped] (demo_warp.py:76-121
+    visualization intent, saved instead of shown)."""
+    viz = flow_viz_image(flow).astype(np.float32)
+    top = np.concatenate([image1, image2], axis=1)
+    bottom = np.concatenate([viz, warped], axis=1)
+    return np.concatenate([top, bottom], axis=0)

@@ -1,0 +1,58 @@
+"""Pair-warp demo: predict flow between two images, warp one onto the
+other, save a collage.
+
+Parity target: ``demo_warp.py`` (demo_warp.py:124-156) with both warp
+implementations — the grid-sample path (demo_warp.py:27-56, including
+the 0.999 validity-mask threshold) and the cv2.remap path
+(demo_warp.py:59-73) — selected by ``--use_cv2``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from raft_tpu.cli.demo_common import (infer_flow, load_image, load_model,
+                                      save_image, warp_collage, warp_image)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("raft_tpu pair warp demo")
+    p.add_argument("--model", required=True)
+    p.add_argument("--image1", required=True)
+    p.add_argument("--image2", required=True)
+    p.add_argument("--output", default="warp_out")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--use_cv2", action="store_true",
+                   help="cv2.remap warp (demo_warp.py:59-73) instead of "
+                        "the grid-sample path")
+    p.add_argument("--backward", action="store_true",
+                   help="also warp image1 toward image2 with -flow")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    _, _, evaluator = load_model(args.model, args.small,
+                                 args.mixed_precision, args.alternate_corr)
+    image1 = load_image(args.image1)
+    image2 = load_image(args.image2)
+    _, flow = infer_flow(evaluator, image1, image2, iters=args.iters)
+
+    # forward warp: image2 sampled back along the flow reproduces image1
+    warped, mask = warp_image(image2, flow, use_cv2=args.use_cv2)
+    save_image(os.path.join(args.output, "collage.png"),
+               warp_collage(image1, image2, flow, warped, mask))
+    save_image(os.path.join(args.output, "warped_2to1.png"), warped)
+
+    if args.backward:
+        warped_b, _ = warp_image(image1, -flow, use_cv2=args.use_cv2)
+        save_image(os.path.join(args.output, "warped_1to2.png"), warped_b)
+    print(f"wrote {args.output}/")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Frame-by-frame warp over consecutive frames of a folder.
+
+Parity target: ``demo_warp_folder.py`` (demo_warp_folder.py:140-165):
+each frame t+1 is warped back toward frame t along the predicted flow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from raft_tpu.cli.demo_common import (infer_flow, list_frames, load_image,
+                                      load_model, save_image, warp_collage,
+                                      warp_image)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("raft_tpu folder warp demo")
+    p.add_argument("--model", required=True)
+    p.add_argument("--path", required=True, help="folder of frames")
+    p.add_argument("--output", default="warp_folder_out")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--use_cv2", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    _, _, evaluator = load_model(args.model, args.small,
+                                 args.mixed_precision, args.alternate_corr)
+    frames = list_frames(args.path)
+    for i, (p1, p2) in enumerate(zip(frames[:-1], frames[1:])):
+        image1 = load_image(p1)
+        image2 = load_image(p2)
+        _, flow = infer_flow(evaluator, image1, image2, iters=args.iters)
+        warped, mask = warp_image(image2, flow, use_cv2=args.use_cv2)
+        save_image(os.path.join(args.output, f"warped_{i:04d}.png"), warped)
+        save_image(os.path.join(args.output, f"collage_{i:04d}.png"),
+                   warp_collage(image1, image2, flow, warped, mask))
+    print(f"wrote {args.output}/ ({len(frames) - 1} pairs)")
+
+
+if __name__ == "__main__":
+    main()

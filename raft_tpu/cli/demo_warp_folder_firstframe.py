@@ -1,0 +1,74 @@
+"""First-frame propagation demo: warp frame 0 forward through a whole
+sequence by chaining per-pair flows.
+
+Parity target: ``demo_warp_folder_firstframe.py`` — flows are computed
+for every consecutive pair, then frame 0 is pushed forward iteratively
+with ``warp(source, -flow)`` (demo_warp_folder_firstframe.py:119-141,
+157-167).  Inputs are resized to a /8 multiple instead of padded
+(demo_warp_folder_firstframe.py:46-53), matching the reference's
+resize-based conditioning for this demo.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from raft_tpu.cli.demo_common import (list_frames, load_image, load_model,
+                                      save_image, warp_image)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("raft_tpu first-frame propagation demo")
+    p.add_argument("--model", required=True)
+    p.add_argument("--path", required=True, help="folder of frames")
+    p.add_argument("--output", default="warp_firstframe_out")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--use_cv2", action="store_true")
+    return p.parse_args(argv)
+
+
+def resize_to_multiple_of_8(img: np.ndarray) -> np.ndarray:
+    """Resize (not pad) to the nearest /8 size
+    (demo_warp_folder_firstframe.py:46-53)."""
+    import cv2
+
+    h, w = img.shape[:2]
+    h8, w8 = (h // 8) * 8, (w // 8) * 8
+    if (h8, w8) == (h, w):
+        return img
+    return cv2.resize(img, (w8, h8), interpolation=cv2.INTER_LINEAR)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    _, _, evaluator = load_model(args.model, args.small,
+                                 args.mixed_precision, args.alternate_corr)
+    frames = list_frames(args.path)
+    images = [resize_to_multiple_of_8(load_image(p)) for p in frames]
+
+    # 1) flow for every consecutive pair (no padding needed post-resize)
+    flows = []
+    for image1, image2 in zip(images[:-1], images[1:]):
+        _, flow_up = evaluator(image1[None], image2[None], args.iters)
+        flows.append(np.asarray(flow_up)[0])
+
+    # 2) chain-warp frame 0 forward through the sequence
+    #    (warp with -flow pushes the source toward the next frame,
+    #    demo_warp_folder_firstframe.py:131-141)
+    current = images[0]
+    save_image(os.path.join(args.output, "prop_0000.png"), current)
+    for i, flow in enumerate(flows):
+        current, _ = warp_image(current, -flow, use_cv2=args.use_cv2)
+        save_image(os.path.join(args.output, f"prop_{i + 1:04d}.png"),
+                   current)
+    print(f"wrote {args.output}/ ({len(flows) + 1} frames)")
+
+
+if __name__ == "__main__":
+    main()

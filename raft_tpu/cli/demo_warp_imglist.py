@@ -1,0 +1,55 @@
+"""Warp demo over an explicit list of image pairs.
+
+Parity target: ``demo_warp_imglist.py`` (demo_warp_imglist.py:86-145).
+The pair list file has one pair per line: ``path1 path2``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from raft_tpu.cli.demo_common import (infer_flow, load_image, load_model,
+                                      save_image, warp_collage, warp_image)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("raft_tpu imglist warp demo")
+    p.add_argument("--model", required=True)
+    p.add_argument("--imglist", required=True,
+                   help="text file, one 'path1 path2' pair per line")
+    p.add_argument("--output", default="warp_imglist_out")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--use_cv2", action="store_true")
+    return p.parse_args(argv)
+
+
+def read_pairs(path: str):
+    pairs = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                pairs.append((parts[0], parts[1]))
+    return pairs
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    _, _, evaluator = load_model(args.model, args.small,
+                                 args.mixed_precision, args.alternate_corr)
+    for i, (p1, p2) in enumerate(read_pairs(args.imglist)):
+        image1 = load_image(p1)
+        image2 = load_image(p2)
+        _, flow = infer_flow(evaluator, image1, image2, iters=args.iters)
+        warped, mask = warp_image(image2, flow, use_cv2=args.use_cv2)
+        save_image(os.path.join(args.output, f"collage_{i:04d}.png"),
+                   warp_collage(image1, image2, flow, warped, mask))
+    print(f"wrote {args.output}/")
+
+
+if __name__ == "__main__":
+    main()

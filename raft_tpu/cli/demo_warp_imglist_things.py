@@ -1,0 +1,71 @@
+"""Batch warp driven by the FlyingThings3D-subset split list.
+
+Parity target: ``demo_warp_imglist_FlyingThings3D.py``
+(demo_warp_imglist_FlyingThings3D.py:137-193): reads the 10-frame
+sequence lines of txt/FlyingThings3D_subset_*_split.txt (a copy ships in
+raft_tpu/data/splits/), forms consecutive pairs per sequence, and warps
+each pair.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from raft_tpu.cli.demo_common import (infer_flow, load_image, load_model,
+                                      save_image, warp_collage, warp_image)
+from raft_tpu.data.datasets import SPLITS_DIR
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("raft_tpu FlyingThings3D-subset warp demo")
+    p.add_argument("--model", required=True)
+    p.add_argument("--data_root", required=True,
+                   help="FlyingThings3D_subset image root")
+    p.add_argument("--split_file",
+                   default=os.path.join(SPLITS_DIR,
+                                        "FlyingThings3D_subset_train_split.txt"))
+    p.add_argument("--output", default="warp_things_out")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--use_cv2", action="store_true")
+    p.add_argument("--max_sequences", type=int, default=None)
+    return p.parse_args(argv)
+
+
+def read_sequences(split_file: str):
+    """Each line lists the frames of one sequence
+    (demo_warp_imglist_FlyingThings3D.py:137-149)."""
+    seqs = []
+    with open(split_file) as f:
+        for line in f:
+            names = line.split()
+            if len(names) >= 2:
+                seqs.append(names)
+    return seqs
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    _, _, evaluator = load_model(args.model, args.small,
+                                 args.mixed_precision, args.alternate_corr)
+    seqs = read_sequences(args.split_file)
+    if args.max_sequences:
+        seqs = seqs[: args.max_sequences]
+    for s, names in enumerate(seqs):
+        for i, (n1, n2) in enumerate(zip(names[:-1], names[1:])):
+            image1 = load_image(os.path.join(args.data_root, n1))
+            image2 = load_image(os.path.join(args.data_root, n2))
+            _, flow = infer_flow(evaluator, image1, image2, iters=args.iters)
+            warped, mask = warp_image(image2, flow, use_cv2=args.use_cv2)
+            save_image(
+                os.path.join(args.output, f"seq{s:04d}",
+                             f"collage_{i:04d}.png"),
+                warp_collage(image1, image2, flow, warped, mask))
+    print(f"wrote {args.output}/ ({len(seqs)} sequences)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Evaluation / submission CLI.
+
+Parity target: the reference's ``evaluate.py`` entry point
+(evaluate.py:169-195): strict checkpoint load, per-dataset validation
+(chairs / sintel / kitti) and benchmark-submission writers.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("raft_tpu evaluation")
+    p.add_argument("--model", required=True, help="checkpoint (.msgpack, "
+                   "or a torch .pth imported via utils.torch_import)")
+    p.add_argument("--dataset", required=True,
+                   choices=["chairs", "sintel", "kitti",
+                            "sintel_submission", "kitti_submission"])
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--datasets_root", default="datasets")
+    p.add_argument("--output_path", default=None)
+    p.add_argument("--warm_start", action="store_true",
+                   help="sintel submission: propagate flow across frames "
+                        "(evaluate.py:28-41)")
+    return p.parse_args(argv)
+
+
+def load_variables(path: str, model, sample_shape=(1, 368, 496, 3)):
+    """Load model variables from a raft_tpu .msgpack checkpoint or a
+    reference torch .pth (strict load, evaluate.py:179)."""
+    import jax
+    import numpy as np
+
+    if path.endswith(".pth"):
+        from raft_tpu.utils.torch_import import load_torch_checkpoint
+        params, batch_stats = load_torch_checkpoint(path,
+                                                    small=model.cfg.small)
+        out = {"params": params}
+        if batch_stats:
+            out["batch_stats"] = batch_stats
+        return out
+
+    import flax
+
+    rng = np.random.default_rng(0)
+    img = rng.uniform(0, 255, sample_shape).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    with open(path, "rb") as f:
+        payload = flax.serialization.msgpack_restore(f.read())
+    out = {"params": flax.serialization.from_state_dict(
+        variables["params"], payload["params"])}
+    if payload.get("batch_stats"):
+        out["batch_stats"] = flax.serialization.from_state_dict(
+            variables.get("batch_stats", {}), payload["batch_stats"])
+    elif "batch_stats" in variables:
+        out["batch_stats"] = variables["batch_stats"]
+    return out
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.evaluation.evaluate import (
+        Evaluator, create_kitti_submission, create_sintel_submission,
+        validate_chairs, validate_kitti, validate_sintel)
+    from raft_tpu.models import RAFT
+
+    cfg = RAFTConfig(
+        small=args.small,
+        compute_dtype="bfloat16" if args.mixed_precision else "float32",
+        alternate_corr=args.alternate_corr)
+    model = RAFT(cfg)
+    variables = load_variables(args.model, model)
+    ev = Evaluator(model, variables)
+    root = args.datasets_root
+
+    if args.dataset == "chairs":
+        validate_chairs(ev, root, iters=args.iters or 24)
+    elif args.dataset == "sintel":
+        validate_sintel(ev, root, iters=args.iters or 32)
+    elif args.dataset == "kitti":
+        validate_kitti(ev, root, iters=args.iters or 24)
+    elif args.dataset == "sintel_submission":
+        create_sintel_submission(
+            ev, root, iters=args.iters or 32, warm_start=args.warm_start,
+            output_path=args.output_path or "sintel_submission")
+    elif args.dataset == "kitti_submission":
+        create_kitti_submission(
+            ev, root, iters=args.iters or 24,
+            output_path=args.output_path or "kitti_submission")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Frame-folder to video utility.
+
+Parity target: ``frame2video.py`` (frame2video.py:17-52): glob a folder
+of frames, write mp4/avi/ogv/flv via cv2.VideoWriter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+FOURCC = {
+    ".mp4": "mp4v",
+    ".avi": "XVID",
+    ".ogv": "THEO",
+    ".flv": "FLV1",
+}
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("raft_tpu frame2video")
+    p.add_argument("--path", required=True, help="folder of frames")
+    p.add_argument("--output", default="out.mp4",
+                   help="video path; extension picks the codec "
+                        "(mp4/avi/ogv/flv, frame2video.py:24-33)")
+    p.add_argument("--fps", type=float, default=20.0)
+    return p.parse_args(argv)
+
+
+def frames_to_video(path: str, output: str, fps: float = 20.0) -> int:
+    import cv2
+
+    from raft_tpu.cli.demo_common import list_frames
+
+    frames = list_frames(path)
+    if not frames:
+        raise FileNotFoundError(f"no frames in {path}")
+    first = cv2.imread(frames[0])
+    h, w = first.shape[:2]
+    ext = os.path.splitext(output)[1].lower()
+    fourcc = cv2.VideoWriter_fourcc(*FOURCC.get(ext, "mp4v"))
+    writer = cv2.VideoWriter(output, fourcc, fps, (w, h))
+    for f in frames:
+        img = cv2.imread(f)
+        if img.shape[:2] != (h, w):
+            img = cv2.resize(img, (w, h))
+        writer.write(img)
+    writer.release()
+    return len(frames)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n = frames_to_video(args.path, args.output, args.fps)
+    print(f"wrote {args.output} ({n} frames)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,263 @@
+"""Training CLI.
+
+Parity target: the reference's ``train.py`` entry point (argparse flags
+train.py:218-239, train() loop train.py:136-214) with the stage
+hyperparameters that lived in train_standard.sh / train_mixed.sh served
+from ``STAGE_PRESETS``.
+
+Superset capabilities (SURVEY.md §5): full train-state checkpoints
+(optimizer + schedule + PRNG, not just params), auto-resume from the
+latest checkpoint after preemption, deterministic data order, mesh data
+parallelism instead of DataParallel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("raft_tpu training")
+    # reference flags (train.py:218-239)
+    p.add_argument("--name", default=None, help="experiment name")
+    p.add_argument("--stage", required=True,
+                   choices=["chairs", "things", "sintel", "kitti"])
+    p.add_argument("--restore_ckpt", default=None,
+                   help="params-only restore for curriculum transfer "
+                        "(strict=False analogue, train.py:141-142)")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--validation", nargs="*", default=[])
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--num_steps", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--image_size", type=int, nargs=2, default=None)
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--wdecay", type=float, default=None)
+    p.add_argument("--epsilon", type=float, default=1e-8)
+    p.add_argument("--clip", type=float, default=1.0)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--gamma", type=float, default=None,
+                   help="exponential loss weighting (train.py:237)")
+    p.add_argument("--add_noise", action="store_true")
+    # TPU-native replacements for --gpus
+    p.add_argument("--data_parallel", type=int, default=1,
+                   help="devices on the mesh data axis (replaces --gpus)")
+    p.add_argument("--spatial_parallel", type=int, default=1,
+                   help="devices sharding the corr-volume query axis")
+    # extras
+    p.add_argument("--alternate_corr", action="store_true",
+                   help="on-demand Pallas correlation (low HBM)")
+    p.add_argument("--datasets_root", default="datasets")
+    p.add_argument("--checkpoint_dir", default="checkpoints")
+    p.add_argument("--log_dir", default="runs")
+    p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--val_freq", type=int, default=5000)
+    p.add_argument("--resume", action="store_true",
+                   help="auto-resume full state from latest checkpoint")
+    p.add_argument("--no_tensorboard", action="store_true")
+    p.add_argument("--max_steps_override", type=int, default=None,
+                   help="debug: stop early regardless of schedule")
+    return p.parse_args(argv)
+
+
+def build_config(args):
+    """Merge the stage preset (config.py STAGE_PRESETS) with CLI overrides."""
+    from raft_tpu.config import STAGE_PRESETS, RAFTConfig
+
+    key = args.stage + ("_mixed" if args.mixed_precision else "")
+    preset = STAGE_PRESETS[key]
+    model = dataclasses.replace(
+        preset.model,
+        small=args.small,
+        dropout=args.dropout,
+        alternate_corr=args.alternate_corr,
+        corr_shard=args.spatial_parallel > 1,
+    )
+    data = dataclasses.replace(
+        preset.data,
+        root=args.datasets_root,
+        num_workers=args.num_workers,
+        **({"image_size": tuple(args.image_size)} if args.image_size else {}),
+        **({"batch_size": args.batch_size} if args.batch_size else {}),
+    )
+    train = dataclasses.replace(
+        preset.train,
+        **({"name": args.name} if args.name else {}),
+        **({"lr": args.lr} if args.lr is not None else {}),
+        **({"num_steps": args.num_steps} if args.num_steps is not None else {}),
+        **({"wdecay": args.wdecay} if args.wdecay is not None else {}),
+        **({"gamma": args.gamma} if args.gamma is not None else {}),
+        epsilon=args.epsilon,
+        clip=args.clip,
+        iters=args.iters,
+        add_noise=args.add_noise,
+        val_freq=args.val_freq,
+        seed=args.seed,
+        restore_ckpt=args.restore_ckpt,
+        validation=tuple(args.validation),
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    return model, data, train
+
+
+def run_validation(model, variables, names,
+                   root: str) -> Dict[str, float]:
+    """In-loop validation (train.py:190-198)."""
+    from raft_tpu.evaluation.evaluate import (
+        Evaluator, validate_chairs, validate_kitti, validate_sintel)
+
+    ev = Evaluator(model, variables)
+    results: Dict[str, float] = {}
+    for name in names:
+        if name == "chairs":
+            results.update(validate_chairs(ev, root))
+        elif name == "sintel":
+            results.update(validate_sintel(ev, root))
+        elif name == "kitti":
+            results.update(validate_kitti(ev, root))
+    return results
+
+
+def train(args) -> str:
+    import jax
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.data import DataLoader, fetch_dataset
+    from raft_tpu.data.loader import prefetch_to_device
+    from raft_tpu.models import RAFT
+    from raft_tpu.parallel import make_mesh, shard_batch
+    from raft_tpu.parallel.step import (make_parallel_train_step,
+                                        replicate_state)
+    from raft_tpu.training import create_train_state, make_optimizer
+    from raft_tpu.training.logger import Logger
+    from raft_tpu.training.state import (latest_checkpoint, restore_checkpoint,
+                                         save_checkpoint)
+    from raft_tpu.training.step import make_train_step
+
+    model_cfg, data_cfg, train_cfg = build_config(args)
+    model = RAFT(model_cfg)
+
+    dataset = fetch_dataset(data_cfg.stage, data_cfg.image_size,
+                            root=data_cfg.root, seed=train_cfg.seed)
+    loader = DataLoader(dataset, data_cfg.batch_size,
+                        num_workers=data_cfg.num_workers,
+                        seed=train_cfg.seed)
+    print(f"stage={data_cfg.stage} dataset={len(dataset)} samples, "
+          f"batch={data_cfg.batch_size}, steps={train_cfg.num_steps}")
+
+    tx, schedule = make_optimizer(train_cfg.lr, train_cfg.num_steps,
+                                  train_cfg.wdecay, train_cfg.epsilon,
+                                  train_cfg.clip)
+
+    # Parameter init from one real batch.
+    first = next(iter(loader))
+    init_batch = {k: v for k, v in first.items() if k != "extra_info"}
+    state = create_train_state(model, tx, jax.random.PRNGKey(train_cfg.seed),
+                               init_batch, iters=train_cfg.iters)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    print(f"Parameter count: {n_params}")
+
+    # Restore: full auto-resume takes precedence, else params-only
+    # curriculum transfer (train.py:141-142).
+    start_step = 0
+    if args.resume:
+        ckpt = latest_checkpoint(train_cfg.checkpoint_dir,
+                                 prefix=train_cfg.name)
+        if ckpt:
+            state = restore_checkpoint(ckpt, state)
+            start_step = int(state.step)
+            print(f"resumed from {ckpt} at step {start_step}")
+    if start_step == 0 and train_cfg.restore_ckpt:
+        state = restore_checkpoint(train_cfg.restore_ckpt, state,
+                                   params_only=True)
+        print(f"restored params from {train_cfg.restore_ckpt}")
+
+    # Mesh / sharded step when parallelism is requested.
+    n_dev = args.data_parallel * args.spatial_parallel
+    mesh = None
+    sharding = None
+    if n_dev > 1:
+        mesh = make_mesh(data=args.data_parallel,
+                         spatial=args.spatial_parallel)
+        state = replicate_state(state, mesh)
+        step = make_parallel_train_step(
+            model, mesh, iters=train_cfg.iters, gamma=train_cfg.gamma,
+            max_flow=train_cfg.max_flow, freeze_bn=train_cfg.freeze_bn,
+            add_noise=train_cfg.add_noise)
+        from jax.sharding import NamedSharding
+        from raft_tpu.parallel.mesh import batch_spec
+        sharding = NamedSharding(mesh, batch_spec())
+    else:
+        step = make_train_step(
+            model, iters=train_cfg.iters, gamma=train_cfg.gamma,
+            max_flow=train_cfg.max_flow, freeze_bn=train_cfg.freeze_bn,
+            add_noise=train_cfg.add_noise)
+
+    logger = Logger(log_dir=os.path.join(args.log_dir, train_cfg.name),
+                    scheduler_lr=lambda s: float(schedule(s)),
+                    enable_tensorboard=not args.no_tensorboard,
+                    start_step=start_step)
+    os.makedirs(train_cfg.checkpoint_dir, exist_ok=True)
+
+    total_steps = start_step
+    num_steps = train_cfg.num_steps
+    if args.max_steps_override:
+        num_steps = min(num_steps, args.max_steps_override)
+
+    stream = prefetch_to_device(
+        (
+            {k: v for k, v in b.items() if k != "extra_info"}
+            for b in loader.epochs(start_epoch=total_steps
+                                   // max(len(loader), 1))
+        ),
+        sharding=sharding,
+    )
+    for batch in stream:
+        state, metrics = step(state, batch)
+        # Device scalars go in as-is; Logger converts at the sum_freq
+        # window boundary, so there is no per-step host sync to stall
+        # the dispatch pipeline.
+        logger.push(metrics)
+        total_steps += 1
+
+        if total_steps % train_cfg.val_freq == train_cfg.val_freq - 1:
+            path = os.path.join(train_cfg.checkpoint_dir,
+                                f"{total_steps + 1}_{train_cfg.name}.msgpack")
+            save_checkpoint(path, jax.device_get(state))
+            print(f"saved {path}")
+            if args.validation:
+                variables = {"params": jax.device_get(state.params)}
+                if state.batch_stats:
+                    variables["batch_stats"] = jax.device_get(
+                        state.batch_stats)
+                results = run_validation(model, variables, args.validation,
+                                         data_cfg.root)
+                logger.write_dict(results)
+
+        if total_steps >= num_steps:
+            break
+
+    final = os.path.join(train_cfg.checkpoint_dir,
+                         f"{train_cfg.name}.msgpack")
+    save_checkpoint(final, jax.device_get(state))
+    logger.close()
+    print(f"saved final checkpoint {final}")
+    return final
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    np.random.seed(args.seed)
+    train(args)
+
+
+if __name__ == "__main__":
+    main()

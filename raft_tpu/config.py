@@ -23,7 +23,11 @@ class RAFTConfig:
 
     small: bool = False
     dropout: float = 0.0
-    alternate_corr: bool = False  # on-demand (Pallas) corr lookup instead of all-pairs
+    alternate_corr: bool = False  # on-demand corr lookup instead of all-pairs
+    # Implementation of the on-demand lookup: "pallas" = the fused TPU
+    # kernel (ops/corr_pallas.py, replaces alt_cuda_corr), "lax" = the
+    # pure-XLA oracle it is tested against.
+    corr_impl: str = "pallas"  # "pallas" | "lax"
     # Mixed precision: compute dtype for encoders + update block; the corr
     # volume and the loss stay float32 (matching the autocast boundaries at
     # raft.py:99-127 and corr.py:50).
@@ -35,6 +39,20 @@ class RAFTConfig:
     # 'spatial' axis (high-res configs where the O((HW)^2) volume exceeds
     # one chip's HBM).  No-op without an active mesh.
     corr_shard: bool = False
+
+    def __post_init__(self):
+        if self.corr_impl not in ("pallas", "lax"):
+            raise ValueError(f"corr_impl must be 'pallas' or 'lax', "
+                             f"got {self.corr_impl!r}")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"compute_dtype must be 'float32' or "
+                             f"'bfloat16', got {self.compute_dtype!r}")
+        if self.alternate_corr and self.corr_shard:
+            raise ValueError(
+                "corr_shard shards the materialized all-pairs volume and "
+                "has no effect on the on-demand (alternate_corr) path — "
+                "the combination would silently drop the requested "
+                "spatial parallelism; choose one")
 
     @property
     def hidden_dim(self) -> int:

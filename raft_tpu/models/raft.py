@@ -61,8 +61,13 @@ class RefinementStep(nn.Module):
 
         if cfg.alternate_corr:
             fmap1, fmap2_pyr = corr_state
-            corr = alternate_corr_lookup(fmap1, fmap2_pyr, coords1,
-                                         cfg.corr_radius)
+            if cfg.corr_impl == "pallas":
+                from raft_tpu.ops.corr_pallas import ondemand_corr_lookup
+                corr = ondemand_corr_lookup(fmap1, fmap2_pyr, coords1,
+                                            cfg.corr_radius)
+            else:
+                corr = alternate_corr_lookup(fmap1, fmap2_pyr, coords1,
+                                             cfg.corr_radius)
         else:
             corr = corr_lookup(corr_state, coords1, cfg.corr_radius,
                                shard=cfg.corr_shard)

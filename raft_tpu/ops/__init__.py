@@ -9,9 +9,11 @@ from raft_tpu.ops.grid import (
 from raft_tpu.ops.corr import (
     all_pairs_correlation,
     build_corr_pyramid,
+    build_fmap_pyramid,
     corr_lookup,
     alternate_corr_lookup,
 )
+from raft_tpu.ops.corr_pallas import ondemand_corr_lookup
 from raft_tpu.ops.pad import InputPadder
 from raft_tpu.ops.warp import backward_warp, forward_interpolate
 
@@ -24,8 +26,10 @@ __all__ = [
     "avg_pool2x",
     "all_pairs_correlation",
     "build_corr_pyramid",
+    "build_fmap_pyramid",
     "corr_lookup",
     "alternate_corr_lookup",
+    "ondemand_corr_lookup",
     "InputPadder",
     "backward_warp",
     "forward_interpolate",

@@ -85,10 +85,53 @@ def _window_offsets(radius: int, dtype=jnp.float32) -> jax.Array:
     return jnp.stack([dx, dy], axis=-1).reshape(-1, 2)
 
 
+def onehot_lerp_weights(coord: jax.Array, radius: int,
+                        extent: int) -> jax.Array:
+    """Bilinear-weighted one-hot gather matrix along one axis.
+
+    M[n, k, j] = (1-f)*[j == i0-r+k] + f*[j == i0-r+k+1], i0 = floor(c),
+    f = c - i0.  Out-of-range taps never match — exactly
+    bilinear_sampler's zero OOB padding (utils.py:61-65).
+
+    This is the single parity-critical construction shared by the XLA
+    lookup below and the Pallas kernel (corr_pallas.py); built from
+    ``broadcasted_iota`` so the same code lowers inside Mosaic.
+
+    Args:
+      coord: (N, 1) scaled coordinates (trailing 1 keeps arrays >= 2-D
+        for TPU vector layouts inside Pallas).
+      extent: axis length (taps outside [0, extent) contribute zero).
+
+    Returns:
+      (N, 2r+1, extent) float32 weights.
+    """
+    n = coord.shape[0]
+    k1 = 2 * radius + 1
+    i0 = jnp.floor(coord)
+    f = (coord - i0)[:, :, None]            # (N, 1, 1)
+    i0 = i0.astype(jnp.int32)[:, :, None]   # (N, 1, 1)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (n, k1, extent), 2)
+    kk = jax.lax.broadcasted_iota(jnp.int32, (n, k1, extent), 1)
+    base = i0 - radius + kk
+    return ((jj == base).astype(jnp.float32) * (1.0 - f)
+            + (jj == base + 1).astype(jnp.float32) * f)
+
+
 def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
                 radius: int, shard: bool = False) -> jax.Array:
-    """Gather bilinear correlation windows at each pyramid level
+    """Bilinear correlation windows at each pyramid level
     (core/corr.py:29-50).
+
+    TPU-native formulation: instead of per-pixel gathers (which starve
+    the VPU — measured >100 ms/iteration at batch 8), the windowed
+    bilinear gather is two separable one-hot contractions per level
+    (gather-as-matmul): weight matrices RY[n, ky, h] / RX[n, kx, w]
+    carry the lerp factors, so
+
+        out[n, kx, ky] = sum_{h,w} RY[n,ky,h] * vol[n,h,w] * RX[n,kx,w]
+
+    runs entirely on the MXU as batched matmuls.  Ordering matches the
+    reference's x-major window flattening (corr.py:37-44).
 
     Args:
       pyramid: list of (B, Q, H_l, W_l) volumes, Q = H1*W1.
@@ -103,22 +146,32 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
     """
     B, H1, W1, _ = coords.shape
     Q = H1 * W1
-    offsets = _window_offsets(radius, coords.dtype)  # (K, 2)
+    N = B * Q
+    k1 = 2 * radius + 1
+    cx = coords[..., 0].reshape(N).astype(jnp.float32)
+    cy = coords[..., 1].reshape(N).astype(jnp.float32)
     out = []
     for i, corr in enumerate(pyramid):
-        centroid = coords.reshape(B * Q, 1, 2) / (2.0 ** i)
-        coords_lvl = centroid + offsets[None]  # (B*Q, K, 2)
-        img = corr.reshape(B * Q, corr.shape[2], corr.shape[3], 1)
+        H2, W2 = corr.shape[2], corr.shape[3]
+        img = corr.reshape(N, H2, W2).astype(jnp.float32)
+        ry = onehot_lerp_weights(cy[:, None] / (2.0 ** i), radius, H2)
+        rx = onehot_lerp_weights(cx[:, None] / (2.0 ** i), radius, W2)
         if shard:
             from jax.sharding import PartitionSpec as P
             from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
             # merged B*Q axis: batch-major outer, query inner — expressible
             # as a compound-axis sharding
-            img = constrain(img, P((DATA_AXIS, SPATIAL_AXIS), None, None, None))
-            coords_lvl = constrain(
-                coords_lvl, P((DATA_AXIS, SPATIAL_AXIS), None, None))
-        sampled = bilinear_sample(img, coords_lvl)  # (B*Q, K, 1)
-        out.append(sampled.reshape(B, H1, W1, -1))
+            spec = P((DATA_AXIS, SPATIAL_AXIS), None, None)
+            img = constrain(img, spec)
+            ry = constrain(ry, spec)
+            rx = constrain(rx, spec)
+        a = jnp.einsum("nkh,nhw->nkw", ry, img,
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)  # (N, ky, W2)
+        win = jnp.einsum("nkw,njw->njk", a, rx,
+                         preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)  # (N, kx, ky)
+        out.append(win.reshape(B, H1, W1, k1 * k1))
     return jnp.concatenate(out, axis=-1).astype(jnp.float32)
 
 

@@ -1,0 +1,89 @@
+"""Training metrics logger.
+
+Parity surface: the reference's ``Logger`` (train.py:89-133) — running
+means printed every SUM_FREQ=100 steps plus TensorBoard scalars for both
+training metrics (train.py:105-110) and validation results
+(train.py:125-130).
+
+TensorBoard backend: ``torch.utils.tensorboard`` when available (torch
+is part of the baked image), else a no-op — the console running means
+and the metrics history are always available.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Logger:
+    """Step-windowed running means + optional TensorBoard scalars."""
+
+    def __init__(self, log_dir: str = "runs", sum_freq: int = 100,
+                 scheduler_lr: Optional[callable] = None,
+                 enable_tensorboard: bool = True, start_step: int = 0):
+        self.sum_freq = sum_freq
+        # start_step: resume offset, so the printed LR and TensorBoard
+        # global_step continue the original run instead of restarting.
+        self.total_steps = start_step
+        self._pending: list = []
+        self.running: Dict[str, float] = {}
+        self.scheduler_lr = scheduler_lr
+        self.history: list = []
+        self.writer = None
+        self._log_dir = log_dir
+        self._tb = enable_tensorboard
+
+    def _ensure_writer(self):
+        if self.writer is None and self._tb:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.writer = SummaryWriter(log_dir=self._log_dir)
+            except Exception:
+                self._tb = False
+
+    def _print_status(self):
+        lr = (self.scheduler_lr(self.total_steps)
+              if self.scheduler_lr else float("nan"))
+        status = f"[{self.total_steps + 1:6d}, {lr:10.7f}] "
+        keys = sorted(self.running.keys())
+        status += "".join(f"{self.running[k] / self.sum_freq:10.4f}, "
+                          for k in keys)
+        print(status)
+
+    def push(self, metrics: Dict[str, float]) -> None:
+        """Accumulate one step's metrics; print + TB-log every sum_freq
+        steps (train.py:112-123).
+
+        Values may be device arrays: host conversion happens only at the
+        window boundary, so pushing never forces a per-step sync.
+        """
+        self.total_steps += 1
+        self._pending.append(metrics)
+
+        if self.total_steps % self.sum_freq == 0:
+            for m in self._pending:
+                for k, v in m.items():
+                    self.running[k] = self.running.get(k, 0.0) + float(v)
+            self._pending = []
+            self._print_status()
+            self._ensure_writer()
+            if self.writer is not None:
+                for k in self.running:
+                    self.writer.add_scalar(
+                        k, self.running[k] / self.sum_freq, self.total_steps)
+            self.history.append(
+                {k: v / self.sum_freq for k, v in self.running.items()}
+                | {"step": self.total_steps})
+            self.running = {}
+
+    def write_dict(self, results: Dict[str, float]) -> None:
+        """Log a validation-results dict (train.py:125-130)."""
+        self._ensure_writer()
+        if self.writer is not None:
+            for k, v in results.items():
+                self.writer.add_scalar(k, float(v), self.total_steps)
+        self.history.append(dict(results) | {"step": self.total_steps})
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()

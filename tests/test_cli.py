@@ -1,0 +1,167 @@
+"""End-to-end tests for the CLI layer: demos, frame2video, logger,
+config plumbing.
+
+The reference ships these as eyeball-only scripts (SURVEY.md §4); here
+each demo runs headless against a tiny random-init checkpoint and real
+frame fixtures written to tmp_path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import flax
+import jax
+
+
+@pytest.fixture(scope="module")
+def small_ckpt(tmp_path_factory):
+    """A random-init RAFT-small checkpoint in .msgpack train-state layout."""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+
+    model = RAFT(RAFTConfig(small=True))
+    rng = np.random.default_rng(0)
+    img = rng.uniform(0, 255, (1, 64, 96, 3)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
+    payload = flax.serialization.to_state_dict(
+        {"params": variables["params"], "batch_stats": {}})
+    path = tmp_path_factory.mktemp("ckpt") / "small.msgpack"
+    with open(path, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(payload))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def frame_dir(tmp_path_factory):
+    """Three tiny synthetic frames with a known 2px shift."""
+    from PIL import Image
+
+    d = tmp_path_factory.mktemp("frames")
+    rng = np.random.default_rng(1)
+    base = (rng.uniform(0, 255, (64, 96, 3))).astype(np.uint8)
+    for i in range(3):
+        Image.fromarray(np.roll(base, 2 * i, axis=1)).save(
+            d / f"frame_{i:02d}.png")
+    return str(d)
+
+
+def test_demo_flow_viz(small_ckpt, frame_dir, tmp_path):
+    from raft_tpu.cli import demo
+
+    out = tmp_path / "flowviz"
+    demo.main(["--model", small_ckpt, "--path", frame_dir,
+               "--output", str(out), "--small", "--iters", "2"])
+    files = sorted(os.listdir(out))
+    assert files == ["flow_0000.png", "flow_0001.png"]
+
+
+def test_demo_warp_pair(small_ckpt, frame_dir, tmp_path):
+    from raft_tpu.cli import demo_warp
+
+    frames = sorted(os.listdir(frame_dir))
+    out = tmp_path / "warp"
+    demo_warp.main(["--model", small_ckpt,
+                    "--image1", os.path.join(frame_dir, frames[0]),
+                    "--image2", os.path.join(frame_dir, frames[1]),
+                    "--output", str(out), "--small", "--iters", "2",
+                    "--backward"])
+    assert sorted(os.listdir(out)) == [
+        "collage.png", "warped_1to2.png", "warped_2to1.png"]
+
+
+def test_demo_warp_imglist(small_ckpt, frame_dir, tmp_path):
+    from raft_tpu.cli import demo_warp_imglist
+
+    frames = sorted(os.listdir(frame_dir))
+    lst = tmp_path / "pairs.txt"
+    lst.write_text(f"{frame_dir}/{frames[0]} {frame_dir}/{frames[1]}\n")
+    out = tmp_path / "imglist"
+    demo_warp_imglist.main(["--model", small_ckpt, "--imglist", str(lst),
+                            "--output", str(out), "--small", "--iters", "2",
+                            "--use_cv2"])
+    assert os.listdir(out) == ["collage_0000.png"]
+
+
+def test_demo_warp_folder_and_firstframe(small_ckpt, frame_dir, tmp_path):
+    from raft_tpu.cli import demo_warp_folder, demo_warp_folder_firstframe
+
+    out1 = tmp_path / "folder"
+    demo_warp_folder.main(["--model", small_ckpt, "--path", frame_dir,
+                           "--output", str(out1), "--small", "--iters", "2"])
+    assert len(os.listdir(out1)) == 4  # 2 pairs x (warped + collage)
+
+    out2 = tmp_path / "firstframe"
+    demo_warp_folder_firstframe.main(
+        ["--model", small_ckpt, "--path", frame_dir, "--output", str(out2),
+         "--small", "--iters", "2"])
+    assert len(os.listdir(out2)) == 3  # frame 0 + 2 propagated
+
+
+def test_demo_warp_things_list(small_ckpt, frame_dir, tmp_path):
+    from raft_tpu.cli import demo_warp_imglist_things
+
+    frames = sorted(os.listdir(frame_dir))
+    split = tmp_path / "split.txt"
+    split.write_text(" ".join(frames) + "\n")
+    out = tmp_path / "things"
+    demo_warp_imglist_things.main(
+        ["--model", small_ckpt, "--data_root", frame_dir,
+         "--split_file", str(split), "--output", str(out), "--small",
+         "--iters", "2", "--max_sequences", "1"])
+    assert len(os.listdir(out / "seq0000")) == 2
+
+
+def test_frame2video(frame_dir, tmp_path):
+    from raft_tpu.cli import frame2video
+
+    out = tmp_path / "vid.mp4"
+    frame2video.main(["--path", frame_dir, "--output", str(out)])
+    assert out.stat().st_size > 0
+
+
+def test_logger_running_means_and_history(tmp_path, capsys):
+    from raft_tpu.training.logger import Logger
+
+    logger = Logger(log_dir=str(tmp_path / "tb"), sum_freq=5,
+                    scheduler_lr=lambda s: 1e-4,
+                    enable_tensorboard=False)
+    for i in range(10):
+        logger.push({"epe": float(i), "loss": 2.0})
+    assert len(logger.history) == 2
+    assert logger.history[0]["epe"] == pytest.approx(2.0)  # mean(0..4)
+    assert logger.history[1]["epe"] == pytest.approx(7.0)  # mean(5..9)
+    logger.write_dict({"chairs": 1.5})
+    assert logger.history[-1]["chairs"] == 1.5
+    assert "epe" not in logger.running  # reset after window
+    out = capsys.readouterr().out
+    assert out.count("[") == 2  # one status line per window
+
+
+def test_build_config_merges_presets_and_overrides():
+    from raft_tpu.cli.train import build_config, parse_args
+
+    args = parse_args(["--stage", "things", "--mixed_precision",
+                       "--batch_size", "3", "--lr", "1e-5",
+                       "--spatial_parallel", "2"])
+    model, data, train = build_config(args)
+    assert model.compute_dtype == "bfloat16"  # things_mixed preset
+    assert model.corr_shard is True
+    assert data.batch_size == 3               # override
+    assert data.image_size == (400, 720)      # preset
+    assert train.lr == 1e-5                   # override
+    assert train.freeze_bn is True            # preset (post-chairs stage)
+
+
+def test_evaluate_load_variables_roundtrip(small_ckpt):
+    from raft_tpu.cli.evaluate import load_variables
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+
+    model = RAFT(RAFTConfig(small=True))
+    variables = load_variables(small_ckpt, model,
+                               sample_shape=(1, 64, 96, 3))
+    assert "params" in variables
+    n = sum(x.size for x in jax.tree.leaves(variables["params"]))
+    assert n > 900_000  # RAFT-small ~1M params

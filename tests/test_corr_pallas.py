@@ -1,0 +1,134 @@
+"""Parity tests for the fused Pallas on-demand correlation kernel.
+
+The kernel (ops/corr_pallas.py) replaces alt_cuda_corr/correlation_kernel.cu;
+its oracle is ``alternate_corr_lookup``, which test_ops_corr.py proves equal
+to the all-pairs path.  On CPU the kernel runs in Pallas interpret mode.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.ops.corr import (
+    all_pairs_correlation,
+    alternate_corr_lookup,
+    build_corr_pyramid,
+    build_fmap_pyramid,
+    corr_lookup,
+)
+from raft_tpu.ops.corr_pallas import ondemand_corr_lookup
+
+
+def _inputs(B=2, H=8, W=12, C=16, levels=3, seed=0):
+    rng = np.random.default_rng(seed)
+    f1 = jnp.asarray(rng.standard_normal((B, H, W, C)).astype(np.float32))
+    f2 = jnp.asarray(rng.standard_normal((B, H, W, C)).astype(np.float32))
+    base = np.stack(np.meshgrid(np.arange(W), np.arange(H)), -1)
+    coords = jnp.asarray(
+        (rng.standard_normal((B, H, W, 2)) * 4 + base[None]).astype(np.float32))
+    return f1, f2, tuple(build_fmap_pyramid(f2, levels)), coords
+
+
+@pytest.mark.parametrize("radius", [2, 4])
+@pytest.mark.parametrize("q_tile", [32, 64])
+def test_forward_matches_lax_oracle(radius, q_tile):
+    f1, _, pyr, coords = _inputs()
+    ref = alternate_corr_lookup(f1, pyr, coords, radius)
+    out = ondemand_corr_lookup(f1, pyr, coords, radius, q_tile)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_forward_matches_all_pairs_path():
+    """End-to-end ordering parity with the CorrBlock path: levels
+    level-major, windows x-major (core/corr.py:37-50)."""
+    f1, f2, pyr, coords = _inputs(levels=3)
+    dense = corr_lookup(build_corr_pyramid(
+        all_pairs_correlation(f1, f2), 3), coords, 3)
+    out = ondemand_corr_lookup(f1, pyr, coords, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_query_padding_path():
+    """Q = H*W not a multiple of q_tile exercises the pad-and-slice path."""
+    f1, _, pyr, coords = _inputs(H=6, W=6)  # Q = 36
+    ref = alternate_corr_lookup(f1, pyr, coords, 2)
+    out = ondemand_corr_lookup(f1, pyr, coords, 2, 32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_far_out_of_bounds_coords_are_zero():
+    """Wildly OOB centroids must produce exact zeros (bilinear_sampler's
+    zero padding, utils.py:61-65), via the clamped zero border."""
+    f1, _, pyr, coords = _inputs()
+    coords = coords.at[0, 0, 0].set(jnp.array([-100.0, 1000.0]))
+    coords = coords.at[1, 2, 3].set(jnp.array([500.0, -500.0]))
+    out = ondemand_corr_lookup(f1, pyr, coords, 3)
+    assert float(jnp.abs(out[0, 0, 0]).max()) == 0.0
+    assert float(jnp.abs(out[1, 2, 3]).max()) == 0.0
+    ref = alternate_corr_lookup(f1, pyr, coords, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_vjp_matches_lax_oracle():
+    """d_fmap1 and every d_fmap2 level match the oracle's autodiff.
+
+    This is a capability the reference never had: its AlternateCorrBlock
+    calls alt_cuda_corr.forward without an autograd wrapper, so no
+    gradient flows (SURVEY.md #5).
+    """
+    f1, _, pyr, coords = _inputs(H=6, W=8, C=8, levels=2)
+    radius = 2
+    key = jnp.asarray(np.random.default_rng(1).standard_normal(
+        (2, 6, 8, 2 * (2 * radius + 1) ** 2)).astype(np.float32))
+
+    def loss_ref(f1, pyr):
+        return jnp.sum(alternate_corr_lookup(f1, pyr, coords, radius) * key)
+
+    def loss_new(f1, pyr):
+        return jnp.sum(ondemand_corr_lookup(f1, pyr, coords, radius, 16) * key)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(f1, pyr)
+    g_new = jax.grad(loss_new, argnums=(0, 1))(f1, pyr)
+    np.testing.assert_allclose(np.asarray(g_new[0]), np.asarray(g_ref[0]),
+                               atol=1e-4, rtol=1e-4)
+    for a, b in zip(g_new[1], g_ref[1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_coords_gradient_is_zero():
+    """d(coords) = 0 by design (dead coords_grad in the CUDA backward,
+    correlation_kernel.cu:307; stop_gradient on coords in the model)."""
+    f1, _, pyr, coords = _inputs(H=6, W=6, C=8, levels=2)
+    g = jax.grad(lambda c: jnp.sum(
+        ondemand_corr_lookup(f1, pyr, c, 2, 16)))(coords)
+    assert float(jnp.abs(g).max()) == 0.0
+
+
+def test_model_with_pallas_corr():
+    """RAFT forward with cfg.alternate_corr + corr_impl='pallas' matches
+    the all-pairs model output."""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+
+    rng = np.random.default_rng(0)
+    img1 = jnp.asarray(rng.uniform(0, 255, (1, 64, 96, 3)).astype(np.float32))
+    img2 = jnp.asarray(rng.uniform(0, 255, (1, 64, 96, 3)).astype(np.float32))
+
+    base = RAFT(RAFTConfig(small=True))
+    variables = base.init(jax.random.PRNGKey(0), img1, img2, iters=2)
+    out_dense = base.apply(variables, img1, img2, iters=3, test_mode=True)
+
+    alt = RAFT(RAFTConfig(small=True, alternate_corr=True,
+                          corr_impl="pallas"))
+    out_alt = alt.apply(variables, img1, img2, iters=3, test_mode=True)
+    # Sub-1e-5 corr differences amplify through the recurrent iterations;
+    # 0.05 px on flows spanning hundreds of px is numerical noise.
+    np.testing.assert_allclose(np.asarray(out_alt[1]),
+                               np.asarray(out_dense[1]),
+                               atol=5e-2, rtol=5e-3)
