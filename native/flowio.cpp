@@ -1,0 +1,357 @@
+// raftio — native data-plane for raft_tpu.
+//
+// C++ counterparts of the hot host-side I/O in the data pipeline
+// (raft_tpu/data/frame_utils.py; format parity with the reference's
+// core/utils/frame_utils.py:12-137):
+//
+//   - Middlebury .flo read/write      (frame_utils.py:12-31, 70-99)
+//   - PFM read (flip + endian)        (frame_utils.py:33-68)
+//   - binary PPM (P6) read            (FlyingChairs images)
+//   - KITTI 16-bit PNG flow read/write ((v*64)+2^15 encoding,
+//                                      frame_utils.py:102-120), via libpng
+//   - a thread-pool batch decoder that overlaps file reads and decodes
+//     across samples (the role of torch DataLoader's worker processes,
+//     reference datasets.py:230) behind one blocking call.
+//
+// Exposed as a plain C ABI consumed with ctypes from
+// raft_tpu/utils/native.py (no pybind11 in this environment).
+// All out-buffers are malloc'd here and released with raftio_free.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <png.h>
+
+namespace {
+
+constexpr float kFloMagic = 202021.25f;
+
+bool host_is_little_endian() {
+    const uint16_t one = 1;
+    return *reinterpret_cast<const uint8_t*>(&one) == 1;
+}
+
+void byteswap_f32(float* data, size_t n) {
+    auto* p = reinterpret_cast<uint32_t*>(data);
+    for (size_t i = 0; i < n; ++i) {
+        uint32_t v = p[i];
+        p[i] = (v >> 24) | ((v >> 8) & 0xff00u) | ((v << 8) & 0xff0000u)
+               | (v << 24);
+    }
+}
+
+// Reads one whitespace-delimited token, skipping PNM-style comments.
+bool next_token(FILE* f, std::string* tok) {
+    tok->clear();
+    int c;
+    while ((c = fgetc(f)) != EOF) {
+        if (c == '#') {  // comment to end of line
+            while ((c = fgetc(f)) != EOF && c != '\n') {
+            }
+            continue;
+        }
+        if (!isspace(c)) break;
+    }
+    if (c == EOF) return false;
+    do {
+        tok->push_back(static_cast<char>(c));
+    } while ((c = fgetc(f)) != EOF && !isspace(c));
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void raftio_free(void* p) { free(p); }
+
+// ---------------------------------------------------------------------------
+// Middlebury .flo
+// ---------------------------------------------------------------------------
+
+// -> 0 ok; 1 open; 2 magic; 3 header; 4 payload.
+int raftio_flo_read(const char* path, float** data, int* w, int* h) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return 1;
+    float magic = 0.f;
+    if (fread(&magic, 4, 1, f) != 1 || magic != kFloMagic) {
+        fclose(f);
+        return 2;
+    }
+    int32_t wd = 0, ht = 0;
+    if (fread(&wd, 4, 1, f) != 1 || fread(&ht, 4, 1, f) != 1 || wd <= 0
+        || ht <= 0 || int64_t(wd) * ht > (1u << 30)) {
+        fclose(f);
+        return 3;
+    }
+    size_t n = size_t(wd) * ht * 2;
+    float* buf = static_cast<float*>(malloc(n * 4));
+    if (!buf || fread(buf, 4, n, f) != n) {
+        free(buf);
+        fclose(f);
+        return 4;
+    }
+    fclose(f);
+    *data = buf;
+    *w = wd;
+    *h = ht;
+    return 0;
+}
+
+int raftio_flo_write(const char* path, const float* data, int w, int h) {
+    FILE* f = fopen(path, "wb");
+    if (!f) return 1;
+    int32_t wd = w, ht = h;
+    size_t n = size_t(w) * h * 2;
+    bool ok = fwrite(&kFloMagic, 4, 1, f) == 1 && fwrite(&wd, 4, 1, f) == 1
+              && fwrite(&ht, 4, 1, f) == 1 && fwrite(data, 4, n, f) == n;
+    fclose(f);
+    return ok ? 0 : 4;
+}
+
+// ---------------------------------------------------------------------------
+// PFM (FlyingThings3D flow ground truth)
+// ---------------------------------------------------------------------------
+
+// channels: 1 (Pf) or 3 (PF). Rows are returned top-down (the file is
+// bottom-up; the flip matches frame_utils.py:61). -> 0 ok.
+int raftio_pfm_read(const char* path, float** data, int* w, int* h,
+                    int* channels) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return 1;
+    std::string tok;
+    if (!next_token(f, &tok) || (tok != "PF" && tok != "Pf")) {
+        fclose(f);
+        return 2;
+    }
+    const int ch = tok == "PF" ? 3 : 1;
+    std::string ws, hs, ss;
+    if (!next_token(f, &ws) || !next_token(f, &hs) || !next_token(f, &ss)) {
+        fclose(f);
+        return 3;
+    }
+    const int wd = atoi(ws.c_str());
+    const int ht = atoi(hs.c_str());
+    const double scale = atof(ss.c_str());
+    if (wd <= 0 || ht <= 0 || scale == 0.0
+        || int64_t(wd) * ht * ch > (1 << 30)) {
+        fclose(f);
+        return 3;
+    }
+    const size_t n = size_t(wd) * ht * ch;
+    float* buf = static_cast<float*>(malloc(n * 4));
+    if (!buf || fread(buf, 4, n, f) != n) {
+        free(buf);
+        fclose(f);
+        return 4;
+    }
+    fclose(f);
+    const bool file_le = scale < 0;
+    if (file_le != host_is_little_endian()) byteswap_f32(buf, n);
+    // bottom-up -> top-down
+    const size_t row = size_t(wd) * ch;
+    std::vector<float> tmp(row);
+    for (int y = 0; y < ht / 2; ++y) {
+        float* a = buf + size_t(y) * row;
+        float* b = buf + size_t(ht - 1 - y) * row;
+        memcpy(tmp.data(), a, row * 4);
+        memcpy(a, b, row * 4);
+        memcpy(b, tmp.data(), row * 4);
+    }
+    *data = buf;
+    *w = wd;
+    *h = ht;
+    *channels = ch;
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// PPM P6 (FlyingChairs images)
+// ---------------------------------------------------------------------------
+
+int raftio_ppm_read(const char* path, uint8_t** data, int* w, int* h) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return 1;
+    std::string magic, ws, hs, maxv;
+    if (!next_token(f, &magic) || magic != "P6" || !next_token(f, &ws)
+        || !next_token(f, &hs) || !next_token(f, &maxv)) {
+        fclose(f);
+        return 2;
+    }
+    const int wd = atoi(ws.c_str());
+    const int ht = atoi(hs.c_str());
+    if (wd <= 0 || ht <= 0 || atoi(maxv.c_str()) != 255
+        || int64_t(wd) * ht * 3 > (1 << 30)) {
+        fclose(f);
+        return 3;
+    }
+    const size_t n = size_t(wd) * ht * 3;
+    uint8_t* buf = static_cast<uint8_t*>(malloc(n));
+    if (!buf || fread(buf, 1, n, f) != n) {
+        free(buf);
+        fclose(f);
+        return 4;
+    }
+    fclose(f);
+    *data = buf;
+    *w = wd;
+    *h = ht;
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// KITTI 16-bit PNG optical flow (libpng)
+// ---------------------------------------------------------------------------
+
+// flow: (H, W, 2) float32 = (u16 - 2^15)/64; valid: (H, W) float32 from
+// the third channel (frame_utils.py:102-107). -> 0 ok.
+int raftio_png16_flow_read(const char* path, float** flow, float** valid,
+                           int* w, int* h) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return 1;
+    png_structp png = png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr,
+                                             nullptr, nullptr);
+    png_infop info = png ? png_create_info_struct(png) : nullptr;
+    if (!info || setjmp(png_jmpbuf(png))) {
+        png_destroy_read_struct(&png, &info, nullptr);
+        fclose(f);
+        return 2;
+    }
+    png_init_io(png, f);
+    png_read_info(png, info);
+    const int wd = png_get_image_width(png, info);
+    const int ht = png_get_image_height(png, info);
+    const int depth = png_get_bit_depth(png, info);
+    int color = png_get_color_type(png, info);
+    if (depth != 16) {
+        png_destroy_read_struct(&png, &info, nullptr);
+        fclose(f);
+        return 3;
+    }
+    if (color == PNG_COLOR_TYPE_RGBA) png_set_strip_alpha(png);
+    png_read_update_info(png, info);
+    const size_t rowbytes = png_get_rowbytes(png, info);
+    std::vector<uint8_t> raw(rowbytes * ht);
+    std::vector<png_bytep> rows(ht);
+    for (int y = 0; y < ht; ++y) rows[y] = raw.data() + y * rowbytes;
+    png_read_image(png, rows.data());
+    png_destroy_read_struct(&png, &info, nullptr);
+    fclose(f);
+
+    float* fl = static_cast<float*>(malloc(size_t(wd) * ht * 2 * 4));
+    float* va = static_cast<float*>(malloc(size_t(wd) * ht * 4));
+    if (!fl || !va) {
+        free(fl);
+        free(va);
+        return 4;
+    }
+    for (int y = 0; y < ht; ++y) {
+        const uint8_t* row = raw.data() + y * rowbytes;
+        for (int x = 0; x < wd; ++x) {
+            // PNG stores 16-bit samples big-endian
+            const uint16_t u = (row[x * 6 + 0] << 8) | row[x * 6 + 1];
+            const uint16_t v = (row[x * 6 + 2] << 8) | row[x * 6 + 3];
+            const uint16_t ok = (row[x * 6 + 4] << 8) | row[x * 6 + 5];
+            fl[(size_t(y) * wd + x) * 2 + 0] = (float(u) - 32768.f) / 64.f;
+            fl[(size_t(y) * wd + x) * 2 + 1] = (float(v) - 32768.f) / 64.f;
+            va[size_t(y) * wd + x] = float(ok);
+        }
+    }
+    *flow = fl;
+    *valid = va;
+    *w = wd;
+    *h = ht;
+    return 0;
+}
+
+int raftio_png16_flow_write(const char* path, const float* flow, int w,
+                            int h) {
+    FILE* f = fopen(path, "wb");
+    if (!f) return 1;
+    png_structp png = png_create_write_struct(PNG_LIBPNG_VER_STRING, nullptr,
+                                              nullptr, nullptr);
+    png_infop info = png ? png_create_info_struct(png) : nullptr;
+    if (!info || setjmp(png_jmpbuf(png))) {
+        png_destroy_write_struct(&png, &info);
+        fclose(f);
+        return 2;
+    }
+    png_init_io(png, f);
+    png_set_IHDR(png, info, w, h, 16, PNG_COLOR_TYPE_RGB,
+                 PNG_INTERLACE_NONE, PNG_COMPRESSION_TYPE_DEFAULT,
+                 PNG_FILTER_TYPE_DEFAULT);
+    png_write_info(png, info);
+    std::vector<uint8_t> row(size_t(w) * 6);
+    for (int y = 0; y < h; ++y) {
+        for (int x = 0; x < w; ++x) {
+            const double u = 64.0 * flow[(size_t(y) * w + x) * 2 + 0] + 32768.0;
+            const double v = 64.0 * flow[(size_t(y) * w + x) * 2 + 1] + 32768.0;
+            const uint16_t uu = static_cast<uint16_t>(u);
+            const uint16_t vv = static_cast<uint16_t>(v);
+            row[x * 6 + 0] = uu >> 8;
+            row[x * 6 + 1] = uu & 0xff;
+            row[x * 6 + 2] = vv >> 8;
+            row[x * 6 + 3] = vv & 0xff;
+            row[x * 6 + 4] = 0;  // valid = 1
+            row[x * 6 + 5] = 1;
+        }
+        png_write_row(png, row.data());
+    }
+    png_write_end(png, nullptr);
+    png_destroy_write_struct(&png, &info);
+    fclose(f);
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Thread-pool batch flow decode
+// ---------------------------------------------------------------------------
+
+// Decodes n .flo files concurrently into caller-provided per-item slots.
+// kinds[i]: 0 = .flo, 1 = .pfm (first 2 channels).  Returns the number
+// of failures; data[i] is null for failed items.
+int raftio_batch_flow_read(const char** paths, const int* kinds, int n,
+                           int n_threads, float** data, int* ws, int* hs) {
+    std::vector<int> errs(n, 0);
+    std::vector<std::thread> workers;
+    const int nt = n_threads < 1 ? 1 : (n_threads > n ? n : n_threads);
+    for (int t = 0; t < nt; ++t) {
+        workers.emplace_back([&, t]() {
+            for (int i = t; i < n; i += nt) {
+                data[i] = nullptr;
+                if (kinds[i] == 0) {
+                    errs[i] = raftio_flo_read(paths[i], &data[i], &ws[i],
+                                              &hs[i]);
+                } else {
+                    float* buf = nullptr;
+                    int w = 0, h = 0, ch = 0;
+                    errs[i] = raftio_pfm_read(paths[i], &buf, &w, &h, &ch);
+                    if (errs[i] == 0) {
+                        // keep (u, v): PFM flow files carry 3 channels
+                        float* fl = static_cast<float*>(
+                            malloc(size_t(w) * h * 2 * 4));
+                        for (int64_t p = 0; p < int64_t(w) * h; ++p) {
+                            fl[p * 2 + 0] = buf[p * ch + 0];
+                            fl[p * 2 + 1] = ch > 1 ? buf[p * ch + 1] : 0.f;
+                        }
+                        free(buf);
+                        data[i] = fl;
+                        ws[i] = w;
+                        hs[i] = h;
+                    }
+                }
+            }
+        });
+    }
+    for (auto& th : workers) th.join();
+    int fails = 0;
+    for (int e : errs) fails += e != 0;
+    return fails;
+}
+
+}  // extern "C"

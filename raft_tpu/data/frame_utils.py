@@ -16,7 +16,15 @@ FLO_MAGIC = 202021.25  # Middlebury sanity-check value (frame_utils.py:10)
 
 
 def read_flow(path: str) -> np.ndarray:
-    """Read a Middlebury .flo file -> (H, W, 2) float32."""
+    """Read a Middlebury .flo file -> (H, W, 2) float32.
+
+    Uses the native decoder (native/flowio.cpp via utils.native) when
+    available; the numpy path below is the fallback and the oracle."""
+    from raft_tpu.utils import native
+
+    out = native.read_flow(path)
+    if out is not None:
+        return out
     with open(path, "rb") as f:
         magic = np.fromfile(f, np.float32, count=1)
         if magic.size == 0 or magic[0] != FLO_MAGIC:
@@ -41,6 +49,11 @@ def write_flow(path: str, flow: np.ndarray) -> None:
 def read_pfm(path: str) -> np.ndarray:
     """Read a PFM file -> float32 array (H, W) or (H, W, 3), bottom-up
     flipped to top-down (frame_utils.py:33-68 semantics)."""
+    from raft_tpu.utils import native
+
+    out = native.read_pfm(path)
+    if out is not None:
+        return out
     with open(path, "rb") as f:
         header = f.readline().rstrip()
         if header == b"PF":
@@ -67,6 +80,11 @@ def read_flow_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
     Encoding: u16 = flow * 64 + 2^15; third channel is validity
     (frame_utils.py:102-107).
     """
+    from raft_tpu.utils import native
+
+    out = native.read_flow_kitti(path)
+    if out is not None:
+        return out
     import cv2
 
     raw = cv2.imread(path, cv2.IMREAD_ANYDEPTH | cv2.IMREAD_COLOR)

@@ -1,0 +1,210 @@
+"""ctypes bindings for the raftio native data-plane (native/flowio.cpp).
+
+The shared object is built lazily on first use (g++ via native/Makefile)
+and cached; every entry point degrades gracefully — callers get ``None``
+from :func:`get_lib` when no compiler is available and fall back to the
+pure-Python implementations in raft_tpu/data/frame_utils.py.
+
+The reference's only native component is the CUDA correlation sampler
+(alt_cuda_corr/); its TPU equivalent is the Pallas kernel
+(ops/corr_pallas.py).  This library is the native half of the *data*
+plane: format decoders plus a thread-pool batch reader standing in for
+torch DataLoader's worker processes (reference datasets.py:230).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libraftio.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+_c_float_p = ctypes.POINTER(ctypes.c_float)
+_c_ubyte_p = ctypes.POINTER(ctypes.c_ubyte)
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "libraftio.so"],
+                       check=True, capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def _bind(lib) -> None:
+    lib.raftio_free.argtypes = [ctypes.c_void_p]
+    lib.raftio_flo_read.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(_c_float_p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.raftio_flo_write.argtypes = [
+        ctypes.c_char_p, _c_float_p, ctypes.c_int, ctypes.c_int]
+    lib.raftio_pfm_read.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(_c_float_p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.raftio_ppm_read.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(_c_ubyte_p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.raftio_png16_flow_read.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(_c_float_p),
+        ctypes.POINTER(_c_float_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.raftio_png16_flow_write.argtypes = [
+        ctypes.c_char_p, _c_float_p, ctypes.c_int, ctypes.c_int]
+    lib.raftio_batch_flow_read.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(_c_float_p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+
+
+def get_lib():
+    """The loaded library, building it if needed; None when unavailable.
+
+    Opt out by setting RAFT_TPU_NO_NATIVE=1.
+    """
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("RAFT_TPU_NO_NATIVE"):
+            return None
+        try:
+            if not os.path.exists(_SO_PATH) and not _build():
+                return None
+            lib = ctypes.CDLL(_SO_PATH)
+            _bind(lib)
+            _lib = lib
+        except Exception:
+            _lib = None
+    return _lib
+
+
+def _take_f32(lib, ptr, shape) -> np.ndarray:
+    n = int(np.prod(shape))
+    out = np.ctypeslib.as_array(ptr, shape=(n,)).reshape(shape).copy()
+    lib.raftio_free(ptr)
+    return out
+
+
+def read_flow(path: str) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = _c_float_p()
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    if lib.raftio_flo_read(path.encode(), ctypes.byref(data),
+                           ctypes.byref(w), ctypes.byref(h)) != 0:
+        return None
+    return _take_f32(lib, data, (h.value, w.value, 2))
+
+
+def write_flow(path: str, flow: np.ndarray) -> bool:
+    lib = get_lib()
+    if lib is None:
+        return False
+    flow = np.ascontiguousarray(flow, np.float32)
+    return lib.raftio_flo_write(
+        path.encode(), flow.ctypes.data_as(_c_float_p),
+        flow.shape[1], flow.shape[0]) == 0
+
+
+def read_pfm(path: str) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = _c_float_p()
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    ch = ctypes.c_int()
+    if lib.raftio_pfm_read(path.encode(), ctypes.byref(data),
+                           ctypes.byref(w), ctypes.byref(h),
+                           ctypes.byref(ch)) != 0:
+        return None
+    shape = ((h.value, w.value) if ch.value == 1
+             else (h.value, w.value, ch.value))
+    return _take_f32(lib, data, shape)
+
+
+def read_ppm(path: str) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = _c_ubyte_p()
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    if lib.raftio_ppm_read(path.encode(), ctypes.byref(data),
+                           ctypes.byref(w), ctypes.byref(h)) != 0:
+        return None
+    n = h.value * w.value * 3
+    out = np.ctypeslib.as_array(data, shape=(n,)).reshape(
+        h.value, w.value, 3).copy()
+    lib.raftio_free(data)
+    return out
+
+
+def read_flow_kitti(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    flow = _c_float_p()
+    valid = _c_float_p()
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    if lib.raftio_png16_flow_read(path.encode(), ctypes.byref(flow),
+                                  ctypes.byref(valid), ctypes.byref(w),
+                                  ctypes.byref(h)) != 0:
+        return None
+    return (_take_f32(lib, flow, (h.value, w.value, 2)),
+            _take_f32(lib, valid, (h.value, w.value)))
+
+
+def write_flow_kitti(path: str, flow: np.ndarray) -> bool:
+    lib = get_lib()
+    if lib is None:
+        return False
+    flow = np.ascontiguousarray(flow, np.float32)
+    return lib.raftio_png16_flow_write(
+        path.encode(), flow.ctypes.data_as(_c_float_p),
+        flow.shape[1], flow.shape[0]) == 0
+
+
+def batch_read_flows(paths, n_threads: int = 4):
+    """Thread-pool decode of many .flo/.pfm flow files at once.
+
+    Returns a list of (H, W, 2) arrays (None per failed item), or None
+    when the native library is unavailable.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(paths)
+    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    kinds = (ctypes.c_int * n)(
+        *[1 if p.lower().endswith(".pfm") else 0 for p in paths])
+    datas = (_c_float_p * n)()
+    ws = (ctypes.c_int * n)()
+    hs = (ctypes.c_int * n)()
+    lib.raftio_batch_flow_read(c_paths, kinds, n, n_threads, datas, ws, hs)
+    out = []
+    for i in range(n):
+        if datas[i]:
+            out.append(_take_f32(lib, datas[i], (hs[i], ws[i], 2)))
+        else:
+            out.append(None)
+    return out
