@@ -137,6 +137,8 @@ def train(args) -> str:
     from raft_tpu.parallel.step import (make_parallel_train_step,
                                         replicate_state)
     from raft_tpu.training import create_train_state, make_optimizer
+    from raft_tpu.training.checkpoint_async import (
+        AsyncCheckpointer, install_preemption_handler, preempted)
     from raft_tpu.training.logger import Logger
     from raft_tpu.training.state import (latest_checkpoint, restore_checkpoint,
                                          save_checkpoint)
@@ -206,6 +208,8 @@ def train(args) -> str:
                     enable_tensorboard=not args.no_tensorboard,
                     start_step=start_step)
     os.makedirs(train_cfg.checkpoint_dir, exist_ok=True)
+    checkpointer = AsyncCheckpointer()
+    install_preemption_handler()
 
     total_steps = start_step
     num_steps = train_cfg.num_steps
@@ -228,11 +232,31 @@ def train(args) -> str:
         logger.push(metrics)
         total_steps += 1
 
+        if preempted():
+            # SIGTERM/SIGINT: synchronous final save, then bail; --resume
+            # picks up from here (the recovery path the reference lacks).
+            path = os.path.join(train_cfg.checkpoint_dir,
+                                f"{total_steps}_{train_cfg.name}.msgpack")
+            try:
+                checkpointer.wait()
+            except Exception as e:
+                # a failed earlier async save must not abort the rescue
+                print(f"warning: pending async save failed: {e}")
+            save_checkpoint(path, jax.device_get(state))
+            print(f"preempted: saved {path}")
+            logger.close()
+            return path
+
         if total_steps % train_cfg.val_freq == train_cfg.val_freq - 1:
             path = os.path.join(train_cfg.checkpoint_dir,
                                 f"{total_steps + 1}_{train_cfg.name}.msgpack")
-            save_checkpoint(path, jax.device_get(state))
-            print(f"saved {path}")
+            try:
+                checkpointer.save(path, state)  # overlaps with training
+                print(f"saving {path} (async)")
+            except Exception as e:
+                # a failed earlier save must not kill training; the next
+                # periodic/final save retries with fresh state
+                print(f"warning: async checkpoint save failed: {e}")
             if args.validation:
                 variables = {"params": jax.device_get(state.params)}
                 if state.batch_stats:
@@ -247,6 +271,11 @@ def train(args) -> str:
 
     final = os.path.join(train_cfg.checkpoint_dir,
                          f"{train_cfg.name}.msgpack")
+    try:
+        checkpointer.wait()
+    except Exception as e:
+        # the final synchronous save below must still run
+        print(f"warning: pending async save failed: {e}")
     save_checkpoint(final, jax.device_get(state))
     logger.close()
     print(f"saved final checkpoint {final}")

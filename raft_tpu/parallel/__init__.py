@@ -7,6 +7,10 @@ from raft_tpu.parallel.mesh import (
 )
 from raft_tpu.parallel.step import make_parallel_train_step
 from raft_tpu.parallel.dist import initialize_distributed
+from raft_tpu.parallel.ring import (
+    ring_all_pairs_correlation,
+    ring_corr_pyramid,
+)
 
 __all__ = [
     "make_mesh",
@@ -16,4 +20,6 @@ __all__ = [
     "constrain",
     "make_parallel_train_step",
     "initialize_distributed",
+    "ring_all_pairs_correlation",
+    "ring_corr_pyramid",
 ]

@@ -1,0 +1,123 @@
+"""Ring-permute sharded correlation — the sequence-parallel analogue.
+
+The reference's scaling wall is the O((H*W)^2) all-pairs volume
+(core/corr.py:19-22; its answer is the CUDA on-demand kernel).  For
+resolutions where even the *feature maps* should not be replicated,
+this module provides the ring-attention-style construction over the
+mesh's ``spatial`` axis:
+
+- queries (fmap1 rows) stay resident, sharded over ``spatial``;
+- fmap2 target shards rotate around the ring via ``lax.ppermute`` —
+  one neighbor hop per step, riding ICI;
+- each device accumulates its (Q_local, T) correlation rows one target
+  block per step, overlapping the MXU matmul of block i with the DMA of
+  block i+1 (XLA schedules the ppermute/dot overlap);
+- no device ever materializes all of fmap2 or any full-volume slice
+  beyond its own query rows.
+
+The result is exactly the query-sharded layout that
+``corr_lookup(..., shard=True)`` (GSPMD path) consumes, so the pyramid
+and windowed lookup proceed locally with zero further communication.
+
+This is the TPU-native counterpart of what NCCL ring collectives would
+do in a torch port — expressed as one jitted SPMD program instead of a
+communication library (SURVEY.md §2.3, §5 long-context row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
+
+
+def _ring_rows(f1_local: jax.Array, f2_shard: jax.Array,
+               axis_name: str, num_shards: int) -> jax.Array:
+    """Per-device body: accumulate this device's correlation rows.
+
+    f1_local: (B, Qd, C) resident query features.
+    f2_shard: (B, Ts, C) current target shard (rotates).
+    Returns (B, Qd, num_shards*Ts) float32 rows, normalized by sqrt(C).
+    """
+    B, Qd, C = f1_local.shape
+    Ts = f2_shard.shape[1]
+    idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.float32(C))
+    out = jnp.zeros((B, Qd, num_shards * Ts), jnp.float32)
+    f1 = f1_local.astype(jnp.float32)
+
+    perm = [(j, (j + 1) % num_shards) for j in range(num_shards)]
+    f2_cur = f2_shard
+    for i in range(num_shards):
+        block = jnp.einsum("bqc,btc->bqt", f1, f2_cur.astype(jnp.float32),
+                           preferred_element_type=jnp.float32) * scale
+        # after i forward rotations, this device holds global shard
+        # (idx - i) mod S
+        src = (idx - i) % num_shards
+        out = jax.lax.dynamic_update_slice(
+            out, block, (0, 0, src * Ts))
+        if i + 1 < num_shards:
+            f2_cur = jax.lax.ppermute(f2_cur, axis_name, perm)
+    return out
+
+
+def ring_all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array,
+                               mesh: Mesh,
+                               axis: str = SPATIAL_AXIS) -> jax.Array:
+    """All-pairs correlation with ring-rotated fmap2 shards.
+
+    Semantically identical to ``all_pairs_correlation`` (the oracle the
+    tests compare against); layout-wise the output rows are sharded over
+    ``axis`` on the query dimension, targets x-ordered as row-major
+    (H2, W2) flattening — the same (B, Q, H2, W2) volume after reshape.
+
+    Args:
+      fmap1, fmap2: (B, H, W, C) feature maps (replicated or sharded on
+        entry; shard_map re-lays them out).
+      mesh: active device mesh with ``axis``.
+
+    Returns:
+      (B, H*W, H, W) float32 volume, batch sharded over the data axis
+      and the query axis sharded over ``axis``.
+    """
+    B, H, W, C = fmap1.shape
+    Q = H * W
+    S = mesh.shape[axis]
+    if Q % S != 0:
+        raise ValueError(f"query count {Q} not divisible by "
+                         f"{axis}={S} shards")
+
+    f1q = fmap1.reshape(B, Q, C)
+    f2t = fmap2.reshape(B, Q, C)
+
+    fn = shard_map(
+        functools.partial(_ring_rows, axis_name=axis, num_shards=S),
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, axis, None), P(DATA_AXIS, axis, None)),
+        out_specs=P(DATA_AXIS, axis, None),
+    )
+    rows = fn(f1q, f2t)  # (B, Q, T) query-sharded
+    return rows.reshape(B, Q, H, W)
+
+
+def ring_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array, mesh: Mesh,
+                      num_levels: int = 4,
+                      axis: str = SPATIAL_AXIS) -> List[jax.Array]:
+    """Ring-built volume + target-axis pyramid, kept query-sharded.
+
+    Drop-in for ``build_corr_pyramid(all_pairs_correlation(...))`` under
+    a mesh: pooling acts on the (local) target axes, so each level
+    inherits the query sharding with no communication.
+    """
+    from raft_tpu.ops.corr import build_corr_pyramid
+
+    vol = ring_all_pairs_correlation(fmap1, fmap2, mesh, axis)
+    pyr = build_corr_pyramid(vol, num_levels)
+    return [constrain(p, P(DATA_AXIS, axis, None, None)) for p in pyr]

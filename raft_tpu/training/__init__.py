@@ -1,6 +1,13 @@
 from raft_tpu.training.loss import sequence_loss, flow_metrics
 from raft_tpu.training.optim import make_optimizer, onecycle_linear_schedule
 from raft_tpu.training.state import TrainState, create_train_state
+from raft_tpu.training.logger import Logger
+from raft_tpu.training.checkpoint_async import (
+    AsyncCheckpointer,
+    install_preemption_handler,
+    preempted,
+)
+from raft_tpu.training.profiler import StepTimer, trace
 
 __all__ = [
     "sequence_loss",
@@ -9,4 +16,10 @@ __all__ = [
     "onecycle_linear_schedule",
     "TrainState",
     "create_train_state",
+    "Logger",
+    "AsyncCheckpointer",
+    "install_preemption_handler",
+    "preempted",
+    "StepTimer",
+    "trace",
 ]

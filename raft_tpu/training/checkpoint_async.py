@@ -1,0 +1,96 @@
+"""Asynchronous checkpointing + preemption-safe saves.
+
+The reference loses everything since the last 5000-step save on a crash
+(train.py:185-187) and blocks training while torch.save runs.  Here:
+
+- :class:`AsyncCheckpointer` — device_get on the caller's thread (cheap,
+  must happen before the state is donated/updated), then msgpack
+  serialization + file write on a background thread, with an atomic
+  rename so a preemption mid-write never corrupts the latest checkpoint;
+- :func:`install_preemption_handler` — SIGTERM/SIGINT hook that flags a
+  final save, the failure-detection mechanism the reference lacks
+  (SURVEY.md §5); training loops check :func:`preempted` each step.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Callable, Optional
+
+from raft_tpu.training.state import TrainState, save_checkpoint
+
+_preempted = threading.Event()
+
+
+def preempted() -> bool:
+    return _preempted.is_set()
+
+
+def clear_preemption() -> None:
+    _preempted.clear()
+
+
+def install_preemption_handler(extra: Optional[Callable] = None) -> None:
+    """Route SIGTERM/SIGINT to a save-and-exit flag instead of a kill.
+
+    The flag is only checked between training steps, so a second signal
+    (e.g. the process is hung in compilation or data loading) kills the
+    process immediately via the default disposition.  Clears any flag
+    left over from a previous run in this process.
+    """
+    _preempted.clear()
+
+    def _handler(signum, frame):
+        if _preempted.is_set():
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        _preempted.set()
+        if extra is not None:
+            extra()
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
+
+
+class AsyncCheckpointer:
+    """One in-flight background save; subsequent saves wait for it.
+
+    Usage:
+        ckpt = AsyncCheckpointer()
+        ...
+        ckpt.save(path, state)   # returns immediately
+        ...
+        ckpt.wait()              # before process exit
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, path: str, state: TrainState) -> None:
+        import jax
+
+        self.wait()  # serialize in-flight saves; surfaces prior errors
+        host_state = jax.device_get(state)
+
+        def _write():
+            try:
+                tmp = path + ".tmp"
+                save_checkpoint(tmp, host_state)
+                os.replace(tmp, path)  # atomic on POSIX
+            except BaseException as e:  # surfaced on next save/wait
+                self._error = e
+
+        self._thread = threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err

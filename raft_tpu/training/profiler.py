@@ -1,0 +1,98 @@
+"""Tracing / profiling helpers.
+
+The reference has no profiling at all (``time`` imported but unused,
+train.py:8 — SURVEY.md §5).  TPU-native surface:
+
+- :func:`trace` — context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable trace;
+- :class:`StepTimer` — wall-clock step timing with correct device
+  synchronization (on some transports — e.g. tunneled single-chip dev
+  setups — ``block_until_ready`` returns before execution finishes, so
+  synchronization here is a one-element host copy, the only reliable
+  barrier);
+- :func:`device_memory_stats` — HBM usage snapshot.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "runs/profile"):
+    """Capture a jax.profiler trace viewable in TensorBoard's Profile tab."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def sync(tree) -> None:
+    """Reliable device barrier: host-copy one element of one leaf."""
+    leaves = [x for x in _tree_leaves(tree) if hasattr(x, "ravel")]
+    if leaves:
+        np.asarray(leaves[-1].ravel()[0])
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+class StepTimer:
+    """Rolling step timer: call :meth:`tick` with each step's outputs."""
+
+    def __init__(self, warmup: int = 1):
+        self.warmup = warmup
+        self.count = 0
+        self.times = []
+        self._last: Optional[float] = None
+
+    def tick(self, outputs=None) -> Optional[float]:
+        """Record one step boundary; returns the last step's seconds."""
+        if outputs is not None:
+            sync(outputs)
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            self.count += 1
+            if self.count > self.warmup:
+                dt = now - self._last
+                self.times.append(dt)
+        self._last = now
+        return dt
+
+    @property
+    def mean(self) -> float:
+        return float(np.mean(self.times)) if self.times else float("nan")
+
+    def throughput(self, items_per_step: int) -> float:
+        m = self.mean
+        return items_per_step / m if m == m and m > 0 else float("nan")
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """Per-device HBM stats (bytes) where the backend reports them."""
+    import jax
+
+    out = {}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[str(d)] = {
+                "bytes_in_use": stats.get("bytes_in_use", -1),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use", -1),
+                "bytes_limit": stats.get("bytes_limit", -1),
+            }
+    return out

@@ -87,11 +87,26 @@ def restore_checkpoint(path: str, state: TrainState,
 
 def latest_checkpoint(ckpt_dir: str, prefix: str = "") -> Optional[str]:
     """Most recently modified checkpoint in a directory (for auto-resume
-    after preemption — the failure-recovery mechanism the reference lacks)."""
+    after preemption — the failure-recovery mechanism the reference lacks).
+
+    Matches both periodic saves (``{step}_{name}.msgpack``) and the final
+    ``{name}.msgpack``."""
     if not os.path.isdir(ckpt_dir):
         return None
+
+    def _matches(f: str) -> bool:
+        if not f.endswith(".msgpack"):
+            return False
+        stem = f[:-len(".msgpack")]
+        if not prefix or stem == prefix:
+            return True
+        # step-numbered saves only — "300_small_raft" must not match
+        # prefix "raft" (shared checkpoint dirs across experiments)
+        return (stem.endswith("_" + prefix)
+                and stem[:-len(prefix) - 1].isdigit())
+
     cands = [os.path.join(ckpt_dir, f) for f in os.listdir(ckpt_dir)
-             if f.endswith(".msgpack") and f.startswith(prefix)]
+             if _matches(f)]
     if not cands:
         return None
     return max(cands, key=os.path.getmtime)

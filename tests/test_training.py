@@ -174,3 +174,23 @@ def test_bn_freeze_keeps_stats():
         for a, b in zip(jax.tree.leaves(state.batch_stats),
                         jax.tree.leaves(live_state.batch_stats)))
     assert changed
+
+
+def test_latest_checkpoint_prefix_matches_step_named_files(tmp_path):
+    """Auto-resume must find ``{step}_{name}.msgpack`` saves, the final
+    ``{name}.msgpack``, and ignore other experiments' files (regression:
+    a startswith(prefix) filter missed every step-prefixed save, so
+    --resume silently restarted from scratch)."""
+    import time as _time
+
+    for fname in ["100_exp.msgpack", "200_exp.msgpack", "other.msgpack",
+                  "300_other.msgpack", "400_small_exp.msgpack",
+                  "small_exp.msgpack"]:
+        (tmp_path / fname).write_bytes(b"x")
+        _time.sleep(0.01)
+    assert latest_checkpoint(str(tmp_path), prefix="exp") == \
+        str(tmp_path / "200_exp.msgpack")
+    (tmp_path / "exp.msgpack").write_bytes(b"x")
+    assert latest_checkpoint(str(tmp_path), prefix="exp") == \
+        str(tmp_path / "exp.msgpack")
+    assert latest_checkpoint(str(tmp_path), prefix="missing") is None
