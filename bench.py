@@ -45,9 +45,13 @@ def main():
     }
 
     # remat=True: without it the unrolled 12-iteration scan needs ~21 GB
-    # of HBM at this resolution (v5e has 15.75 GB) — rematerialisation
-    # trades the recompute for fitting on one chip.
-    cfg = RAFTConfig(small=False, compute_dtype="bfloat16", remat=True)
+    # of HBM at this resolution (v5e has 15.75 GB).  dots_saveable keeps
+    # matmul outputs and recomputes only elementwise work: 16.0 pairs/s
+    # vs 14.2 for full recompute on v5e.  corr_dtype=bfloat16 halves the
+    # volume traffic and runs the lookup matmuls at full MXU rate
+    # (f32 accumulation; ~0.5% relative error): 20.3 pairs/s.
+    cfg = RAFTConfig(small=False, compute_dtype="bfloat16", remat=True,
+                     remat_policy="dots_saveable", corr_dtype="bfloat16")
     model = RAFT(cfg)
     tx, _ = make_optimizer(lr=4e-4, num_steps=1000, wdecay=1e-4)
     state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,

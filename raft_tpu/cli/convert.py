@@ -1,0 +1,51 @@
+"""Convert reference PyTorch RAFT checkpoints (.pth) to raft_tpu .msgpack.
+
+The eval/demo CLIs load ``.pth`` files directly through
+``raft_tpu.utils.torch_import``; this tool does the conversion once so
+later loads skip torch entirely (and so converted zoo checkpoints can be
+used as ``--restore_ckpt`` curriculum seeds in the training CLI, the
+strict=False analogue of train.py:141-142).
+
+Usage:
+    python -m raft_tpu.cli.convert --input models/raft-things.pth \
+        --output checkpoints/raft-things.msgpack
+    python -m raft_tpu.cli.convert --input models/raft-small.pth \
+        --output checkpoints/raft-small.msgpack --small
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("raft_tpu checkpoint converter")
+    p.add_argument("--input", required=True, help="reference .pth checkpoint")
+    p.add_argument("--output", required=True, help="output .msgpack path")
+    p.add_argument("--small", action="store_true",
+                   help="checkpoint is a RAFT-small model (raft-small.pth)")
+    return p.parse_args(argv)
+
+
+def convert(input_path: str, output_path: str, small: bool = False) -> None:
+    import flax.serialization
+    import jax
+
+    from raft_tpu.utils.torch_import import load_torch_checkpoint
+
+    params, batch_stats = load_torch_checkpoint(input_path, small=small)
+    payload = {"params": params, "batch_stats": batch_stats or {}}
+    data = flax.serialization.msgpack_serialize(payload)
+    with open(output_path, "wb") as f:
+        f.write(data)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    print(f"wrote {output_path} ({n} params)")
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    convert(args.input, args.output, small=args.small)
+
+
+if __name__ == "__main__":
+    main()

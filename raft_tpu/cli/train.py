@@ -54,6 +54,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     # extras
     p.add_argument("--alternate_corr", action="store_true",
                    help="on-demand Pallas correlation (low HBM)")
+    p.add_argument("--corr_dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="corr pyramid storage/contraction dtype; bfloat16 "
+                        "is ~25%% faster end-to-end (f32 accumulation)")
     p.add_argument("--datasets_root", default="datasets")
     p.add_argument("--checkpoint_dir", default="checkpoints")
     p.add_argument("--log_dir", default="runs")
@@ -80,6 +84,7 @@ def build_config(args):
         dropout=args.dropout,
         alternate_corr=args.alternate_corr,
         corr_shard=args.spatial_parallel > 1,
+        **({"corr_dtype": args.corr_dtype} if args.corr_dtype else {}),
     )
     data = dataclasses.replace(
         preset.data,

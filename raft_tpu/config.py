@@ -32,9 +32,21 @@ class RAFTConfig:
     # volume and the loss stay float32 (matching the autocast boundaries at
     # raft.py:99-127 and corr.py:50).
     compute_dtype: str = "float32"  # "float32" | "bfloat16"
+    # Storage/contraction dtype for the correlation pyramid + lookup.
+    # float32 matches the reference boundary exactly (corr.py:50);
+    # bfloat16 halves volume HBM traffic and runs the lookup matmuls at
+    # full MXU rate (~0.5% relative error on corr values, which feed
+    # bf16 convs anyway under compute_dtype=bfloat16).  Accumulation is
+    # f32 either way.
+    corr_dtype: str = "float32"  # "float32" | "bfloat16"
     # Rematerialize each refinement step in the backward pass (trade FLOPs
     # for activation memory across the scan).
     remat: bool = False
+    # Selective remat: name of a jax.checkpoint_policies member (e.g.
+    # "dots_with_no_batch_dims_saveable" keeps matmul outputs and only
+    # recomputes the cheap elementwise/gather work).  Empty = save
+    # nothing (full recompute).  Only used when remat=True.
+    remat_policy: str = ""
     # Shard the correlation volume's H1*W1 query axis over the mesh's
     # 'spatial' axis (high-res configs where the O((HW)^2) volume exceeds
     # one chip's HBM).  No-op without an active mesh.
@@ -47,12 +59,27 @@ class RAFTConfig:
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"compute_dtype must be 'float32' or "
                              f"'bfloat16', got {self.compute_dtype!r}")
+        if self.corr_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"corr_dtype must be 'float32' or "
+                             f"'bfloat16', got {self.corr_dtype!r}")
         if self.alternate_corr and self.corr_shard:
             raise ValueError(
                 "corr_shard shards the materialized all-pairs volume and "
                 "has no effect on the on-demand (alternate_corr) path — "
                 "the combination would silently drop the requested "
                 "spatial parallelism; choose one")
+        if self.alternate_corr and self.corr_dtype != "float32":
+            raise ValueError(
+                "corr_dtype applies to the materialized all-pairs pyramid; "
+                "the on-demand (alternate_corr) path computes from float32 "
+                "fmap pyramids and would silently ignore it")
+        if self.remat_policy:
+            import jax
+
+            if not hasattr(jax.checkpoint_policies, self.remat_policy):
+                raise ValueError(
+                    f"remat_policy {self.remat_policy!r} is not a "
+                    f"jax.checkpoint_policies member")
 
     @property
     def hidden_dim(self) -> int:
@@ -140,47 +167,51 @@ def _stage(model: RAFTConfig, data: DataConfig, train: TrainConfig) -> Config:
 # train_mixed.sh:3-6 (1-GPU bf16 recipe). Keys: f"{stage}" and f"{stage}_mixed".
 STAGE_PRESETS = {
     "chairs": _stage(
-        RAFTConfig(),
+        RAFTConfig(remat=True, remat_policy="dots_saveable"),
         DataConfig(stage="chairs", image_size=(368, 496), batch_size=10),
         TrainConfig(name="raft-chairs", lr=4e-4, num_steps=100000, wdecay=1e-4),
     ),
     "things": _stage(
-        RAFTConfig(),
+        RAFTConfig(remat=True, remat_policy="dots_saveable"),
         DataConfig(stage="things", image_size=(400, 720), batch_size=6),
         TrainConfig(name="raft-things", lr=1.25e-4, num_steps=100000, wdecay=1e-4,
                     freeze_bn=True),
     ),
     "sintel": _stage(
-        RAFTConfig(),
+        RAFTConfig(remat=True, remat_policy="dots_saveable"),
         DataConfig(stage="sintel", image_size=(368, 768), batch_size=6),
         TrainConfig(name="raft-sintel", lr=1.25e-4, num_steps=100000, wdecay=1e-5,
                     gamma=0.85, freeze_bn=True),
     ),
     "kitti": _stage(
-        RAFTConfig(),
+        RAFTConfig(remat=True, remat_policy="dots_saveable"),
         DataConfig(stage="kitti", image_size=(288, 960), batch_size=6),
         TrainConfig(name="raft-kitti", lr=1e-4, num_steps=50000, wdecay=1e-5,
                     gamma=0.85, freeze_bn=True),
     ),
     "chairs_mixed": _stage(
-        RAFTConfig(compute_dtype="bfloat16"),
+        RAFTConfig(compute_dtype="bfloat16", remat=True,
+                   remat_policy="dots_saveable"),
         DataConfig(stage="chairs", image_size=(368, 496), batch_size=8),
         TrainConfig(name="raft-chairs", lr=2.5e-4, num_steps=120000, wdecay=1e-4),
     ),
     "things_mixed": _stage(
-        RAFTConfig(compute_dtype="bfloat16"),
+        RAFTConfig(compute_dtype="bfloat16", remat=True,
+                   remat_policy="dots_saveable"),
         DataConfig(stage="things", image_size=(400, 720), batch_size=5),
         TrainConfig(name="raft-things", lr=1e-4, num_steps=120000, wdecay=1e-4,
                     freeze_bn=True),
     ),
     "sintel_mixed": _stage(
-        RAFTConfig(compute_dtype="bfloat16"),
+        RAFTConfig(compute_dtype="bfloat16", remat=True,
+                   remat_policy="dots_saveable"),
         DataConfig(stage="sintel", image_size=(368, 768), batch_size=5),
         TrainConfig(name="raft-sintel", lr=1e-4, num_steps=120000, wdecay=1e-5,
                     gamma=0.85, freeze_bn=True),
     ),
     "kitti_mixed": _stage(
-        RAFTConfig(compute_dtype="bfloat16"),
+        RAFTConfig(compute_dtype="bfloat16", remat=True,
+                   remat_policy="dots_saveable"),
         DataConfig(stage="kitti", image_size=(288, 960), batch_size=5),
         TrainConfig(name="raft-kitti", lr=1e-4, num_steps=50000, wdecay=1e-5,
                     gamma=0.85, freeze_bn=True),

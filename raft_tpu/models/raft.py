@@ -132,12 +132,14 @@ class RAFT(nn.Module):
         fmap1 = fmap1.astype(jnp.float32)
         fmap2 = fmap2.astype(jnp.float32)
 
+        corr_dt = jnp.bfloat16 if cfg.corr_dtype == "bfloat16" else jnp.float32
         if cfg.alternate_corr:
             corr_state = (fmap1, tuple(build_fmap_pyramid(fmap2,
                                                           cfg.corr_levels)))
         else:
             vol = all_pairs_correlation(fmap1, fmap2)
-            pyramid = build_corr_pyramid(vol, cfg.corr_levels)
+            pyramid = [p.astype(corr_dt)
+                       for p in build_corr_pyramid(vol, cfg.corr_levels)]
             if cfg.corr_shard:
                 # batch stays sharded over 'data'; the H1*W1 query axis
                 # shards over 'spatial' (each device holds all of fmap2's
@@ -160,7 +162,11 @@ class RAFT(nn.Module):
 
         step_cls = RefinementStep
         if cfg.remat:
-            step_cls = nn.remat(step_cls)
+            if cfg.remat_policy:
+                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+                step_cls = nn.remat(step_cls, policy=policy)
+            else:
+                step_cls = nn.remat(step_cls)
         scan = nn.scan(step_cls,
                        variable_broadcast="params",
                        split_rngs={"params": False},

@@ -153,9 +153,16 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
     out = []
     for i, corr in enumerate(pyramid):
         H2, W2 = corr.shape[2], corr.shape[3]
-        img = corr.reshape(N, H2, W2).astype(jnp.float32)
-        ry = onehot_lerp_weights(cy[:, None] / (2.0 ** i), radius, H2)
-        rx = onehot_lerp_weights(cx[:, None] / (2.0 ** i), radius, W2)
+        # Contraction dtype follows the stored pyramid: bf16 pyramids
+        # (cfg.corr_dtype) halve the HBM traffic of the volume reads and
+        # run the one-hot matmuls at full MXU rate; accumulation is
+        # always f32 via preferred_element_type.
+        cdt = corr.dtype if corr.dtype == jnp.bfloat16 else jnp.float32
+        prec = (jax.lax.Precision.DEFAULT if cdt == jnp.bfloat16
+                else jax.lax.Precision.HIGHEST)
+        img = corr.reshape(N, H2, W2).astype(cdt)
+        ry = onehot_lerp_weights(cy[:, None] / (2.0 ** i), radius, H2).astype(cdt)
+        rx = onehot_lerp_weights(cx[:, None] / (2.0 ** i), radius, W2).astype(cdt)
         if shard:
             from jax.sharding import PartitionSpec as P
             from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
@@ -167,10 +174,10 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
             rx = constrain(rx, spec)
         a = jnp.einsum("nkh,nhw->nkw", ry, img,
                        preferred_element_type=jnp.float32,
-                       precision=jax.lax.Precision.HIGHEST)  # (N, ky, W2)
+                       precision=prec).astype(cdt)  # (N, ky, W2)
         win = jnp.einsum("nkw,njw->njk", a, rx,
                          preferred_element_type=jnp.float32,
-                         precision=jax.lax.Precision.HIGHEST)  # (N, kx, ky)
+                         precision=prec)  # (N, kx, ky)
         out.append(win.reshape(B, H1, W1, k1 * k1))
     return jnp.concatenate(out, axis=-1).astype(jnp.float32)
 

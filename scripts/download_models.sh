@@ -1,0 +1,16 @@
+#!/bin/bash
+# Fetch the reference RAFT model zoo (download_models.sh in the reference
+# repo) and convert each checkpoint to raft_tpu's .msgpack format.
+# The .pth files also load directly in the eval/demo CLIs; conversion just
+# removes the torch dependency from later runs.
+set -e
+
+wget https://www.dropbox.com/s/4j4z58wuv8o0mfz/models.zip
+unzip models.zip
+
+for m in models/raft-chairs.pth models/raft-things.pth \
+         models/raft-sintel.pth models/raft-kitti.pth; do
+    python -m raft_tpu.cli.convert --input "$m" --output "${m%.pth}.msgpack"
+done
+python -m raft_tpu.cli.convert --input models/raft-small.pth \
+    --output models/raft-small.msgpack --small

@@ -110,3 +110,24 @@ def test_alternate_equals_all_pairs():
         alternate_corr_lookup(jnp.asarray(f1), fpyr, jnp.asarray(coords),
                               radius))
     np.testing.assert_allclose(ondemand, dense, rtol=1e-4, atol=1e-4)
+
+
+def test_corr_lookup_bf16_pyramid_close_to_f32():
+    """cfg.corr_dtype=bfloat16 stores the pyramid in bf16 and contracts
+    in bf16 with f32 accumulation; values must stay within bf16 rounding
+    of the f32 path (the perf path used by bench.py)."""
+    B, H, W, C = 2, 8, 8, 16
+    f1 = jnp.asarray(RNG.standard_normal((B, H, W, C)).astype(np.float32))
+    f2 = jnp.asarray(RNG.standard_normal((B, H, W, C)).astype(np.float32))
+    coords = jnp.stack(
+        jnp.meshgrid(jnp.arange(W, dtype=jnp.float32),
+                     jnp.arange(H, dtype=jnp.float32), indexing="xy"),
+        axis=-1)[None].repeat(B, axis=0) + 0.37
+
+    pyr = build_corr_pyramid(all_pairs_correlation(f1, f2), 4)
+    ref = np.asarray(corr_lookup(pyr, coords, radius=4))
+    got = np.asarray(corr_lookup([p.astype(jnp.bfloat16) for p in pyr],
+                                 coords, radius=4))
+    assert got.dtype == np.float32
+    scale = np.abs(ref).max()
+    np.testing.assert_allclose(got, ref, atol=0.02 * scale)
