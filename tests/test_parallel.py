@@ -102,3 +102,12 @@ def test_corr_shard_noop_without_mesh():
     variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
     out = model.apply(variables, img, img, iters=1)
     assert out.shape == (1, 1, 64, 64, 2)
+
+
+def test_initialize_distributed_single_host_noop():
+    """Single-host call must be a no-op (the common dev path); multi-host
+    wiring is jax.distributed.initialize, exercised only on real fleets."""
+    from raft_tpu.parallel import initialize_distributed
+
+    initialize_distributed()  # must not raise or re-init
+    assert jax.process_count() == 1
