@@ -51,6 +51,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="devices on the mesh data axis (replaces --gpus)")
     p.add_argument("--spatial_parallel", type=int, default=1,
                    help="devices sharding the corr-volume query axis")
+    p.add_argument("--corr_shard_impl", default="gspmd",
+                   choices=["gspmd", "ring"],
+                   help="sharded-volume construction: GSPMD annotations "
+                        "or explicit ring-ppermute (parallel/ring.py)")
     # extras
     p.add_argument("--alternate_corr", action="store_true",
                    help="on-demand Pallas correlation (low HBM)")
@@ -84,6 +88,7 @@ def build_config(args):
         dropout=args.dropout,
         alternate_corr=args.alternate_corr,
         corr_shard=args.spatial_parallel > 1,
+        corr_shard_impl=args.corr_shard_impl,
         **({"corr_dtype": args.corr_dtype} if args.corr_dtype else {}),
     )
     data = dataclasses.replace(
@@ -164,11 +169,25 @@ def train(args) -> str:
                                   train_cfg.wdecay, train_cfg.epsilon,
                                   train_cfg.clip)
 
+    # Mesh first: the model trace (create_train_state) needs the ambient
+    # mesh bound when corr_shard is on (the ring construction reads it
+    # via get_abstract_mesh; GSPMD constrains no-op without one).
+    import contextlib
+
+    n_dev = args.data_parallel * args.spatial_parallel
+    mesh = None
+    if n_dev > 1:
+        mesh = make_mesh(data=args.data_parallel,
+                         spatial=args.spatial_parallel)
+    mesh_ctx = jax.set_mesh(mesh) if mesh else contextlib.nullcontext()
+
     # Parameter init from one real batch.
     first = next(iter(loader))
     init_batch = {k: v for k, v in first.items() if k != "extra_info"}
-    state = create_train_state(model, tx, jax.random.PRNGKey(train_cfg.seed),
-                               init_batch, iters=train_cfg.iters)
+    with mesh_ctx:
+        state = create_train_state(model, tx,
+                                   jax.random.PRNGKey(train_cfg.seed),
+                                   init_batch, iters=train_cfg.iters)
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
     print(f"Parameter count: {n_params}")
 
@@ -187,13 +206,9 @@ def train(args) -> str:
                                    params_only=True)
         print(f"restored params from {train_cfg.restore_ckpt}")
 
-    # Mesh / sharded step when parallelism is requested.
-    n_dev = args.data_parallel * args.spatial_parallel
-    mesh = None
+    # Sharded step when parallelism is requested.
     sharding = None
-    if n_dev > 1:
-        mesh = make_mesh(data=args.data_parallel,
-                         spatial=args.spatial_parallel)
+    if mesh is not None:
         state = replicate_state(state, mesh)
         step = make_parallel_train_step(
             model, mesh, iters=train_cfg.iters, gamma=train_cfg.gamma,

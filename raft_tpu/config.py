@@ -51,6 +51,12 @@ class RAFTConfig:
     # 'spatial' axis (high-res configs where the O((HW)^2) volume exceeds
     # one chip's HBM).  No-op without an active mesh.
     corr_shard: bool = False
+    # How the sharded volume is built: "gspmd" annotates shardings and
+    # lets XLA place the collectives; "ring" constructs it explicitly
+    # with lax.ppermute rotations of fmap2 shards (parallel/ring.py) so
+    # no device ever materializes all of fmap2 — the ring-attention
+    # analogue.  Identical results (test_ring_corr.py).
+    corr_shard_impl: str = "gspmd"  # "gspmd" | "ring"
 
     def __post_init__(self):
         if self.corr_impl not in ("pallas", "lax"):
@@ -68,6 +74,13 @@ class RAFTConfig:
                 "has no effect on the on-demand (alternate_corr) path — "
                 "the combination would silently drop the requested "
                 "spatial parallelism; choose one")
+        if self.corr_shard_impl not in ("gspmd", "ring"):
+            raise ValueError(f"corr_shard_impl must be 'gspmd' or 'ring', "
+                             f"got {self.corr_shard_impl!r}")
+        if self.corr_shard_impl == "ring" and not self.corr_shard:
+            raise ValueError(
+                "corr_shard_impl='ring' requires corr_shard=True — "
+                "without it the ring construction is silently skipped")
         if self.alternate_corr and self.corr_dtype != "float32":
             raise ValueError(
                 "corr_dtype applies to the materialized all-pairs pyramid; "

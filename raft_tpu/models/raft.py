@@ -136,6 +136,16 @@ class RAFT(nn.Module):
         if cfg.alternate_corr:
             corr_state = (fmap1, tuple(build_fmap_pyramid(fmap2,
                                                           cfg.corr_levels)))
+        elif cfg.corr_shard and cfg.corr_shard_impl == "ring":
+            # Explicit ring construction over the ambient mesh
+            # (parallel/ring.py): fmap2 shards rotate via ppermute, the
+            # query-sharded pyramid comes out already pinned to
+            # (data, spatial) — no device holds all of fmap2.
+            from raft_tpu.parallel.ring import ring_corr_pyramid
+
+            mesh = jax.sharding.get_abstract_mesh()
+            pyramid = ring_corr_pyramid(fmap1, fmap2, mesh, cfg.corr_levels)
+            corr_state = tuple(p.astype(corr_dt) for p in pyramid)
         else:
             vol = all_pairs_correlation(fmap1, fmap2)
             pyramid = [p.astype(corr_dt)
