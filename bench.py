@@ -56,7 +56,8 @@ def main():
     tx, _ = make_optimizer(lr=4e-4, num_steps=1000, wdecay=1e-4)
     state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
                                iters=iters)
-    step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0)
+    step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0,
+                           donate=True)
 
     # Warmup / compile.  Synchronization must be a host copy: over the
     # axon tunnel, block_until_ready returns before execution finishes,

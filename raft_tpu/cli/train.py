@@ -213,7 +213,7 @@ def train(args) -> str:
         step = make_parallel_train_step(
             model, mesh, iters=train_cfg.iters, gamma=train_cfg.gamma,
             max_flow=train_cfg.max_flow, freeze_bn=train_cfg.freeze_bn,
-            add_noise=train_cfg.add_noise)
+            add_noise=train_cfg.add_noise, donate=True)
         from jax.sharding import NamedSharding
         from raft_tpu.parallel.mesh import batch_spec
         sharding = NamedSharding(mesh, batch_spec())
@@ -221,7 +221,7 @@ def train(args) -> str:
         step = make_train_step(
             model, iters=train_cfg.iters, gamma=train_cfg.gamma,
             max_flow=train_cfg.max_flow, freeze_bn=train_cfg.freeze_bn,
-            add_noise=train_cfg.add_noise)
+            add_noise=train_cfg.add_noise, donate=True)
 
     logger = Logger(log_dir=os.path.join(args.log_dir, train_cfg.name),
                     scheduler_lr=lambda s: float(schedule(s)),

@@ -43,9 +43,11 @@ class RAFTConfig:
     # for activation memory across the scan).
     remat: bool = False
     # Selective remat: name of a jax.checkpoint_policies member (e.g.
-    # "dots_with_no_batch_dims_saveable" keeps matmul outputs and only
-    # recomputes the cheap elementwise/gather work).  Empty = save
-    # nothing (full recompute).  Only used when remat=True.
+    # "dots_saveable" keeps matmul outputs and only recomputes the cheap
+    # elementwise/gather work), or "convs_and_dots_saveable" (ours —
+    # additionally saves every conv output tagged by layers.conv, see
+    # models/raft.py resolve_remat_policy).  Empty = save nothing (full
+    # recompute).  Only used when remat=True.
     remat_policy: str = ""
     # Shard the correlation volume's H1*W1 query axis over the mesh's
     # 'spatial' axis (high-res configs where the O((HW)^2) volume exceeds
@@ -86,13 +88,14 @@ class RAFTConfig:
                 "corr_dtype applies to the materialized all-pairs pyramid; "
                 "the on-demand (alternate_corr) path computes from float32 "
                 "fmap pyramids and would silently ignore it")
-        if self.remat_policy:
+        if self.remat_policy and self.remat_policy != "convs_and_dots_saveable":
             import jax
 
             if not hasattr(jax.checkpoint_policies, self.remat_policy):
                 raise ValueError(
-                    f"remat_policy {self.remat_policy!r} is not a "
-                    f"jax.checkpoint_policies member")
+                    f"remat_policy {self.remat_policy!r} is not "
+                    f"'convs_and_dots_saveable' or a jax.checkpoint_policies "
+                    f"member")
 
     @property
     def hidden_dim(self) -> int:

@@ -19,14 +19,28 @@ kaiming_out = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 
 def conv(features: int, kernel: Union[int, Tuple[int, int]], stride: int = 1,
          *, dtype=jnp.float32, name: Optional[str] = None,
-         padding: Optional[Sequence[Tuple[int, int]]] = None) -> nn.Conv:
-    """3x3/7x7/1x1 conv with torch-style symmetric padding (kernel//2)."""
+         padding: Optional[Sequence[Tuple[int, int]]] = None) -> Callable:
+    """3x3/7x7/1x1 conv with torch-style symmetric padding (kernel//2).
+
+    The output is tagged ``checkpoint_name(..., "conv_out")`` so the
+    ``convs_and_dots_saveable`` remat policy (RAFTConfig.remat_policy) can
+    keep conv outputs across the refinement scan's backward pass — XLA
+    classifies convolutions as conv_general_dilated, which ``dots_saveable``
+    alone would recompute.  The tag is inert under every other policy.
+    """
     if isinstance(kernel, int):
         kernel = (kernel, kernel)
     if padding is None:
         padding = [(k // 2, k // 2) for k in kernel]
-    return nn.Conv(features, kernel, strides=(stride, stride), padding=padding,
-                   kernel_init=kaiming_out, dtype=dtype, name=name)
+
+    def apply(x):
+        from jax.ad_checkpoint import checkpoint_name
+        y = nn.Conv(features, kernel, strides=(stride, stride),
+                    padding=padding, kernel_init=kaiming_out, dtype=dtype,
+                    name=name)(x)
+        return checkpoint_name(y, "conv_out")
+
+    return apply
 
 
 class InstanceNorm(nn.Module):

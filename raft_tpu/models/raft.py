@@ -30,19 +30,34 @@ from jax.sharding import PartitionSpec as P
 from raft_tpu.config import RAFTConfig
 from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
-from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
+from raft_tpu.models.update import BasicUpdateBlock, MaskHead, SmallUpdateBlock
 from raft_tpu.ops.corr import (
-    all_pairs_correlation,
     alternate_corr_lookup,
-    build_corr_pyramid,
+    build_corr_pyramid_direct,
     build_fmap_pyramid,
     corr_lookup,
 )
-from raft_tpu.ops.grid import convex_upsample, coords_grid, upflow8
+from raft_tpu.ops.grid import (convex_upsample, coords_grid, pack_fine,
+                               upflow8)
 
 
 def _compute_dtype(cfg: RAFTConfig):
     return jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+
+
+def resolve_remat_policy(name: str):
+    """Map RAFTConfig.remat_policy to a jax checkpoint policy.
+
+    ``convs_and_dots_saveable`` is ours: matmul outputs (dots_saveable)
+    plus every output tagged "conv_out" by layers.conv — the refinement
+    scan's backward then recomputes only cheap elementwise work.  Any
+    other name is a jax.checkpoint_policies member.
+    """
+    if name == "convs_and_dots_saveable":
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_saveable,
+            jax.checkpoint_policies.save_only_these_names("conv_out"))
+    return getattr(jax.checkpoint_policies, name)
 
 
 class RefinementStep(nn.Module):
@@ -80,17 +95,18 @@ class RefinementStep(nn.Module):
         else:
             block = BasicUpdateBlock(corr_ch, cfg.hidden_dim, dtype=dtype,
                                      name="update_block")
-        net, mask, delta = block(net, inp, corr.astype(dtype),
-                                 flow.astype(dtype))
+        net, delta = block(net, inp, corr.astype(dtype), flow.astype(dtype))
 
         coords1 = coords1 + delta.astype(jnp.float32)
         new_flow = coords1 - coords0
 
-        if mask is None:
-            flow_up = upflow8(new_flow)
-        else:
-            flow_up = convex_upsample(new_flow, mask.astype(jnp.float32))
-        return (net, coords1), flow_up
+        # The mask head and 8x upsample happen OUTSIDE the scan (batched
+        # over all iterates in train mode, last-only in test mode): the
+        # scan emits the 128-ch GRU state instead of the 576-ch mask (4.5x
+        # less scan-output traffic), the mask convs and the upsampler's
+        # softmax run once over an iters*B batch instead of 12 times inside
+        # the while loop, and inference skips 11/12 of that work entirely.
+        return (net, coords1), (new_flow, net)
 
 
 class RAFT(nn.Module):
@@ -102,7 +118,7 @@ class RAFT(nn.Module):
     def __call__(self, image1, image2, iters: int = 12,
                  flow_init: Optional[jax.Array] = None,
                  train: bool = False, freeze_bn: bool = False,
-                 test_mode: bool = False):
+                 test_mode: bool = False, pack_output: bool = False):
         cfg = self.cfg
         dtype = _compute_dtype(cfg)
         hdim, cdim = cfg.hidden_dim, cfg.context_dim
@@ -147,9 +163,11 @@ class RAFT(nn.Module):
             pyramid = ring_corr_pyramid(fmap1, fmap2, mesh, cfg.corr_levels)
             corr_state = tuple(p.astype(corr_dt) for p in pyramid)
         else:
-            vol = all_pairs_correlation(fmap1, fmap2)
-            pyramid = [p.astype(corr_dt)
-                       for p in build_corr_pyramid(vol, cfg.corr_levels)]
+            # Each level as a matmul against pooled fmap2 (exactly equal to
+            # pooling the full volume — see build_corr_pyramid_direct); the
+            # f32 O((HW)^2) volume is never materialized.
+            pyramid = build_corr_pyramid_direct(fmap1, fmap2,
+                                                cfg.corr_levels, corr_dt)
             if cfg.corr_shard:
                 # batch stays sharded over 'data'; the H1*W1 query axis
                 # shards over 'spatial' (each device holds all of fmap2's
@@ -173,8 +191,8 @@ class RAFT(nn.Module):
         step_cls = RefinementStep
         if cfg.remat:
             if cfg.remat_policy:
-                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
-                step_cls = nn.remat(step_cls, policy=policy)
+                step_cls = nn.remat(step_cls,
+                                    policy=resolve_remat_policy(cfg.remat_policy))
             else:
                 step_cls = nn.remat(step_cls)
         scan = nn.scan(step_cls,
@@ -183,9 +201,30 @@ class RAFT(nn.Module):
                        in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                        out_axes=0,
                        length=iters)
-        (net, coords1), flow_predictions = scan(cfg, name="refine")(
+        (net, coords1), (flows_lr, nets) = scan(cfg, name="refine")(
             (net, coords1), inp, corr_state, coords0)
 
+        mask_head = (None if cfg.small
+                     else MaskHead(dtype=dtype, name="mask_head"))
+
+        def upsample(flow_lr, net_state, packed=False):
+            if mask_head is None:
+                up = upflow8(flow_lr)
+                return pack_fine(up) if packed else up
+            return convex_upsample(flow_lr, mask_head(net_state),
+                                   packed=packed)
+
         if test_mode:
-            return coords1 - coords0, flow_predictions[-1]
-        return flow_predictions
+            # Use the final CARRY (value-identical to flows_lr[-1]/nets[-1])
+            # so jit can DCE the stacked per-iterate scan outputs entirely.
+            flow_lr = coords1 - coords0
+            return flow_lr, upsample(flow_lr, net)
+
+        # Batch the upsample over all iterates: (iters, B, ...) -> (iters*B, ...)
+        # pack_output=True keeps the result in pack_fine's (B, H, W, 64, 2)
+        # layout — the training loss brings the TARGETS into this layout
+        # instead of transposing 12 full-res iterates back to image layout.
+        n_it = flows_lr.shape[0]
+        flat = lambda x: x.reshape((n_it * B,) + x.shape[2:])
+        ups = upsample(flat(flows_lr), flat(nets), packed=pack_output)
+        return ups.reshape((n_it, B) + ups.shape[1:])

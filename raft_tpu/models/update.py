@@ -100,6 +100,26 @@ class BasicMotionEncoder(nn.Module):
         return jnp.concatenate([out, flow], axis=-1)  # 126 + 2 = 128 channels
 
 
+class MaskHead(nn.Module):
+    """Convex-upsample mask head (update.py:122-125; the 0.25 scale balances
+    gradients, update.py:135).
+
+    A sibling of the update block rather than a part of it: the mask only
+    feeds the 8x upsampler, never the recurrence, so the model applies it
+    OUTSIDE the refinement scan — batched over all iterates in train mode,
+    final-iterate-only at inference (see models/raft.py).  Reference
+    checkpoints' ``update_block.mask.*`` keys map here
+    (utils/torch_import.py).
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, net):
+        mask = nn.relu(conv(256, 3, dtype=self.dtype, name="mask_conv1")(net))
+        return 0.25 * conv(576, 1, dtype=self.dtype, name="mask_conv2")(mask)
+
+
 class SmallUpdateBlock(nn.Module):
     """Motion encoder + ConvGRU + flow head; no upsample mask
     (update.py:99-112 — mask is None, so the model bilinearly upsamples)."""
@@ -115,12 +135,14 @@ class SmallUpdateBlock(nn.Module):
         x = jnp.concatenate([inp, motion], axis=-1)
         net = ConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
         delta = FlowHead(128, dtype=self.dtype, name="flow_head")(net)
-        return net, None, delta
+        return net, delta
 
 
 class BasicUpdateBlock(nn.Module):
-    """Motion encoder + SepConvGRU + flow head + convex-upsample mask head
-    (update.py:114-136; the 0.25 mask scale balances gradients)."""
+    """Motion encoder + SepConvGRU + flow head (update.py:114-136).
+
+    The reference computes the upsample mask here too; ours lives in
+    :class:`MaskHead` so it can run outside the scan."""
 
     corr_channels: int
     hidden_dim: int = 128
@@ -133,6 +155,4 @@ class BasicUpdateBlock(nn.Module):
         x = jnp.concatenate([inp, motion], axis=-1)
         net = SepConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
         delta = FlowHead(256, dtype=self.dtype, name="flow_head")(net)
-        mask = nn.relu(conv(256, 3, dtype=self.dtype, name="mask_conv1")(net))
-        mask = 0.25 * conv(576, 1, dtype=self.dtype, name="mask_conv2")(mask)
-        return net, mask, delta
+        return net, delta

@@ -126,7 +126,26 @@ def avg_pool2x(x: jax.Array) -> jax.Array:
     return x.mean(axis=(2, 4))
 
 
-def convex_upsample(flow: jax.Array, mask: jax.Array) -> jax.Array:
+def pack_fine(x: jax.Array) -> jax.Array:
+    """(B, 8H, 8W, ...) image-layout array -> packed (B, H, W, 64, ...).
+
+    The packed layout is the one ``convex_upsample(..., packed=True)``
+    produces natively (coarse pixel major, subpixel s = 8*sy + sx next).
+    Used to bring the training TARGETS (gt flow, valid mask) into the
+    predictions' layout once per step, instead of transposing every
+    iterate's 8x-upsampled prediction into image layout (~140 MB of pure
+    data movement per direction at training resolution).
+    """
+    B, HF, WF = x.shape[:3]
+    rest = x.shape[3:]
+    H, W = HF // 8, WF // 8
+    x = x.reshape((B, H, 8, W, 8) + rest)
+    x = jnp.moveaxis(x, 2, 3)  # (B, H, W, 8, 8, ...)
+    return x.reshape((B, H, W, 64) + rest)
+
+
+def convex_upsample(flow: jax.Array, mask: jax.Array,
+                    packed: bool = False) -> jax.Array:
     """Convex-combination 8x upsampling of flow (core/raft.py:72-83).
 
     Each fine pixel is a softmax-weighted combination of the 3x3 coarse
@@ -141,11 +160,18 @@ def convex_upsample(flow: jax.Array, mask: jax.Array) -> jax.Array:
         so imported checkpoints line up.
 
     Returns:
-      (B, 8H, 8W, 2) upsampled flow.
+      (B, 8H, 8W, 2) upsampled flow; or, with ``packed=True``, the same
+      values in the (B, H, W, 64, 2) layout of ``pack_fine`` — skipping
+      the subpixel-to-image transpose (training consumes predictions via
+      the loss only, which works in either layout).
     """
     B, H, W, _ = flow.shape
-    mask = mask.reshape(B, H, W, 9, 8, 8)
-    mask = jax.nn.softmax(mask, axis=3)
+    # TPU layout note: keep the subpixel axis fused as s = 8*sy + sx (64
+    # lanes) instead of unpacking to (..., 9, 8, 8) — trailing dims of 8
+    # would occupy 8 of 128 vector lanes, and the softmax reductions here
+    # were the hottest ops in the whole train step under that layout.
+    m = mask.reshape(B, H, W, 9, 64).astype(jnp.float32)
+    m = jax.nn.softmax(m, axis=3)
 
     up = 8.0 * flow
     up_pad = jnp.pad(up, ((0, 0), (1, 1), (1, 1), (0, 0)))
@@ -155,8 +181,10 @@ def convex_upsample(flow: jax.Array, mask: jax.Array) -> jax.Array:
         axis=3,
     )  # (B, H, W, 9, 2)
 
-    # out[b,h,w,sy,sx,c] = sum_k mask[b,h,w,k,sy,sx] * neighbors[b,h,w,k,c]
-    out = jnp.einsum("bhwkyx,bhwkc->bhwyxc", mask, neighbors)
-    # (B, H, 8, W, 8, 2) -> (B, 8H, 8W, 2)
-    out = out.transpose(0, 1, 3, 2, 4, 5)
+    # out[b,h,w,s,c] = sum_k m[b,h,w,k,s] * neighbors[b,h,w,k,c]
+    out = jnp.einsum("bhwks,bhwkc->bhwsc", m, neighbors)
+    if packed:
+        return out  # (B, H, W, 64, 2)
+    # (B, H, W, (sy, sx), 2) -> (B, H, 8, W, 8, 2) -> (B, 8H, 8W, 2)
+    out = out.reshape(B, H, W, 8, 8, 2).transpose(0, 1, 3, 2, 4, 5)
     return out.reshape(B, 8 * H, 8 * W, 2)

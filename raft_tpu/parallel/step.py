@@ -30,7 +30,7 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
 
 def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
                              max_flow: float, freeze_bn: bool = False,
-                             add_noise: bool = False):
+                             add_noise: bool = False, donate: bool = False):
     """Build the mesh-aware train step.
 
     Usage:
@@ -38,9 +38,13 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
         step = make_parallel_train_step(model, mesh, ...)
         for batch in loader:
             state, metrics = step(state, shard_batch(batch, mesh))
+
+    donate=True forwards state-buffer donation to the jitted step (see
+    make_train_step); only for linear-flow callers.
     """
     base = make_train_step(model, iters=iters, gamma=gamma, max_flow=max_flow,
-                           freeze_bn=freeze_bn, add_noise=add_noise)
+                           freeze_bn=freeze_bn, add_noise=add_noise,
+                           donate=donate)
 
     def step(state: TrainState, batch: Dict):
         with jax.set_mesh(mesh):

@@ -10,7 +10,8 @@ import jax.numpy as jnp
 
 def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
                   valid: jax.Array, gamma: float = 0.8,
-                  max_flow: float = 400.0) -> Tuple[jax.Array, Dict]:
+                  max_flow: float = 400.0,
+                  packed: bool = False) -> Tuple[jax.Array, Dict]:
     """Exponentially weighted L1 over all refinement iterates.
 
     The i-th of N predictions is weighted gamma**(N - i - 1) (train.py:58),
@@ -18,27 +19,36 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
     (train.py:54-55).
 
     Args:
-      flow_preds: (iters, B, H, W, 2) stacked iterates (scan output).
-      flow_gt: (B, H, W, 2).
-      valid: (B, H, W) 0/1 mask.
+      flow_preds: (iters, B, H, W, 2) stacked iterates (scan output); with
+        ``packed=True``, (iters, B, H/8, W/8, 64, 2) in the model's
+        pack_output layout (see ops/grid.py pack_fine).
+      flow_gt: (B, H, W, 2), always image layout.
+      valid: (B, H, W) 0/1 mask, always image layout.
       gamma: decay.
       max_flow: magnitude cutoff for supervision.
 
     Returns:
       (scalar loss, metrics dict with epe/1px/3px/5px computed from the
-      final iterate, train.py:62-70).
+      final iterate, train.py:62-70).  Loss and metrics are identical in
+      both layouts — packed just transposes the two targets once instead
+      of every prediction iterate.
     """
+    if packed:
+        from raft_tpu.ops.grid import pack_fine
+        flow_gt = pack_fine(flow_gt)                    # (B, H, W, 64, 2)
+        valid = pack_fine(valid[..., None])[..., 0]     # (B, H, W, 64)
+
     n = flow_preds.shape[0]
     mag = jnp.sqrt(jnp.sum(flow_gt.astype(jnp.float32) ** 2, axis=-1))
-    valid = (valid >= 0.5) & (mag < max_flow)  # (B, H, W)
-    vw = valid.astype(jnp.float32)[None, ..., None]  # (1, B, H, W, 1)
+    valid = (valid >= 0.5) & (mag < max_flow)
+    vw = valid.astype(jnp.float32)[None, ..., None]
 
     weights = gamma ** (n - 1 - jnp.arange(n, dtype=jnp.float32))
     abs_err = jnp.abs(flow_preds.astype(jnp.float32) - flow_gt[None])
     # mean over everything per-iterate (the reference takes .mean() of the
     # masked per-pixel loss, i.e. including masked zeros in the denominator:
     # (valid[:, None] * i_loss).mean(), train.py:59)
-    per_iter = jnp.mean(vw * abs_err, axis=(1, 2, 3, 4))
+    per_iter = jnp.mean(vw * abs_err, axis=tuple(range(1, abs_err.ndim)))
     loss = jnp.sum(weights * per_iter)
 
     metrics = flow_metrics(flow_preds[-1], flow_gt, valid)

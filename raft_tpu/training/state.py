@@ -58,6 +58,28 @@ def save_checkpoint(path: str, state: TrainState) -> str:
     return path
 
 
+def _migrate_mask_head(node):
+    """Relocate legacy checkpoints' refine/update_block/mask_conv1|2 to the
+    top-level mask_head/* scope.
+
+    The convex-upsample mask head used to live inside the scanned update
+    block; it now runs outside the scan (models/update.py MaskHead), so
+    older native checkpoints need their params — and the mirroring AdamW
+    moment trees inside opt_state — moved.  Applied recursively, so any
+    subtree shaped like a param tree (params itself, mu, nu) migrates.
+    """
+    if not isinstance(node, dict):
+        return node
+    node = {k: _migrate_mask_head(v) for k, v in node.items()}
+    refine = node.get("refine")
+    ub = refine.get("update_block") if isinstance(refine, dict) else None
+    if (isinstance(ub, dict) and "mask_head" not in node
+            and ("mask_conv1" in ub or "mask_conv2" in ub)):
+        node["mask_head"] = {k: ub.pop(k)
+                             for k in ("mask_conv1", "mask_conv2") if k in ub}
+    return node
+
+
 def restore_checkpoint(path: str, state: TrainState,
                        params_only: bool = False) -> TrainState:
     """Restore a checkpoint.
@@ -68,6 +90,7 @@ def restore_checkpoint(path: str, state: TrainState,
     """
     with open(path, "rb") as f:
         payload = flax.serialization.msgpack_restore(f.read())
+    payload = _migrate_mask_head(payload)
 
     params = flax.serialization.from_state_dict(state.params, payload["params"])
     batch_stats = flax.serialization.from_state_dict(

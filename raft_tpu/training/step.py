@@ -19,14 +19,22 @@ from raft_tpu.training.state import TrainState
 
 
 def make_train_step(model, iters: int, gamma: float, max_flow: float,
-                    freeze_bn: bool = False, add_noise: bool = False):
+                    freeze_bn: bool = False, add_noise: bool = False,
+                    donate: bool = False):
     """Build a jit-compiled train step for ``model``.
 
     The optional noise augmentation matches train.py:167-170: N(0, sigma)
     with sigma ~ U(0, 5), clipped back to [0, 255], applied on device.
+
+    donate=True donates the incoming train state to XLA, which then reuses
+    its buffers (params + 2 AdamW moments, ~64 MB for RAFT-large) for the
+    output state instead of copying.  Only for callers whose state flows
+    linearly (``state, _ = step(state, ...)`` and never touch the old
+    object again) — the training loop and bench do; tests that diff
+    pre/post states must not donate.
     """
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState,
                    batch: Dict[str, jax.Array]) -> Tuple[TrainState, Dict]:
         rng, step_rng, noise_rng = jax.random.split(state.rng, 3)
@@ -46,12 +54,13 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
                 variables["batch_stats"] = state.batch_stats
             out = model.apply(
                 variables, image1, image2, iters=iters, train=True,
-                freeze_bn=freeze_bn,
+                freeze_bn=freeze_bn, pack_output=True,
                 mutable=["batch_stats"] if state.batch_stats else [],
                 rngs={"dropout": step_rng})
             preds, new_model_state = out
             loss, metrics = sequence_loss(preds, batch["flow"], batch["valid"],
-                                          gamma=gamma, max_flow=max_flow)
+                                          gamma=gamma, max_flow=max_flow,
+                                          packed=True)
             return loss, (metrics, new_model_state)
 
         (loss, (metrics, new_model_state)), grads = jax.value_and_grad(

@@ -11,8 +11,9 @@ onto this package's flax param/batch_stats trees:
 - torch Sequential indices -> named modules:
     layerN.M            -> layerN_M
     downsample.0/.1     -> downsample / norm3 (residual) or norm4 (bottleneck)
-    update_block.mask.0/.2 -> mask_conv1 / mask_conv2
-- update_block.* lives under the scan scope: refine/update_block/*
+    update_block.mask.0/.2 -> mask_head/mask_conv1 / mask_head/mask_conv2
+      (top-level scope — the mask head runs outside the scan)
+- other update_block.* lives under the scan scope: refine/update_block/*
 
 Zoo checkpoints (raft-things.pth etc., download_models.sh) load through
 this shim for EPE-parity evaluation.
@@ -63,7 +64,13 @@ def _map_torch_key(key: str) -> Tuple[Tuple[str, ...], str, str]:
             out.append({"0": "mask_conv1", "2": "mask_conv2"}[idx])
             i += 2
         elif p == "update_block":
-            out.extend(["refine", "update_block"])
+            # The mask head is hoisted out of the scanned update block
+            # (models/update.py MaskHead) — it lives at the model's top
+            # scope, not under refine/.
+            if i + 1 < len(parts) and parts[i + 1] == "mask":
+                out.append("mask_head")
+            else:
+                out.extend(["refine", "update_block"])
             i += 1
         else:
             out.append(p)

@@ -1,0 +1,101 @@
+"""Perf probe: time train-step variants at the bench config (chairs_mixed:
+batch 8, 368x496, 12 iters) to guide optimization.  Not part of the test
+suite; run on the real chip:  python scripts/perf_probe.py [variant ...]
+
+Variants: current, alt_pallas, alt_lax, no_remat_policy, fwd_only
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_batch(B=8, H=368, W=496):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    return {
+        "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32)),
+        "image2": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32)),
+        "flow": jnp.asarray((rng.standard_normal((B, H, W, 2)) * 5).astype(np.float32)),
+        "valid": jnp.ones((B, H, W), np.float32),
+    }
+
+
+def time_step(cfg, batch, iters=12, n=10, fwd_only=False):
+    import jax
+    from raft_tpu.models import RAFT
+    from raft_tpu.training import create_train_state, make_optimizer
+    from raft_tpu.training.step import make_train_step
+
+    model = RAFT(cfg)
+    tx, _ = make_optimizer(lr=4e-4, num_steps=1000, wdecay=1e-4)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=iters)
+    if fwd_only:
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fwd(params, batch):
+            preds = model.apply({"params": params,
+                                 **({"batch_stats": state.batch_stats}
+                                    if state.batch_stats else {})},
+                                batch["image1"], batch["image2"], iters=iters)
+            return jnp.float32(preds[-1].mean())
+
+        out = fwd(state.params, batch); float(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fwd(state.params, batch)
+        float(out)
+        return (time.perf_counter() - t0) / n
+
+    step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0,
+                           donate=True)
+    state, m = step(state, batch); float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, m = step(state, batch)
+    float(m["loss"])
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    from raft_tpu.config import RAFTConfig
+
+    base = dict(small=False, compute_dtype="bfloat16", remat=True,
+                remat_policy="dots_saveable", corr_dtype="bfloat16")
+    variants = {
+        "current": lambda: RAFTConfig(**base),
+        "alt_pallas": lambda: RAFTConfig(**{**base, "corr_dtype": "float32",
+                                            "alternate_corr": True,
+                                            "corr_impl": "pallas"}),
+        "alt_lax": lambda: RAFTConfig(**{**base, "corr_dtype": "float32",
+                                         "alternate_corr": True,
+                                         "corr_impl": "lax"}),
+        # NOTE: an nn.scan unroll>1 variant was tried here and wedged the
+        # remote XLA compile service for ~45 min at the chairs config —
+        # don't re-add without a compile-time budget.
+        "no_remat_policy": lambda: RAFTConfig(**{**base, "remat_policy": ""}),
+        "convs_saved": lambda: RAFTConfig(
+            **{**base, "remat_policy": "convs_and_dots_saveable"}),
+        "fwd_only": lambda: RAFTConfig(**base),
+    }
+    want = sys.argv[1:] or ["current", "alt_pallas", "fwd_only"]
+    batch = make_batch()
+    B = batch["image1"].shape[0]
+    for name in want:
+        cfg = variants[name]()
+        try:
+            dt = time_step(cfg, batch, fwd_only=(name == "fwd_only"))
+            print(f"{name:>16}: {dt * 1e3:8.1f} ms/step  "
+                  f"({B / dt:6.2f} pairs/s)")
+        except Exception as e:  # OOM etc — report and continue
+            print(f"{name:>16}: FAILED {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -131,3 +131,30 @@ def test_corr_lookup_bf16_pyramid_close_to_f32():
     assert got.dtype == np.float32
     scale = np.abs(ref).max()
     np.testing.assert_allclose(got, ref, atol=0.02 * scale)
+
+
+def test_direct_pyramid_equals_pooled_volume():
+    """build_corr_pyramid_direct (matmul per level against pooled fmap2 —
+    the model's default path) must equal pooling the materialized volume,
+    including the odd-dim floor crop."""
+    from raft_tpu.ops.corr import build_corr_pyramid_direct
+
+    B, H, W, C = 2, 9, 11, 8  # odd dims exercise the floor crop
+    levels = 4
+    f1 = jnp.asarray(RNG.standard_normal((B, H, W, C)).astype(np.float32))
+    f2 = jnp.asarray(RNG.standard_normal((B, H, W, C)).astype(np.float32))
+
+    ref = build_corr_pyramid(all_pairs_correlation(f1, f2), levels)
+    got = build_corr_pyramid_direct(f1, f2, levels)
+    assert len(got) == len(ref)
+    for r, g in zip(ref, got):
+        assert g.shape == r.shape and g.dtype == r.dtype
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-5, atol=1e-5)
+
+    got_bf16 = build_corr_pyramid_direct(f1, f2, levels, dtype=jnp.bfloat16)
+    for r, g in zip(ref, got_bf16):
+        assert g.dtype == jnp.bfloat16
+        scale = np.abs(np.asarray(r)).max()
+        np.testing.assert_allclose(np.asarray(g, np.float32), np.asarray(r),
+                                   atol=0.02 * scale)

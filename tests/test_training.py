@@ -194,3 +194,83 @@ def test_latest_checkpoint_prefix_matches_step_named_files(tmp_path):
     assert latest_checkpoint(str(tmp_path), prefix="exp") == \
         str(tmp_path / "exp.msgpack")
     assert latest_checkpoint(str(tmp_path), prefix="missing") is None
+
+
+def test_sequence_loss_packed_equals_image_layout():
+    """The train step feeds packed (pack_fine-layout) predictions; loss and
+    metrics must be identical to the image-layout path."""
+    from raft_tpu.ops.grid import pack_fine
+
+    rng = np.random.default_rng(7)
+    it, B, H, W = 3, 2, 16, 24
+    preds = rng.standard_normal((it, B, H, W, 2)).astype(np.float32) * 4
+    gt = rng.standard_normal((B, H, W, 2)).astype(np.float32) * 4
+    valid = (rng.uniform(size=(B, H, W)) > 0.2).astype(np.float32)
+
+    loss_img, m_img = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
+                                    jnp.asarray(valid))
+    packed_preds = jnp.stack([pack_fine(jnp.asarray(p)) for p in preds])
+    loss_pk, m_pk = sequence_loss(packed_preds, jnp.asarray(gt),
+                                  jnp.asarray(valid), packed=True)
+    np.testing.assert_allclose(float(loss_pk), float(loss_img), rtol=1e-6)
+    for k in m_img:
+        np.testing.assert_allclose(float(m_pk[k]), float(m_img[k]),
+                                   rtol=1e-5, err_msg=k)
+
+
+def test_model_pack_output_matches_image_layout():
+    """pack_output=True must be a pure re-layout of the train-mode output."""
+    from raft_tpu.ops.grid import pack_fine
+
+    batch = _tiny_batch(B=1, H=64, W=64)
+    model = RAFT(RAFTConfig(small=False))
+    variables = model.init(jax.random.PRNGKey(0), batch["image1"],
+                           batch["image2"], iters=1)
+    kw = dict(iters=2, mutable=["batch_stats"], train=True,
+              rngs={"dropout": jax.random.PRNGKey(1)})
+    img, _ = model.apply(variables, batch["image1"], batch["image2"], **kw)
+    pk, _ = model.apply(variables, batch["image1"], batch["image2"],
+                        pack_output=True, **kw)
+    repacked = jnp.stack([pack_fine(f) for f in img])
+    np.testing.assert_allclose(np.asarray(pk), np.asarray(repacked),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_restore_migrates_legacy_mask_head_location():
+    """Checkpoints written before the mask head moved out of the scan keep
+    mask_conv1/2 under refine/update_block; restore must relocate them (and
+    the mirroring AdamW moments) to mask_head/*."""
+    import flax
+
+    batch = _tiny_batch(B=1, H=64, W=64)
+    model = RAFT(RAFTConfig(small=False))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=50, wdecay=1e-5)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=2)
+
+    def demote(tree):  # new layout -> legacy layout
+        if not isinstance(tree, dict):
+            return tree
+        tree = {k: demote(v) for k, v in tree.items()}
+        if "mask_head" in tree and isinstance(tree.get("refine"), dict):
+            tree["refine"]["update_block"].update(tree.pop("mask_head"))
+        return tree
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "legacy.msgpack")
+        save_checkpoint(path, state)
+        payload = flax.serialization.msgpack_restore(open(path, "rb").read())
+        legacy = demote(payload)
+        assert "mask_head" not in legacy["params"]
+        with open(path, "wb") as f:
+            f.write(flax.serialization.msgpack_serialize(legacy))
+
+        fresh = create_train_state(model, tx, jax.random.PRNGKey(1), batch,
+                                   iters=2)
+        restored = restore_checkpoint(path, fresh)
+        for a, b in zip(jax.tree.leaves(restored.params),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(restored.opt_state),
+                        jax.tree.leaves(state.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
