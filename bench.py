@@ -33,8 +33,17 @@ def main():
     from raft_tpu.training import create_train_state, make_optimizer
     from raft_tpu.training.step import make_train_step
 
-    B, H, W = 8, 368, 496
-    iters = 12
+    import dataclasses
+
+    from raft_tpu.config import STAGE_PRESETS
+
+    # The measured config IS the chairs_mixed stage preset (reference's
+    # train_mixed.sh recipe), so bench and training can't drift apart;
+    # scripts/perf_probe.py derives its variants from the same source.
+    preset = STAGE_PRESETS["chairs_mixed"]
+    B = preset.data.batch_size
+    H, W = preset.data.image_size
+    iters = preset.train.iters
 
     rng = np.random.default_rng(0)
     batch = {
@@ -44,14 +53,13 @@ def main():
         "valid": jnp.ones((B, H, W), np.float32),
     }
 
-    # remat=True: without it the unrolled 12-iteration scan needs ~21 GB
-    # of HBM at this resolution (v5e has 15.75 GB).  dots_saveable keeps
-    # matmul outputs and recomputes only elementwise work: 16.0 pairs/s
-    # vs 14.2 for full recompute on v5e.  corr_dtype=bfloat16 halves the
-    # volume traffic and runs the lookup matmuls at full MXU rate
-    # (f32 accumulation; ~0.5% relative error): 20.3 pairs/s.
-    cfg = RAFTConfig(small=False, compute_dtype="bfloat16", remat=True,
-                     remat_policy="dots_saveable", corr_dtype="bfloat16")
+    # remat=True (from the preset): without it the unrolled 12-iteration
+    # scan needs ~21 GB of HBM at this resolution (v5e has 15.75 GB).
+    # dots_saveable keeps matmul outputs and recomputes only elementwise
+    # work: 16.0 pairs/s vs 14.2 for full recompute on v5e.
+    # corr_dtype=bfloat16 halves the volume traffic and runs the lookup
+    # matmuls at full MXU rate (f32 accumulation; ~0.5% relative error).
+    cfg = dataclasses.replace(preset.model, corr_dtype="bfloat16")
     model = RAFT(cfg)
     tx, _ = make_optimizer(lr=4e-4, num_steps=1000, wdecay=1e-4)
     state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,

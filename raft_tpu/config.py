@@ -24,10 +24,12 @@ class RAFTConfig:
     small: bool = False
     dropout: float = 0.0
     alternate_corr: bool = False  # on-demand corr lookup instead of all-pairs
-    # Implementation of the on-demand lookup: "pallas" = the fused TPU
-    # kernel (ops/corr_pallas.py, replaces alt_cuda_corr), "lax" = the
-    # pure-XLA oracle it is tested against.
-    corr_impl: str = "pallas"  # "pallas" | "lax"
+    # Implementation of the on-demand lookup: "chunked" = query-chunked
+    # matmul rows + one-hot windows (ops/corr.py chunked_corr_lookup — the
+    # fastest O(H*W)-memory path), "pallas" = the fused TPU kernel
+    # (ops/corr_pallas.py, replaces alt_cuda_corr), "lax" = the
+    # gather-based oracle both are tested against.
+    corr_impl: str = "chunked"  # "chunked" | "pallas" | "lax"
     # Mixed precision: compute dtype for encoders + update block; the corr
     # volume and the loss stay float32 (matching the autocast boundaries at
     # raft.py:99-127 and corr.py:50).
@@ -61,9 +63,9 @@ class RAFTConfig:
     corr_shard_impl: str = "gspmd"  # "gspmd" | "ring"
 
     def __post_init__(self):
-        if self.corr_impl not in ("pallas", "lax"):
-            raise ValueError(f"corr_impl must be 'pallas' or 'lax', "
-                             f"got {self.corr_impl!r}")
+        if self.corr_impl not in ("chunked", "pallas", "lax"):
+            raise ValueError(f"corr_impl must be 'chunked', 'pallas' or "
+                             f"'lax', got {self.corr_impl!r}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"compute_dtype must be 'float32' or "
                              f"'bfloat16', got {self.compute_dtype!r}")

@@ -35,6 +35,7 @@ from raft_tpu.ops.corr import (
     alternate_corr_lookup,
     build_corr_pyramid_direct,
     build_fmap_pyramid,
+    chunked_corr_lookup,
     corr_lookup,
 )
 from raft_tpu.ops.grid import (convex_upsample, coords_grid, pack_fine,
@@ -80,6 +81,9 @@ class RefinementStep(nn.Module):
                 from raft_tpu.ops.corr_pallas import ondemand_corr_lookup
                 corr = ondemand_corr_lookup(fmap1, fmap2_pyr, coords1,
                                             cfg.corr_radius)
+            elif cfg.corr_impl == "chunked":
+                corr = chunked_corr_lookup(fmap1, fmap2_pyr, coords1,
+                                           cfg.corr_radius)
             else:
                 corr = alternate_corr_lookup(fmap1, fmap2_pyr, coords1,
                                              cfg.corr_radius)
@@ -215,6 +219,10 @@ class RAFT(nn.Module):
                                    packed=packed)
 
         if test_mode:
+            if pack_output:
+                raise ValueError("pack_output applies to the train-mode "
+                                 "stacked iterates; test_mode returns "
+                                 "image-layout flow")
             # Use the final CARRY (value-identical to flows_lr[-1]/nets[-1])
             # so jit can DCE the stacked per-iterate scan outputs entirely.
             flow_lr = coords1 - coords0

@@ -1,6 +1,7 @@
 from raft_tpu.ops.grid import (
     bilinear_sample,
     coords_grid,
+    pack_fine,
     upflow8,
     upsample2x,
     convex_upsample,
@@ -9,7 +10,9 @@ from raft_tpu.ops.grid import (
 from raft_tpu.ops.corr import (
     all_pairs_correlation,
     build_corr_pyramid,
+    build_corr_pyramid_direct,
     build_fmap_pyramid,
+    chunked_corr_lookup,
     corr_lookup,
     alternate_corr_lookup,
 )
@@ -20,13 +23,16 @@ from raft_tpu.ops.warp import backward_warp, forward_interpolate
 __all__ = [
     "bilinear_sample",
     "coords_grid",
+    "pack_fine",
     "upflow8",
     "upsample2x",
     "convex_upsample",
     "avg_pool2x",
     "all_pairs_correlation",
     "build_corr_pyramid",
+    "build_corr_pyramid_direct",
     "build_fmap_pyramid",
+    "chunked_corr_lookup",
     "corr_lookup",
     "alternate_corr_lookup",
     "ondemand_corr_lookup",

@@ -29,9 +29,10 @@ Design (TPU-first, not a CUDA translation):
   never match the one-hot, reproducing bilinear_sampler's zero OOB
   padding (core/utils/utils.py:61-65) without a padded border.
 
-- Targets are laid out x-major (t = x*H2 + y) so the flat window index
-  k = kx*(2r+1) + ky matches the reference's meshgrid ordering
-  (core/corr.py:37-44).
+- Targets keep their natural row-major flattening (t = y*W2 + x); the
+  contraction order (w first, then h) yields the flat window index
+  k = kx*(2r+1) + ky directly, matching the reference's meshgrid
+  ordering (core/corr.py:37-44) with no re-layout pass.
 
 - The backward pass is a hand-written VJP (the CUDA backward exists at
   correlation_kernel.cu:123-256 but is dead code — the Python side never
@@ -42,9 +43,9 @@ Design (TPU-first, not a CUDA translation):
   stop_gradient on coords (core/raft.py:123).
 
 VMEM budget per grid step (fp32): fmap2 (T*C) + corr row block
-(q_tile*T) + corr image (q_tile*W2*H2) — about 10 MB at the reference's
-largest training resolution (400x720/8, C=256, q_tile=128), within the
-~16 MB/core budget.  Larger inputs should lower ``q_tile``.
+(q_tile*T) — about 7 MB at the reference's largest training resolution
+(400x720/8, C=256, q_tile=128), within the ~16 MB/core budget.  Larger
+inputs should lower ``q_tile``.
 """
 
 from __future__ import annotations
@@ -65,15 +66,16 @@ def _on_tpu() -> bool:
 
 
 def _level_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, corr_ref,
-                  img_ref, *, radius: int, h2: int, w2: int, q_tile: int):
+                  *, radius: int, h2: int, w2: int, q_tile: int):
     """One (batch, query-block) grid step.
 
     f1_ref:  (1, q_tile, C) query features.
-    f2_ref:  (1, T, C) target features, x-major flattened (T = W2*H2).
+    f2_ref:  (1, T, C) target features, row-major flattened (T = H2*W2,
+             t = y*W2 + x — the array's natural order, so the row block
+             reshapes to (q, H2, W2) for free; no re-layout scratch).
     cx_ref/cy_ref: (q_tile, 1) query coords at this level's scale.
     out_ref: (1, q_tile, 2r+1, 2r+1) window correlations, [kx, ky].
     corr_ref: (q_tile, T) scratch for the correlation row block.
-    img_ref: (q_tile, W2, H2) scratch — the same rows as (x, y) images.
     """
     r = radius
     k1 = 2 * r + 1
@@ -87,27 +89,25 @@ def _level_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, corr_ref,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
-    ) * scale  # (q_tile, T) with t = x*H2 + y
+    ) * scale  # (q_tile, T) with t = y*W2 + x
 
-    # 2) Re-layout each query's row as a (W2, H2) image (static slices).
-    for x in range(w2):
-        img_ref[:, x, :] = corr_ref[:, x * h2:(x + 1) * h2]
-
-    # 3) Separable bilinear one-hot gather: two weighted contractions
-    #    (shared parity-critical construction, corr.py).
+    # 2) Separable bilinear one-hot gather: two weighted contractions
+    #    (shared parity-critical construction, corr.py).  Contracting w
+    #    first and h second yields [kx, ky] directly — the reference's
+    #    x-major window order (corr.py:37-44) — from row-major rows.
     rx = onehot_lerp_weights(cx_ref[...], r, w2)         # (q, k1, W2)
     ry = onehot_lerp_weights(cy_ref[...], r, h2)         # (q, k1, H2)
-    img = img_ref[...]                                   # (q, W2, H2)
+    img = corr_ref[...].reshape(q_tile, h2, w2)
 
-    # B1[q, kx, h] = sum_w rx[q, kx, w] * img[q, w, h]
-    b1 = jax.lax.dot_general(
+    # A[q, kx, h] = sum_w rx[q, kx, w] * img[q, h, w]
+    a = jax.lax.dot_general(
         rx, img,
-        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST)             # (q, k1, H2)
-    # out[q, kx, ky] = sum_h b1[q, kx, h] * ry[q, ky, h]
+    # out[q, kx, ky] = sum_h a[q, kx, h] * ry[q, ky, h]
     out_ref[0] = jax.lax.dot_general(
-        b1, ry,
+        a, ry,
         dimension_numbers=(((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST)             # (q, k1, k1)
@@ -131,8 +131,8 @@ def _lookup_level(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
     r = radius
     k1 = 2 * r + 1
     T = H2 * W2
-    # x-major target flattening: t = x*H2 + y
-    f2x = jnp.transpose(f2, (0, 2, 1, 3)).reshape(B, T, C)
+    # natural row-major target flattening: t = y*W2 + x
+    f2x = f2.reshape(B, T, C)
     nqb = NQ // q_tile
     cx_col = cx.reshape(B * NQ, 1)
     cy_col = cy.reshape(B * NQ, 1)
@@ -158,26 +158,24 @@ def _lookup_level(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
         out_shape=jax.ShapeDtypeStruct((B, NQ, k1, k1), jnp.float32),
         scratch_shapes=[
             pltpu.VMEM((q_tile, T), jnp.float32),
-            pltpu.VMEM((q_tile, W2, H2), jnp.float32),
         ],
         interpret=interpret,
     )(f1q, f2x, cx_col, cy_col)
 
 
-def _pick_q_tile(T: int, C: int, H2: int, W2: int, radius: int) -> int:
+def _pick_q_tile(T: int, C: int, radius: int) -> int:
     """Largest q_tile whose level-0 VMEM footprint fits the ~16 MB/core
-    budget with headroom: double-buffered fmap2 + corr row block + corr
-    image (lane-padded) + double-buffered output."""
+    budget with headroom: double-buffered fmap2 + corr row block
+    (lane-padded) + double-buffered output."""
     f2_bytes = 2 * 4 * T * C
     budget = 12 * 1024 * 1024 - f2_bytes
 
     def per_q(qt: int) -> int:
         lane = 128
         corr = 4 * ((T + lane - 1) // lane) * lane
-        img = 4 * W2 * ((H2 + lane - 1) // lane) * lane
         k1p = ((2 * radius + 1 + 7) // 8) * 8
         out = 2 * 4 * k1p * lane
-        return corr + img + out + 2 * 4 * C
+        return corr + out + 2 * 4 * C
 
     for qt in (256, 128, 64, 32, 16, 8):
         if qt * per_q(qt) <= budget:
@@ -191,8 +189,7 @@ def _forward(fmap1: jax.Array, fmap2_pyramid: Tuple[jax.Array, ...],
     Q = H1 * W1
     if q_tile is None:
         f2 = fmap2_pyramid[0]
-        q_tile = _pick_q_tile(f2.shape[1] * f2.shape[2], C,
-                              f2.shape[1], f2.shape[2], radius)
+        q_tile = _pick_q_tile(f2.shape[1] * f2.shape[2], C, radius)
     nq = ((Q + q_tile - 1) // q_tile) * q_tile
     pad = nq - Q
     interpret = not _on_tpu()

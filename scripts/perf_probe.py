@@ -2,7 +2,8 @@
 batch 8, 368x496, 12 iters) to guide optimization.  Not part of the test
 suite; run on the real chip:  python scripts/perf_probe.py [variant ...]
 
-Variants: current, alt_pallas, alt_lax, no_remat_policy, fwd_only
+Variants: current, alt_pallas, alt_lax, alt_chunked, no_remat_policy,
+convs_saved, fwd_only
 """
 
 import os
@@ -14,8 +15,14 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def make_batch(B=8, H=368, W=496):
+def make_batch(B=None, H=None, W=None):
+    """Synthetic batch at the bench config (chairs_mixed preset) by default."""
     import jax.numpy as jnp
+    from raft_tpu.config import STAGE_PRESETS
+
+    preset = STAGE_PRESETS["chairs_mixed"]
+    B = B or preset.data.batch_size
+    H, W = (H, W) if H and W else preset.data.image_size
     rng = np.random.default_rng(0)
     return {
         "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32)),
@@ -64,10 +71,15 @@ def time_step(cfg, batch, iters=12, n=10, fwd_only=False):
 
 
 def main():
-    from raft_tpu.config import RAFTConfig
+    import dataclasses
 
-    base = dict(small=False, compute_dtype="bfloat16", remat=True,
-                remat_policy="dots_saveable", corr_dtype="bfloat16")
+    from raft_tpu.config import RAFTConfig, STAGE_PRESETS
+
+    # Same source of truth as bench.py: the chairs_mixed preset model
+    # config plus the bf16 corr pyramid.
+    base = dataclasses.asdict(
+        dataclasses.replace(STAGE_PRESETS["chairs_mixed"].model,
+                            corr_dtype="bfloat16"))
     variants = {
         "current": lambda: RAFTConfig(**base),
         "alt_pallas": lambda: RAFTConfig(**{**base, "corr_dtype": "float32",
@@ -76,6 +88,9 @@ def main():
         "alt_lax": lambda: RAFTConfig(**{**base, "corr_dtype": "float32",
                                          "alternate_corr": True,
                                          "corr_impl": "lax"}),
+        "alt_chunked": lambda: RAFTConfig(**{**base, "corr_dtype": "float32",
+                                             "alternate_corr": True,
+                                             "corr_impl": "chunked"}),
         # NOTE: an nn.scan unroll>1 variant was tried here and wedged the
         # remote XLA compile service for ~45 min at the chairs config —
         # don't re-add without a compile-time budget.
