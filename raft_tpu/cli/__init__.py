@@ -8,3 +8,11 @@ frame2video.py:17-52) and the shell-script stage recipes
 
 Usage: ``python -m raft_tpu.cli.train --stage chairs ...``.
 """
+
+from raft_tpu.utils.platform import ensure_platform
+
+# Every entry point imports this package first (both ``python -m
+# raft_tpu.cli.X`` and the console scripts), so honoring a
+# JAX_PLATFORMS=cpu override happens here once — before any module can
+# touch the pinned plugin backend — instead of per-main() boilerplate.
+ensure_platform()

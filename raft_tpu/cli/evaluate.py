@@ -15,7 +15,7 @@ def parse_args(argv=None):
     p.add_argument("--model", required=True, help="checkpoint (.msgpack, "
                    "or a torch .pth imported via utils.torch_import)")
     p.add_argument("--dataset", required=True,
-                   choices=["chairs", "sintel", "kitti",
+                   choices=["chairs", "sintel", "kitti", "synthetic",
                             "sintel_submission", "kitti_submission"])
     p.add_argument("--small", action="store_true")
     p.add_argument("--iters", type=int, default=None)
@@ -67,7 +67,8 @@ def main(argv=None):
     from raft_tpu.config import RAFTConfig
     from raft_tpu.evaluation.evaluate import (
         Evaluator, create_kitti_submission, create_sintel_submission,
-        validate_chairs, validate_kitti, validate_sintel)
+        validate_chairs, validate_kitti, validate_sintel,
+        validate_synthetic)
     from raft_tpu.models import RAFT
 
     cfg = RAFTConfig(
@@ -85,6 +86,8 @@ def main(argv=None):
         validate_sintel(ev, root, iters=args.iters or 32)
     elif args.dataset == "kitti":
         validate_kitti(ev, root, iters=args.iters or 24)
+    elif args.dataset == "synthetic":
+        validate_synthetic(ev, root, iters=args.iters or 24)
     elif args.dataset == "sintel_submission":
         create_sintel_submission(
             ev, root, iters=args.iters or 32, warm_start=args.warm_start,

@@ -27,7 +27,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     # reference flags (train.py:218-239)
     p.add_argument("--name", default=None, help="experiment name")
     p.add_argument("--stage", required=True,
-                   choices=["chairs", "things", "sintel", "kitti"])
+                   choices=["chairs", "things", "sintel", "kitti",
+                            "synthetic"],
+                   help="training stage preset; 'synthetic' needs no "
+                        "on-disk dataset (random-shift pairs, exact GT)")
     p.add_argument("--restore_ckpt", default=None,
                    help="params-only restore for curriculum transfer "
                         "(strict=False analogue, train.py:141-142)")
@@ -122,7 +125,8 @@ def run_validation(model, variables, names,
                    root: str) -> Dict[str, float]:
     """In-loop validation (train.py:190-198)."""
     from raft_tpu.evaluation.evaluate import (
-        Evaluator, validate_chairs, validate_kitti, validate_sintel)
+        Evaluator, validate_chairs, validate_kitti, validate_sintel,
+        validate_synthetic)
 
     ev = Evaluator(model, variables)
     results: Dict[str, float] = {}
@@ -133,6 +137,8 @@ def run_validation(model, variables, names,
             results.update(validate_sintel(ev, root))
         elif name == "kitti":
             results.update(validate_kitti(ev, root))
+        elif name == "synthetic":
+            results.update(validate_synthetic(ev, root))
     return results
 
 

@@ -234,4 +234,21 @@ STAGE_PRESETS = {
         TrainConfig(name="raft-kitti", lr=1e-4, num_steps=50000, wdecay=1e-5,
                     gamma=0.85, freeze_bn=True),
     ),
+    # Dataset-free stage: random-shift pairs with exact ground truth
+    # (data/datasets.py SyntheticShift).  Defaults mirror the chairs
+    # recipe's scale for single-chip hardware validation; for a CPU smoke
+    # run, shrink it: --image_size 64 64 --batch_size 2 --num_steps 4.
+    "synthetic": _stage(
+        RAFTConfig(remat=True, remat_policy="dots_saveable"),
+        DataConfig(stage="synthetic", image_size=(368, 496), batch_size=8),
+        TrainConfig(name="raft-synthetic", lr=4e-4, num_steps=1000,
+                    wdecay=1e-4, val_freq=500),
+    ),
+    "synthetic_mixed": _stage(
+        RAFTConfig(compute_dtype="bfloat16", remat=True,
+                   remat_policy="dots_saveable"),
+        DataConfig(stage="synthetic", image_size=(368, 496), batch_size=8),
+        TrainConfig(name="raft-synthetic", lr=4e-4, num_steps=1000,
+                    wdecay=1e-4, val_freq=500),
+    ),
 }

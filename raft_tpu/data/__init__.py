@@ -16,6 +16,7 @@ from raft_tpu.data.datasets import (
     MpiSintel,
     KITTI,
     HD1K,
+    SyntheticShift,
     fetch_dataset,
 )
 from raft_tpu.data.loader import DataLoader
@@ -24,6 +25,6 @@ __all__ = [
     "read_flow", "write_flow", "read_pfm", "read_flow_kitti",
     "write_flow_kitti", "read_disp_kitti", "read_gen", "flow_to_image",
     "FlowAugmentor", "SparseFlowAugmentor", "FlowDataset", "FlyingChairs",
-    "FlyingThings3D", "MpiSintel", "KITTI", "HD1K", "fetch_dataset",
-    "DataLoader",
+    "FlyingThings3D", "MpiSintel", "KITTI", "HD1K", "SyntheticShift",
+    "fetch_dataset", "DataLoader",
 ]

@@ -246,6 +246,82 @@ class HD1K(FlowDataset):
             seq_ix += 1
 
 
+class SyntheticShift(FlowDataset):
+    """Procedural dataset: textured image + random integer shift, with exact
+    dense ground-truth flow.
+
+    No on-disk dataset required — the stage that lets the full training
+    pipeline (loader, step, checkpointing, eval) run on any machine, and
+    the recipe used for single-chip hardware validation (PARITY.md).  If
+    ``frames_dir`` is given, real images from it are used as the base
+    texture; otherwise images are procedural filtered noise.
+
+    The shift is applied with wrap-around (np.roll), and the wrapped-in
+    band is marked invalid so supervision is exact everywhere it is on.
+    """
+
+    def __init__(self, image_size=(368, 496), length: int = 1000,
+                 max_shift: int = 16, frames_dir: Optional[str] = None,
+                 seed: int = 0):
+        super().__init__(aug_params=None, seed=seed)
+        self.image_size = tuple(image_size)
+        self.length = length
+        self.max_shift = max_shift
+        self.frames: List[str] = []
+        if frames_dir:
+            exts = (".png", ".jpg", ".jpeg", ".ppm")
+            self.frames = sorted(
+                osp.join(frames_dir, f) for f in os.listdir(frames_dir)
+                if f.lower().endswith(exts))
+
+    def __len__(self) -> int:
+        return self.length
+
+    def _base_image(self, rng: np.random.Generator) -> np.ndarray:
+        H, W = self.image_size
+        if self.frames:
+            img = self._load_image(
+                self.frames[int(rng.integers(len(self.frames)))])
+            # tile + crop to the requested size
+            ry = -(-H // img.shape[0])
+            rx = -(-W // img.shape[1])
+            img = np.tile(img, (ry, rx, 1))[:H, :W]
+            return img.astype(np.float32)
+        # procedural texture: low-frequency noise via box-filtered uniform
+        small = rng.uniform(0, 255, (H // 8 + 2, W // 8 + 2, 3))
+        img = np.kron(small, np.ones((8, 8, 1)))[:H, :W]
+        img = img + rng.uniform(-20, 20, (H, W, 3))
+        return np.clip(img, 0, 255).astype(np.float32)
+
+    def __getitem__(self, index) -> Dict[str, np.ndarray]:
+        if index >= self.length:
+            raise IndexError(index)
+        rng = np.random.default_rng(
+            abs(hash((self.seed, self.epoch, index))) % (2 ** 31))
+        H, W = self.image_size
+        img1 = self._base_image(rng)
+        dx = int(rng.integers(-self.max_shift, self.max_shift + 1))
+        dy = int(rng.integers(-self.max_shift, self.max_shift + 1))
+        # flow maps img1 pixels to img2: img2(p + flow) == img1(p)
+        img2 = np.roll(img1, (dy, dx), axis=(0, 1))
+        flow = np.zeros((H, W, 2), np.float32)
+        flow[..., 0] = dx
+        flow[..., 1] = dy
+        # np.roll wraps, so img2(p + (dx, dy)) == img1(p) exactly whenever
+        # the target p + (dx, dy) is in-bounds; mark only the rows/cols
+        # whose target falls outside the frame as invalid.
+        valid = np.ones((H, W), np.float32)
+        if dy > 0:
+            valid[H - dy:] = 0
+        elif dy < 0:
+            valid[:-dy] = 0
+        if dx > 0:
+            valid[:, W - dx:] = 0
+        elif dx < 0:
+            valid[:, :-dx] = 0
+        return {"image1": img1, "image2": img2, "flow": flow, "valid": valid}
+
+
 def fetch_dataset(stage: str, image_size, root: str = "datasets",
                   train_ds: str = "C+T+K+S+H", seed: int = 0):
     """Stage mixture construction (datasets.py:199-228).
@@ -255,6 +331,12 @@ def fetch_dataset(stage: str, image_size, root: str = "datasets",
     kitti -> sparse KITTI only.
     """
     crop = tuple(image_size)
+    if stage == "synthetic":
+        # Dataset-free stage: random-shift pairs with exact GT (see
+        # SyntheticShift).  `root` may point at a folder of frames to use
+        # as base textures; otherwise procedural noise.
+        frames_dir = root if root and osp.isdir(root) else None
+        return SyntheticShift(crop, frames_dir=frames_dir, seed=seed)
     if stage == "chairs":
         aug = dict(crop_size=crop, min_scale=-0.1, max_scale=1.0, do_flip=True)
         return FlyingChairs(aug, split="training",

@@ -56,6 +56,26 @@ class Evaluator:
         return fn(self.variables, image1, image2)
 
 
+def validate_synthetic(evaluator: Evaluator, root: str = "datasets",
+                       iters: int = 24, n_samples: int = 32,
+                       image_size=(368, 496)) -> Dict[str, float]:
+    """EPE on held-out SyntheticShift pairs (dataset-free validation; pairs
+    the `--stage synthetic` training path).  Uses a seed disjoint from the
+    training stream so validation pairs are never trained on."""
+    ds = datasets.SyntheticShift(image_size, length=n_samples,
+                                 frames_dir=root if os.path.isdir(root) else None,
+                                 seed=987654321)
+    epes = []
+    for i in range(len(ds)):
+        s = ds[i]
+        _, flow_up = evaluator(s["image1"][None], s["image2"][None], iters)
+        epe = np.sqrt(((np.asarray(flow_up)[0] - s["flow"]) ** 2).sum(-1))
+        epes.append(epe[s["valid"] > 0.5].reshape(-1))
+    epe = float(np.concatenate(epes).mean())
+    print(f"Validation Synthetic EPE: {epe:.3f}")
+    return {"synthetic": epe}
+
+
 def validate_chairs(evaluator: Evaluator, root: str = "datasets",
                     iters: int = 24) -> Dict[str, float]:
     """FlyingChairs validation split EPE (evaluate.py:75-92)."""

@@ -1,0 +1,27 @@
+"""Honor JAX_PLATFORMS=cpu in environments that pin a plugin backend.
+
+The deployment image pins ``JAX_PLATFORMS=axon`` (a tunneled TPU).  When a
+user overrides the env var to ``cpu`` (or asks for virtual devices via
+``--xla_force_host_platform_device_count``), the env var alone does not
+beat the plugin registration — ``jax.config.update`` does, but only if it
+runs before the backend is first touched.  Every CLI calls this once at
+startup.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_platform() -> None:
+    """Apply the JAX_PLATFORMS env choice via jax.config (idempotent)."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    forced_cpu = ("force_host_platform_device_count"
+                  in os.environ.get("XLA_FLAGS", ""))
+    if want == "cpu" or (forced_cpu and not want):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialized; nothing safe to do

@@ -283,3 +283,37 @@ def test_loader_determinism_and_shapes(synth_root, tmp_path):
     loader.set_epoch(1)
     b3 = next(iter(loader))
     assert not np.array_equal(b1["image1"], b3["image1"])
+
+
+def test_synthetic_shift_dataset_exact_correspondence():
+    """SyntheticShift: img2(p + flow) == img1(p) exactly wherever valid,
+    deterministic per (seed, epoch, index), and reachable via
+    fetch_dataset('synthetic', ...) without any on-disk data."""
+    from raft_tpu.data.datasets import SyntheticShift, fetch_dataset
+
+    ds = SyntheticShift(image_size=(48, 64), length=5, max_shift=6, seed=3)
+    assert len(ds) == 5
+    s = ds[2]
+    img1, img2, flow, valid = (s["image1"], s["image2"], s["flow"],
+                               s["valid"])
+    assert img1.shape == (48, 64, 3) and flow.shape == (48, 64, 2)
+    dx, dy = int(flow[0, 0, 0]), int(flow[0, 0, 1])
+    H, W = 48, 64
+    ys, xs = np.nonzero(valid)
+    # every valid pixel's target is in-bounds and matches exactly
+    assert ((ys + dy >= 0) & (ys + dy < H)).all()
+    assert ((xs + dx >= 0) & (xs + dx < W)).all()
+    np.testing.assert_array_equal(img2[ys + dy, xs + dx], img1[ys, xs])
+    # and some pixel is invalid iff there is a nonzero shift
+    assert (valid == 0).any() == (dx != 0 or dy != 0)
+
+    # determinism
+    s2 = ds[2]
+    np.testing.assert_array_equal(s2["image1"], img1)
+    ds.set_epoch(1)
+    s3 = ds[2]
+    assert not np.array_equal(s3["flow"], flow) or \
+        not np.array_equal(s3["image1"], img1)
+
+    via_fetch = fetch_dataset("synthetic", (48, 64), root="nonexistent-dir")
+    assert len(via_fetch) > 0 and via_fetch[0]["image1"].shape == (48, 64, 3)
