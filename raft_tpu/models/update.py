@@ -7,12 +7,54 @@ MXU as two skinny matmuls.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
-from raft_tpu.models.layers import conv
+from raft_tpu.models.layers import conv, kaiming_out
+
+
+class ConvParams(nn.Module):
+    """Parameter container structurally identical to an nn.Conv child.
+
+    Lets the GRUs fuse sibling convolutions that share an input (z and r
+    gates) into ONE conv at apply time — concatenating kernels along the
+    output-channel axis is mathematically the same two convs, but fills
+    the MXU with N=2*hidden instead of N=hidden — while the checkpoint
+    tree keeps the reference's per-gate layout (convz1/kernel etc.), so
+    .pth import and existing checkpoints are unaffected.
+    """
+
+    features: int
+    kernel_size: Tuple[int, int]
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        w = self.param("kernel", kaiming_out,
+                       self.kernel_size + (in_features, self.features))
+        b = self.param("bias", nn.initializers.zeros_init(),
+                       (self.features,))
+        return w, b
+
+
+def _fused_gate_conv(hx, z_name: str, r_name: str, hidden: int,
+                     kernel: Tuple[int, int], dtype):
+    """sigmoid(conv_z(hx)), sigmoid(conv_r(hx)) as one fused conv."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    cin = hx.shape[-1]
+    wz, bz = ConvParams(hidden, kernel, name=z_name)(cin)
+    wr, br = ConvParams(hidden, kernel, name=r_name)(cin)
+    w = jnp.concatenate([wz, wr], axis=-1).astype(dtype)
+    b = jnp.concatenate([bz, br]).astype(dtype)
+    pad = [(k // 2, k // 2) for k in kernel]
+    out = jax.lax.conv_general_dilated(
+        hx.astype(dtype), w, (1, 1), pad,
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
+    out = checkpoint_name(nn.sigmoid(out), "conv_out")
+    return out[..., :hidden], out[..., hidden:]
 
 
 class FlowHead(nn.Module):
@@ -36,8 +78,8 @@ class ConvGRU(nn.Module):
     @nn.compact
     def __call__(self, h, x):
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(conv(self.hidden_dim, 3, dtype=self.dtype, name="convz")(hx))
-        r = nn.sigmoid(conv(self.hidden_dim, 3, dtype=self.dtype, name="convr")(hx))
+        z, r = _fused_gate_conv(hx, "convz", "convr", self.hidden_dim,
+                                (3, 3), self.dtype)
         q = nn.tanh(conv(self.hidden_dim, 3, dtype=self.dtype, name="convq")(
             jnp.concatenate([r * h, x], axis=-1)))
         return (1 - z) * h + z * q
@@ -53,15 +95,15 @@ class SepConvGRU(nn.Module):
     def __call__(self, h, x):
         # horizontal pass (1x5)
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(conv(self.hidden_dim, (1, 5), dtype=self.dtype, name="convz1")(hx))
-        r = nn.sigmoid(conv(self.hidden_dim, (1, 5), dtype=self.dtype, name="convr1")(hx))
+        z, r = _fused_gate_conv(hx, "convz1", "convr1", self.hidden_dim,
+                                (1, 5), self.dtype)
         q = nn.tanh(conv(self.hidden_dim, (1, 5), dtype=self.dtype, name="convq1")(
             jnp.concatenate([r * h, x], axis=-1)))
         h = (1 - z) * h + z * q
         # vertical pass (5x1)
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(conv(self.hidden_dim, (5, 1), dtype=self.dtype, name="convz2")(hx))
-        r = nn.sigmoid(conv(self.hidden_dim, (5, 1), dtype=self.dtype, name="convr2")(hx))
+        z, r = _fused_gate_conv(hx, "convz2", "convr2", self.hidden_dim,
+                                (5, 1), self.dtype)
         q = nn.tanh(conv(self.hidden_dim, (5, 1), dtype=self.dtype, name="convq2")(
             jnp.concatenate([r * h, x], axis=-1)))
         return (1 - z) * h + z * q

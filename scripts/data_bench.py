@@ -1,0 +1,50 @@
+"""Host data-pipeline throughput: samples/s through the DataLoader.
+
+The TPU step consumes ~30 image-pairs/s at the chairs config (bench.py);
+the host pipeline must beat that or the chip starves (SURVEY.md §7 hard
+part #6).  This measures the loader alone — decode + augment + batch +
+prefetch — with no device in the loop.
+
+    python scripts/data_bench.py [--stage synthetic] [--batches 30]
+
+For real datasets pass --stage chairs --root datasets (requires data on
+disk); the synthetic default runs anywhere.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--stage", default="synthetic")
+    p.add_argument("--root", default="datasets")
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--image_size", type=int, nargs=2, default=(368, 496))
+    p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--batches", type=int, default=30)
+    args = p.parse_args()
+
+    from raft_tpu.data import DataLoader, fetch_dataset
+
+    ds = fetch_dataset(args.stage, tuple(args.image_size), root=args.root)
+    loader = DataLoader(ds, args.batch_size, num_workers=args.num_workers)
+
+    it = iter(loader.epochs())
+    next(it)  # warm the pool
+    t0 = time.perf_counter()
+    for _ in range(args.batches):
+        next(it)
+    dt = time.perf_counter() - t0
+    sps = args.batches * args.batch_size / dt
+    print(f"{args.stage}: {sps:.1f} samples/s "
+          f"({args.batches} batches of {args.batch_size}, "
+          f"{args.num_workers} workers, {args.image_size[0]}x{args.image_size[1]})")
+
+
+if __name__ == "__main__":
+    main()
