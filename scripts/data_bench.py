@@ -33,6 +33,9 @@ def main():
 
     ds = fetch_dataset(args.stage, tuple(args.image_size), root=args.root)
     loader = DataLoader(ds, args.batch_size, num_workers=args.num_workers)
+    if len(loader) == 0:
+        sys.exit(f"dataset too small: {len(ds)} samples < batch_size "
+                 f"{args.batch_size} (loader drops the last short batch)")
 
     it = iter(loader.epochs())
     next(it)  # warm the pool
