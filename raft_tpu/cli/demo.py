@@ -13,7 +13,7 @@ import os
 
 import numpy as np
 
-from raft_tpu.cli.demo_common import (flow_viz_image, infer_flow, list_frames,
+from raft_tpu.cli.demo_common import (add_model_args, flow_viz_image, infer_flow, list_frames,
                                       load_image, load_model, save_image)
 
 
@@ -22,9 +22,7 @@ def parse_args(argv=None):
     p.add_argument("--model", required=True, help="checkpoint path")
     p.add_argument("--path", required=True, help="folder of frames")
     p.add_argument("--output", default="demo_out")
-    p.add_argument("--small", action="store_true")
-    p.add_argument("--mixed_precision", action="store_true")
-    p.add_argument("--alternate_corr", action="store_true")
+    add_model_args(p)
     p.add_argument("--iters", type=int, default=20)  # demo.py:62
     return p.parse_args(argv)
 
@@ -32,7 +30,8 @@ def parse_args(argv=None):
 def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
-                                 args.mixed_precision, args.alternate_corr)
+                                 args.mixed_precision, args.alternate_corr,
+                                 args.corr_impl)
     frames = list_frames(args.path)
     for i, (p1, p2) in enumerate(zip(frames[:-1], frames[1:])):
         image1 = load_image(p1)

@@ -15,8 +15,19 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def add_model_args(p) -> None:
+    """The model flags every demo/eval CLI shares (one source of truth)."""
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--alternate_corr", action="store_true")
+    p.add_argument("--corr_impl", default="chunked",
+                   choices=["chunked", "pallas", "lax"],
+                   help="on-demand correlation implementation "
+                        "(with --alternate_corr)")
+
+
 def load_model(ckpt: str, small: bool = False, mixed_precision: bool = False,
-               alternate_corr: bool = False):
+               alternate_corr: bool = False, corr_impl: str = "chunked"):
     """Build RAFT + load a checkpoint (demo.py:43-48 analogue).
 
     Returns (model, variables, evaluator).
@@ -29,7 +40,8 @@ def load_model(ckpt: str, small: bool = False, mixed_precision: bool = False,
     cfg = RAFTConfig(
         small=small,
         compute_dtype="bfloat16" if mixed_precision else "float32",
-        alternate_corr=alternate_corr)
+        alternate_corr=alternate_corr,
+        corr_impl=corr_impl)
     model = RAFT(cfg)
     variables = load_variables(ckpt, model)
     return model, variables, Evaluator(model, variables)

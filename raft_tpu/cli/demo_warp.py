@@ -12,7 +12,7 @@ from __future__ import annotations
 import argparse
 import os
 
-from raft_tpu.cli.demo_common import (infer_flow, load_image, load_model,
+from raft_tpu.cli.demo_common import (add_model_args, infer_flow, load_image, load_model,
                                       save_image, warp_collage, warp_image)
 
 
@@ -22,9 +22,7 @@ def parse_args(argv=None):
     p.add_argument("--image1", required=True)
     p.add_argument("--image2", required=True)
     p.add_argument("--output", default="warp_out")
-    p.add_argument("--small", action="store_true")
-    p.add_argument("--mixed_precision", action="store_true")
-    p.add_argument("--alternate_corr", action="store_true")
+    add_model_args(p)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--use_cv2", action="store_true",
                    help="cv2.remap warp (demo_warp.py:59-73) instead of "
@@ -37,7 +35,8 @@ def parse_args(argv=None):
 def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
-                                 args.mixed_precision, args.alternate_corr)
+                                 args.mixed_precision, args.alternate_corr,
+                                 args.corr_impl)
     image1 = load_image(args.image1)
     image2 = load_image(args.image2)
     _, flow = infer_flow(evaluator, image1, image2, iters=args.iters)

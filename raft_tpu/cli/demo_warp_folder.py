@@ -9,7 +9,7 @@ from __future__ import annotations
 import argparse
 import os
 
-from raft_tpu.cli.demo_common import (infer_flow, list_frames, load_image,
+from raft_tpu.cli.demo_common import (add_model_args, infer_flow, list_frames, load_image,
                                       load_model, save_image, warp_collage,
                                       warp_image)
 
@@ -19,9 +19,7 @@ def parse_args(argv=None):
     p.add_argument("--model", required=True)
     p.add_argument("--path", required=True, help="folder of frames")
     p.add_argument("--output", default="warp_folder_out")
-    p.add_argument("--small", action="store_true")
-    p.add_argument("--mixed_precision", action="store_true")
-    p.add_argument("--alternate_corr", action="store_true")
+    add_model_args(p)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--use_cv2", action="store_true")
     return p.parse_args(argv)
@@ -30,7 +28,8 @@ def parse_args(argv=None):
 def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
-                                 args.mixed_precision, args.alternate_corr)
+                                 args.mixed_precision, args.alternate_corr,
+                                 args.corr_impl)
     frames = list_frames(args.path)
     for i, (p1, p2) in enumerate(zip(frames[:-1], frames[1:])):
         image1 = load_image(p1)

@@ -16,7 +16,7 @@ import os
 
 import numpy as np
 
-from raft_tpu.cli.demo_common import (list_frames, load_image, load_model,
+from raft_tpu.cli.demo_common import (add_model_args, list_frames, load_image, load_model,
                                       save_image, warp_image)
 
 
@@ -25,9 +25,7 @@ def parse_args(argv=None):
     p.add_argument("--model", required=True)
     p.add_argument("--path", required=True, help="folder of frames")
     p.add_argument("--output", default="warp_firstframe_out")
-    p.add_argument("--small", action="store_true")
-    p.add_argument("--mixed_precision", action="store_true")
-    p.add_argument("--alternate_corr", action="store_true")
+    add_model_args(p)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--use_cv2", action="store_true")
     return p.parse_args(argv)
@@ -48,7 +46,8 @@ def resize_to_multiple_of_8(img: np.ndarray) -> np.ndarray:
 def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
-                                 args.mixed_precision, args.alternate_corr)
+                                 args.mixed_precision, args.alternate_corr,
+                                 args.corr_impl)
     frames = list_frames(args.path)
     images = [resize_to_multiple_of_8(load_image(p)) for p in frames]
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 import argparse
 import os
 
-from raft_tpu.cli.demo_common import (infer_flow, load_image, load_model,
+from raft_tpu.cli.demo_common import (add_model_args, infer_flow, load_image, load_model,
                                       save_image, warp_collage, warp_image)
 
 
@@ -19,9 +19,7 @@ def parse_args(argv=None):
     p.add_argument("--imglist", required=True,
                    help="text file, one 'path1 path2' pair per line")
     p.add_argument("--output", default="warp_imglist_out")
-    p.add_argument("--small", action="store_true")
-    p.add_argument("--mixed_precision", action="store_true")
-    p.add_argument("--alternate_corr", action="store_true")
+    add_model_args(p)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--use_cv2", action="store_true")
     return p.parse_args(argv)
@@ -40,7 +38,8 @@ def read_pairs(path: str):
 def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
-                                 args.mixed_precision, args.alternate_corr)
+                                 args.mixed_precision, args.alternate_corr,
+                                 args.corr_impl)
     for i, (p1, p2) in enumerate(read_pairs(args.imglist)):
         image1 = load_image(p1)
         image2 = load_image(p2)

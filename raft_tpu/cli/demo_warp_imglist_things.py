@@ -12,7 +12,7 @@ from __future__ import annotations
 import argparse
 import os
 
-from raft_tpu.cli.demo_common import (infer_flow, load_image, load_model,
+from raft_tpu.cli.demo_common import (add_model_args, infer_flow, load_image, load_model,
                                       save_image, warp_collage, warp_image)
 from raft_tpu.data.datasets import SPLITS_DIR
 
@@ -26,9 +26,7 @@ def parse_args(argv=None):
                    default=os.path.join(SPLITS_DIR,
                                         "FlyingThings3D_subset_train_split.txt"))
     p.add_argument("--output", default="warp_things_out")
-    p.add_argument("--small", action="store_true")
-    p.add_argument("--mixed_precision", action="store_true")
-    p.add_argument("--alternate_corr", action="store_true")
+    add_model_args(p)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--use_cv2", action="store_true")
     p.add_argument("--max_sequences", type=int, default=None)
@@ -50,7 +48,8 @@ def read_sequences(split_file: str):
 def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
-                                 args.mixed_precision, args.alternate_corr)
+                                 args.mixed_precision, args.alternate_corr,
+                                 args.corr_impl)
     seqs = read_sequences(args.split_file)
     if args.max_sequences:
         seqs = seqs[: args.max_sequences]

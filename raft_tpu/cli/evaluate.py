@@ -17,10 +17,9 @@ def parse_args(argv=None):
     p.add_argument("--dataset", required=True,
                    choices=["chairs", "sintel", "kitti", "synthetic",
                             "sintel_submission", "kitti_submission"])
-    p.add_argument("--small", action="store_true")
+    from raft_tpu.cli.demo_common import add_model_args
+    add_model_args(p)
     p.add_argument("--iters", type=int, default=None)
-    p.add_argument("--mixed_precision", action="store_true")
-    p.add_argument("--alternate_corr", action="store_true")
     p.add_argument("--datasets_root", default="datasets")
     p.add_argument("--output_path", default=None)
     p.add_argument("--warm_start", action="store_true",
@@ -74,7 +73,8 @@ def main(argv=None):
     cfg = RAFTConfig(
         small=args.small,
         compute_dtype="bfloat16" if args.mixed_precision else "float32",
-        alternate_corr=args.alternate_corr)
+        alternate_corr=args.alternate_corr,
+        corr_impl=args.corr_impl)
     model = RAFT(cfg)
     variables = load_variables(args.model, model)
     ev = Evaluator(model, variables)

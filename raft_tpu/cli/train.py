@@ -60,7 +60,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "or explicit ring-ppermute (parallel/ring.py)")
     # extras
     p.add_argument("--alternate_corr", action="store_true",
-                   help="on-demand Pallas correlation (low HBM)")
+                   help="on-demand correlation (O(H*W) memory; "
+                        "differentiable, unlike the reference's)")
+    p.add_argument("--corr_impl", default="chunked",
+                   choices=["chunked", "pallas", "lax"],
+                   help="on-demand correlation implementation "
+                        "(with --alternate_corr)")
     p.add_argument("--corr_dtype", default=None,
                    choices=["float32", "bfloat16"],
                    help="corr pyramid storage/contraction dtype; bfloat16 "
@@ -90,6 +95,7 @@ def build_config(args):
         small=args.small,
         dropout=args.dropout,
         alternate_corr=args.alternate_corr,
+        corr_impl=args.corr_impl,
         corr_shard=args.spatial_parallel > 1,
         corr_shard_impl=args.corr_shard_impl,
         **({"corr_dtype": args.corr_dtype} if args.corr_dtype else {}),

@@ -66,6 +66,12 @@ class RAFTConfig:
         if self.corr_impl not in ("chunked", "pallas", "lax"):
             raise ValueError(f"corr_impl must be 'chunked', 'pallas' or "
                              f"'lax', got {self.corr_impl!r}")
+        if self.corr_impl != "chunked" and not self.alternate_corr:
+            raise ValueError(
+                "corr_impl selects the on-demand lookup implementation and "
+                "is only consulted when alternate_corr=True — without it "
+                "the materialized all-pairs path runs and the requested "
+                f"corr_impl={self.corr_impl!r} would be silently ignored")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"compute_dtype must be 'float32' or "
                              f"'bfloat16', got {self.compute_dtype!r}")
