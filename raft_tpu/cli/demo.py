@@ -13,8 +13,9 @@ import os
 
 import numpy as np
 
-from raft_tpu.cli.demo_common import (add_model_args, flow_viz_image, infer_flow, list_frames,
-                                      load_image, load_model, save_image)
+from raft_tpu.cli.demo_common import (
+    add_model_args, flow_viz_image, infer_flow, list_frames, load_image,
+    load_model, save_image)
 
 
 def parse_args(argv=None):

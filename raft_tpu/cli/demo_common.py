@@ -17,11 +17,12 @@ import numpy as np
 
 def add_model_args(p) -> None:
     """The model flags every demo/eval CLI shares (one source of truth)."""
+    from raft_tpu.config import CORR_IMPLS
+
     p.add_argument("--small", action="store_true")
     p.add_argument("--mixed_precision", action="store_true")
     p.add_argument("--alternate_corr", action="store_true")
-    p.add_argument("--corr_impl", default="chunked",
-                   choices=["chunked", "pallas", "lax"],
+    p.add_argument("--corr_impl", default="chunked", choices=CORR_IMPLS,
                    help="on-demand correlation implementation "
                         "(with --alternate_corr)")
 

@@ -16,8 +16,9 @@ import os
 
 import numpy as np
 
-from raft_tpu.cli.demo_common import (add_model_args, list_frames, load_image, load_model,
-                                      save_image, warp_image)
+from raft_tpu.cli.demo_common import (
+    add_model_args, list_frames, load_image, load_model, save_image,
+    warp_image)
 
 
 def parse_args(argv=None):

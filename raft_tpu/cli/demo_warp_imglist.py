@@ -9,8 +9,9 @@ from __future__ import annotations
 import argparse
 import os
 
-from raft_tpu.cli.demo_common import (add_model_args, infer_flow, load_image, load_model,
-                                      save_image, warp_collage, warp_image)
+from raft_tpu.cli.demo_common import (
+    add_model_args, infer_flow, load_image, load_model, save_image,
+    warp_collage, warp_image)
 
 
 def parse_args(argv=None):

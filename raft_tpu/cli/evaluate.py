@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import argparse
 
+from raft_tpu.cli.demo_common import add_model_args
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser("raft_tpu evaluation")
@@ -17,7 +19,6 @@ def parse_args(argv=None):
     p.add_argument("--dataset", required=True,
                    choices=["chairs", "sintel", "kitti", "synthetic",
                             "sintel_submission", "kitti_submission"])
-    from raft_tpu.cli.demo_common import add_model_args
     add_model_args(p)
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--datasets_root", default="datasets")

@@ -62,8 +62,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--alternate_corr", action="store_true",
                    help="on-demand correlation (O(H*W) memory; "
                         "differentiable, unlike the reference's)")
-    p.add_argument("--corr_impl", default="chunked",
-                   choices=["chunked", "pallas", "lax"],
+    from raft_tpu.config import CORR_IMPLS
+    p.add_argument("--corr_impl", default="chunked", choices=CORR_IMPLS,
                    help="on-demand correlation implementation "
                         "(with --alternate_corr)")
     p.add_argument("--corr_dtype", default=None,

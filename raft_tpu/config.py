@@ -12,6 +12,12 @@ import dataclasses
 from typing import Optional, Sequence, Tuple
 
 
+# On-demand correlation implementations (ops/corr.py chunked_corr_lookup,
+# ops/corr_pallas.py, ops/corr.py alternate_corr_lookup) — the single
+# source for config validation and every CLI's --corr_impl choices.
+CORR_IMPLS = ("chunked", "pallas", "lax")
+
+
 @dataclasses.dataclass(frozen=True)
 class RAFTConfig:
     """Model hyperparameters.
@@ -63,9 +69,9 @@ class RAFTConfig:
     corr_shard_impl: str = "gspmd"  # "gspmd" | "ring"
 
     def __post_init__(self):
-        if self.corr_impl not in ("chunked", "pallas", "lax"):
-            raise ValueError(f"corr_impl must be 'chunked', 'pallas' or "
-                             f"'lax', got {self.corr_impl!r}")
+        if self.corr_impl not in CORR_IMPLS:
+            raise ValueError(f"corr_impl must be one of {CORR_IMPLS}, "
+                             f"got {self.corr_impl!r}")
         if self.corr_impl != "chunked" and not self.alternate_corr:
             raise ValueError(
                 "corr_impl selects the on-demand lookup implementation and "

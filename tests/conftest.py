@@ -23,16 +23,16 @@ if not _ON_DEVICE:
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
 
-    import jax
+import jax  # noqa: E402
 
-    # The env var alone does not beat the axon plugin registration; the
-    # config update does.
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", False)
-else:
-    import jax
+# The env var alone does not beat the axon plugin registration;
+# ensure_platform applies the jax.config update that does.  In device
+# mode the env is untouched above, so this still honors an explicit
+# JAX_PLATFORMS=cpu (e.g. exercising the skip logic without hardware).
+from raft_tpu.utils.platform import ensure_platform  # noqa: E402
 
-    jax.config.update("jax_enable_x64", False)
+ensure_platform()
+jax.config.update("jax_enable_x64", False)
 
 
 def pytest_collection_modifyitems(config, items):
