@@ -21,6 +21,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from raft_tpu.config import CORR_IMPLS
+
 
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser("raft_tpu training")
@@ -62,7 +64,6 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--alternate_corr", action="store_true",
                    help="on-demand correlation (O(H*W) memory; "
                         "differentiable, unlike the reference's)")
-    from raft_tpu.config import CORR_IMPLS
     p.add_argument("--corr_impl", default="chunked", choices=CORR_IMPLS,
                    help="on-demand correlation implementation "
                         "(with --alternate_corr)")

@@ -13,15 +13,34 @@ from __future__ import annotations
 import os
 
 
-def ensure_platform() -> None:
-    """Apply the JAX_PLATFORMS env choice via jax.config (idempotent)."""
+def force_cpu(strict: bool = False) -> bool:
+    """jax.config-force the cpu platform; returns False (or raises with
+    ``strict``) when the backend is already initialized."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except RuntimeError:
+        if strict:
+            raise
+        return False
+
+
+def ensure_platform(honor_device_count_flag: bool = True,
+                    strict: bool = False) -> None:
+    """Apply the JAX_PLATFORMS env choice via jax.config (idempotent).
+
+    ``honor_device_count_flag=False`` restricts the trigger to an explicit
+    JAX_PLATFORMS=cpu — used by on-device test runs, where a stale
+    ``--xla_force_host_platform_device_count`` left in XLA_FLAGS must not
+    silently turn hardware validation into a virtual-CPU run.  ``strict``
+    raises instead of silently proceeding on the pinned backend when the
+    cpu override can no longer take effect (backend already initialized).
+    """
     want = os.environ.get("JAX_PLATFORMS", "")
-    forced_cpu = ("force_host_platform_device_count"
+    forced_cpu = (honor_device_count_flag
+                  and "force_host_platform_device_count"
                   in os.environ.get("XLA_FLAGS", ""))
     if want == "cpu" or (forced_cpu and not want):
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except RuntimeError:
-            pass  # backend already initialized; nothing safe to do
+        force_cpu(strict=strict)

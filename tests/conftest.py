@@ -26,12 +26,15 @@ if not _ON_DEVICE:
 import jax  # noqa: E402
 
 # The env var alone does not beat the axon plugin registration;
-# ensure_platform applies the jax.config update that does.  In device
-# mode the env is untouched above, so this still honors an explicit
-# JAX_PLATFORMS=cpu (e.g. exercising the skip logic without hardware).
+# ensure_platform applies the jax.config update that does.  CPU mode is
+# strict (a silently-ineffective override would run the suite against
+# the pinned TPU backend); device mode only honors an EXPLICIT
+# JAX_PLATFORMS=cpu — a stale device-count XLA_FLAG must not silently
+# turn hardware validation into a virtual-CPU run.
 from raft_tpu.utils.platform import ensure_platform  # noqa: E402
 
-ensure_platform()
+ensure_platform(honor_device_count_flag=not _ON_DEVICE,
+                strict=not _ON_DEVICE)
 jax.config.update("jax_enable_x64", False)
 
 
