@@ -2,11 +2,18 @@
 
 Prints ONE JSON line:
   {"metric": "image-pairs/sec/chip", "value": N, "unit": "pairs/s",
-   "vs_baseline": N}
+   "vs_baseline": N, "mfu": N, "fed_pairs_per_s": N}
 
 Measured config mirrors the reference's mixed-precision chairs recipe
 (train_mixed.sh:3: batch 8, crop 368x496, 12 refinement iterations,
 bf16 compute) — the primary metric named in BASELINE.json.
+
+- ``value``: device-rate pairs/s, synthetic resident batch (pure step time).
+- ``mfu``: model FLOPs utilization — XLA's analyzed FLOPs per step divided
+  by (step time x chip peak bf16 FLOP/s).
+- ``fed_pairs_per_s``: same step fed by the real host pipeline
+  (SyntheticShift + dense augmentor -> DataLoader -> prefetch_to_device),
+  proving the loader sustains the device rate.
 
 Baseline: the reference repo publishes no numbers (BASELINE.md).  The
 denominator used here is 7.0 pairs/s — an A100 estimate derived from the
@@ -17,14 +24,98 @@ speedup).  vs_baseline = measured / 7.0, so 2.0 meets the north-star
 """
 
 import json
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 A100_BASELINE_PAIRS_PER_S = 7.0
 
+# Dense bf16 peak FLOP/s by TPU generation (device_kind substrings,
+# checked in order).  Used for the MFU line only.
+_PEAK_BF16 = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v4", 275e12),
+]
+
+
+def _fail(reason: str) -> None:
+    """The driver records this script's stdout as the round's scoreboard;
+    protect it — one parseable line with a diagnosis, not a traceback."""
+    print(json.dumps({
+        "metric": "image-pairs/sec/chip", "value": 0.0, "unit": "pairs/s",
+        "vs_baseline": 0.0,
+        "error": f"{reason} — recover the TPU tunnel, then run "
+                 "scripts/tpu_validation.py",
+    }))
+    sys.exit(1)
+
+
+def preflight(attempts: int = 2, timeout_s: int = 150) -> None:
+    """Probe backend init in a subprocess so a hung tunnel cannot wedge the
+    bench itself (round-1 failure mode: BENCH_r01 died 40 frames deep in
+    device_put when the axon backend was down).  Also rejects a silent CPU
+    fallback — a CPU run of the chairs config takes minutes per step and
+    would poison the scoreboard; set RAFT_BENCH_ALLOW_CPU=1 to bench on
+    CPU deliberately."""
+    import os
+
+    code = ("import jax; d = jax.devices()[0]; "
+            "print(d.platform, '|', d.device_kind)")
+    last = ""
+    for i in range(attempts):
+        if i:
+            time.sleep(20)
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            last = f"backend init timed out after {timeout_s}s"
+            continue
+        if proc.returncode == 0:
+            platform = proc.stdout.split("|")[0].strip()
+            if (platform == "cpu"
+                    and os.environ.get("RAFT_BENCH_ALLOW_CPU", "") in
+                    ("", "0")):
+                _fail("backend fell back to CPU (expected the tunneled "
+                      "TPU; set RAFT_BENCH_ALLOW_CPU=1 to bench on CPU "
+                      "anyway)")
+            return
+        tail = (proc.stderr or "").strip().splitlines()
+        last = tail[-1][:300] if tail else f"rc={proc.returncode}"
+    _fail(f"backend unavailable ({last})")
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return 0.0
+
+
+def _make_fed_loader(B, H, W, seed: int = 1):
+    """Host pipeline for the fed benchmark: procedural image pairs run
+    through the real dense augmentor (jitter/scale/crop — the chairs
+    recipe's host-side cost), batched and prefetched by the real loader."""
+    from raft_tpu.data.datasets import SyntheticShift
+    from raft_tpu.data.loader import DataLoader
+
+    ds = SyntheticShift(
+        image_size=(H + 32, W + 32), length=512, seed=seed,
+        aug_params=dict(crop_size=(H, W), min_scale=0.0, max_scale=0.2,
+                        do_flip=True))
+    return DataLoader(ds, batch_size=B, num_workers=4, drop_last=True,
+                      seed=seed, prefetch=3)
+
 
 def main():
+    preflight()
+
     import jax
     import jax.numpy as jnp
 
@@ -67,6 +158,19 @@ def main():
     step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0,
                            donate=True)
 
+    # Compile once via lower/compile: the same executable serves the timing
+    # loop AND exposes XLA's FLOPs estimate for the MFU line.
+    flops_per_step = 0.0
+    try:
+        compiled = step.lower(state, batch).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops_per_step = float((ca or {}).get("flops", 0.0))
+        step = compiled
+    except Exception:
+        pass  # fall back to the plain jitted step; mfu reported as 0
+
     # Warmup / compile.  Synchronization must be a host copy: over the
     # axon tunnel, block_until_ready returns before execution finishes,
     # which silently times dispatch instead of compute.
@@ -81,11 +185,34 @@ def main():
     dt = time.perf_counter() - t0
 
     pairs_per_s = B * n_steps / dt
+    peak = _peak_flops(jax.devices()[0])
+    mfu = (flops_per_step * n_steps / dt / peak) if peak else 0.0
+
+    # Fed variant: identical step, batches produced by the host pipeline.
+    fed_pairs_per_s = 0.0
+    try:
+        loader = _make_fed_loader(B, H, W)
+        from raft_tpu.data.loader import prefetch_to_device
+        it = prefetch_to_device(iter(loader), size=2)
+        fed0 = next(it)  # warm the pipeline (+ any reshape recompile)
+        state, metrics = step(state, fed0)
+        float(metrics["loss"])
+        n_fed = 10
+        t0 = time.perf_counter()
+        for _ in range(n_fed):
+            state, metrics = step(state, next(it))
+        float(metrics["loss"])
+        fed_pairs_per_s = B * n_fed / (time.perf_counter() - t0)
+    except Exception as e:  # the fed lane must never sink the scoreboard
+        print(f"fed bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "image-pairs/sec/chip",
         "value": round(pairs_per_s, 3),
         "unit": "pairs/s",
         "vs_baseline": round(pairs_per_s / A100_BASELINE_PAIRS_PER_S, 3),
+        "mfu": round(mfu, 4),
+        "fed_pairs_per_s": round(fed_pairs_per_s, 3),
     }))
 
 

@@ -46,11 +46,14 @@ def load_variables(path: str, model, sample_shape=(1, 368, 496, 3)):
 
     import flax
 
+    from raft_tpu.training.state import _migrate_mask_head
+
     rng = np.random.default_rng(0)
     img = rng.uniform(0, 255, sample_shape).astype(np.float32)
     variables = model.init(jax.random.PRNGKey(0), img, img, iters=1)
     with open(path, "rb") as f:
         payload = flax.serialization.msgpack_restore(f.read())
+    payload = _migrate_mask_head(payload)
     out = {"params": flax.serialization.from_state_dict(
         variables["params"], payload["params"])}
     if payload.get("batch_stats"):

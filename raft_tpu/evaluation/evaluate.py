@@ -29,19 +29,26 @@ class Evaluator:
     """Shape-bucketed jitted forward for eval (batch=1, test_mode).
 
     Eval-time inputs vary in size (KITTI especially), so the jitted forward
-    is cached per padded shape; each unique shape compiles once.
+    is cached per padded shape; each unique shape compiles once.  The cache
+    is LRU-bounded: arbitrary-folder demos with heterogeneous frame sizes
+    would otherwise hold every compiled executable forever.  Evictions are
+    reported on stderr so a shape-thrashing workload is visible instead of
+    silently slow.
     """
 
-    def __init__(self, model, variables):
+    def __init__(self, model, variables, max_cached_shapes: int = 16):
         self.model = model
         self.variables = variables
-        self._cache: Dict = {}
+        self.max_cached_shapes = max_cached_shapes
+        import collections
+        self._cache = collections.OrderedDict()
 
     def __call__(self, image1: np.ndarray, image2: np.ndarray, iters: int,
                  flow_init: Optional[np.ndarray] = None):
         warm = flow_init is not None
         key = (image1.shape, iters, warm)
-        if key not in self._cache:
+        fn = self._cache.get(key)
+        if fn is None:
             model = self.model
             if warm:
                 fn = jax.jit(lambda v, a, b, f: model.apply(
@@ -49,8 +56,16 @@ class Evaluator:
             else:
                 fn = jax.jit(lambda v, a, b: model.apply(
                     v, a, b, iters=iters, test_mode=True))
+            if len(self._cache) >= self.max_cached_shapes:
+                import sys
+                old_key, _ = self._cache.popitem(last=False)
+                print(f"Evaluator: evicting compiled shape {old_key} "
+                      f"(cache limit {self.max_cached_shapes}; heterogeneous "
+                      f"frame sizes recompile per shape — consider padding "
+                      f"to a common size)", file=sys.stderr)
             self._cache[key] = fn
-        fn = self._cache[key]
+        else:
+            self._cache.move_to_end(key)
         if warm:
             return fn(self.variables, image1, image2, flow_init)
         return fn(self.variables, image1, image2)

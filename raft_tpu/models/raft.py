@@ -37,6 +37,7 @@ from raft_tpu.ops.corr import (
     build_fmap_pyramid,
     chunked_corr_lookup,
     corr_lookup,
+    stacked_pyramid_cotangent,
 )
 from raft_tpu.ops.grid import (convex_upsample, coords_grid, pack_fine,
                                upflow8)
@@ -67,7 +68,7 @@ class RefinementStep(nn.Module):
     cfg: RAFTConfig
 
     @nn.compact
-    def __call__(self, carry, inp, corr_state, coords0):
+    def __call__(self, carry, inp, corr_state, coords0, corr_bias=None):
         cfg = self.cfg
         dtype = _compute_dtype(cfg)
         net, coords1 = carry
@@ -90,6 +91,11 @@ class RefinementStep(nn.Module):
         else:
             corr = corr_lookup(corr_state, coords1, cfg.corr_radius,
                                shard=cfg.corr_shard)
+        if corr_bias is not None:
+            # Deferred-grad path: the pyramid above is stop_gradient'd and
+            # this zero scanned input carries the window cotangent out of
+            # the scan instead (see RAFT.__call__ / cfg.deferred_corr_grad).
+            corr = corr + corr_bias
 
         flow = coords1 - coords0
         corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1) ** 2
@@ -199,14 +205,67 @@ class RAFT(nn.Module):
                                     policy=resolve_remat_policy(cfg.remat_policy))
             else:
                 step_cls = nn.remat(step_cls)
+
+        # Deferred pyramid cotangent (dense path, gradient contexts): the
+        # scan sees stop_gradient(pyramid) + a zero per-iteration window
+        # bias; the bias' stacked cotangent rebuilds d_pyramid with one
+        # contraction per level AFTER the scan (ops/corr.py
+        # stacked_pyramid_cotangent) instead of `iters` volume-sized
+        # accumulate-adds inside the backward scan.  test_mode skips it
+        # (no backward; avoids the zeros input entirely).
+        use_deferred = (cfg.deferred_corr_grad and not cfg.alternate_corr
+                        and not test_mode)
+
+        in_axes = (nn.broadcast, nn.broadcast, nn.broadcast) \
+            + ((0,) if use_deferred else ())
         scan = nn.scan(step_cls,
                        variable_broadcast="params",
                        split_rngs={"params": False},
-                       in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                       in_axes=in_axes,
                        out_axes=0,
                        length=iters)
-        (net, coords1), (flows_lr, nets) = scan(cfg, name="refine")(
-            (net, coords1), inp, corr_state, coords0)
+        refine_mod = scan(cfg, name="refine")
+
+        if use_deferred:
+            corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1) ** 2
+            win_zeros = jnp.zeros((iters, B, H8, W8, corr_ch), jnp.float32)
+            level_shapes = [p.shape[2:] for p in corr_state]
+            level_dtypes = [p.dtype for p in corr_state]
+
+            def f(mdl, pyramid, win_bias, carry0, inp_, coords0_):
+                return mdl(carry0, inp_, pyramid, coords0_, win_bias)
+
+            def fwd(mdl, pyramid, win_bias, carry0, inp_, coords0_):
+                def f_sg(mdl, win_bias, carry0, inp_, coords0_):
+                    sg = jax.tree.map(jax.lax.stop_gradient, pyramid)
+                    return mdl(carry0, inp_, sg, coords0_, win_bias)
+
+                out, vjp_fn = nn.vjp(f_sg, mdl, win_bias, carry0, inp_,
+                                     coords0_)
+                (_, (flows_out, _)) = out
+                # lookup coords at each iteration ENTRY: the initial
+                # coords1 (incl. warm start), then each iterate's output
+                entry = jnp.concatenate(
+                    [carry0[1][None], (coords0_[None] + flows_out)[:-1]],
+                    axis=0)
+                return out, (vjp_fn, entry)
+
+            def bwd(residuals, cotangents):
+                vjp_fn, entry = residuals
+                params_t, win_t, carry0_t, inp_t, coords0_t = vjp_fn(
+                    cotangents)
+                pyr_t = stacked_pyramid_cotangent(
+                    win_t, entry, cfg.corr_radius, level_shapes,
+                    level_dtypes, shard=cfg.corr_shard)
+                return (params_t, pyr_t, win_t, carry0_t, inp_t, coords0_t)
+
+            refine = nn.custom_vjp(f, forward_fn=fwd, backward_fn=bwd)
+            (net, coords1), (flows_lr, nets) = refine(
+                refine_mod, corr_state, win_zeros, (net, coords1), inp,
+                coords0)
+        else:
+            (net, coords1), (flows_lr, nets) = refine_mod(
+                (net, coords1), inp, corr_state, coords0)
 
         mask_head = (None if cfg.small
                      else MaskHead(dtype=dtype, name="mask_head"))

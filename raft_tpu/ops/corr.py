@@ -91,18 +91,22 @@ def build_corr_pyramid_direct(fmap1: jax.Array, fmap2: jax.Array,
     _check_pyramid_depth(H, W, num_levels)
     # bf16 storage implies bf16 matmul inputs: full MXU rate and half the
     # fmap HBM reads, with f32 accumulation — the result is rounded to
-    # bf16 for storage either way, so the extra input rounding is within
-    # the path's existing error budget (see corr_dtype docs).
+    # bf16 for storage either way, so the per-level input rounding is
+    # within the path's existing error budget (see corr_dtype docs).
+    # The pooling CHAIN stays float32: pooling in bf16 would compound a
+    # rounding per level into the coarse pyramid entries, an error source
+    # the all-pairs oracle (f32 pool of the f32 volume) does not have.
     in_dt = jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
     f1 = fmap1.reshape(B, H * W, C).astype(in_dt)
     scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(C))
     pyramid = []
-    f2 = fmap2.astype(in_dt)
+    f2 = fmap2.astype(jnp.float32)
     for lvl in range(num_levels):
         if lvl:
             f2 = avg_pool2x(f2)
         Hl, Wl = f2.shape[1], f2.shape[2]
-        corr = jnp.einsum("bqc,btc->bqt", f1, f2.reshape(B, Hl * Wl, C),
+        corr = jnp.einsum("bqc,btc->bqt", f1,
+                          f2.reshape(B, Hl * Wl, C).astype(in_dt),
                           preferred_element_type=jnp.float32)
         pyramid.append((corr * scale).reshape(B, H * W, Hl, Wl).astype(dtype))
     return pyramid
@@ -223,6 +227,69 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
                          precision=prec)  # (N, kx, ky)
         out.append(win.reshape(B, H1, W1, k1 * k1))
     return jnp.concatenate(out, axis=-1).astype(jnp.float32)
+
+
+def stacked_pyramid_cotangent(d_win: jax.Array, entry_coords: jax.Array,
+                              radius: int,
+                              level_shapes: Sequence[tuple],
+                              level_dtypes: Sequence,
+                              shard: bool = False):
+    """d_pyramid from the stacked per-iteration window cotangents.
+
+    The lookup is LINEAR in the pyramid (coords are stop_gradient'd per
+    iteration, raft.py:123), so the total pyramid cotangent is
+
+        d_pyr_l[n,h,w] = sum_i RY_i^T[n,·,h] · d_win_i[n,·,·] · RX_i[n,·,w]
+
+    computed here as one contraction per level over the merged
+    (iteration, window-tap) axis — replacing the `iters` volume-sized
+    accumulate-adds a plain backward scan performs (the select_add chain
+    the profiler showed at ~26 ms/step).  Used by the deferred-grad
+    refinement wrapper in models/raft.py (cfg.deferred_corr_grad).
+
+    Args:
+      d_win: (iters, B, H1, W1, L*(2r+1)^2) f32 stacked window cotangents.
+      entry_coords: (iters, B, H1, W1, 2) lookup coordinates at each
+        iteration ENTRY (i.e. what corr_lookup saw).
+      level_shapes: [(H_l, W_l), ...] target extents per level.
+      level_dtypes: pyramid dtypes per level (cotangent dtype must match
+        the primal's).
+
+    Returns:
+      tuple of (B, H1*W1, H_l, W_l) arrays.
+    """
+    it, B, H1, W1, _ = d_win.shape
+    Q = H1 * W1
+    N = B * Q
+    k1 = 2 * radius + 1
+    cx = entry_coords[..., 0].reshape(it * N, 1).astype(jnp.float32)
+    cy = entry_coords[..., 1].reshape(it * N, 1).astype(jnp.float32)
+    out = []
+    ofs = 0
+    for lvl, ((H2, W2), dt) in enumerate(zip(level_shapes, level_dtypes)):
+        # (i, n, kx, ky) — x-major window flattening, as in corr_lookup
+        D = d_win[..., ofs:ofs + k1 * k1].reshape(it, N, k1, k1) \
+            .astype(jnp.float32)
+        ofs += k1 * k1
+        ry = onehot_lerp_weights(cy / (2.0 ** lvl), radius, H2) \
+            .reshape(it, N, k1, H2)
+        rx = onehot_lerp_weights(cx / (2.0 ** lvl), radius, W2) \
+            .reshape(it, N, k1, W2)
+        if shard:
+            from jax.sharding import PartitionSpec as P
+            from raft_tpu.parallel.mesh import (DATA_AXIS, SPATIAL_AXIS,
+                                                constrain)
+            spec = P(None, (DATA_AXIS, SPATIAL_AXIS), None, None)
+            D = constrain(D, spec)
+            ry = constrain(ry, spec)
+            rx = constrain(rx, spec)
+        # contract kx first, then (i, ky) in one batched matmul
+        tmp = jnp.einsum("injk,injw->inkw", D, rx,
+                         preferred_element_type=jnp.float32)
+        d_img = jnp.einsum("inkh,inkw->nhw", ry, tmp,
+                           preferred_element_type=jnp.float32)
+        out.append(d_img.reshape(B, Q, H2, W2).astype(dt))
+    return tuple(out)
 
 
 def build_fmap_pyramid(fmap: jax.Array, num_levels: int = 4) -> List[jax.Array]:

@@ -3,7 +3,7 @@ batch 8, 368x496, 12 iters) to guide optimization.  Not part of the test
 suite; run on the real chip:  python scripts/perf_probe.py [variant ...]
 
 Variants: current, alt_pallas, alt_lax, alt_chunked, no_remat_policy,
-convs_saved, fwd_only
+no_deferred_grad, convs_saved, fwd_only
 """
 
 import os
@@ -95,6 +95,8 @@ def main():
         # remote XLA compile service for ~45 min at the chairs config —
         # don't re-add without a compile-time budget.
         "no_remat_policy": lambda: RAFTConfig(**{**base, "remat_policy": ""}),
+        "no_deferred_grad": lambda: RAFTConfig(
+            **{**base, "deferred_corr_grad": False}),
         "convs_saved": lambda: RAFTConfig(
             **{**base, "remat_policy": "convs_and_dots_saveable"}),
         "fwd_only": lambda: RAFTConfig(**base),

@@ -38,17 +38,37 @@ ensure_platform(honor_device_count_flag=not _ON_DEVICE,
 jax.config.update("jax_enable_x64", False)
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run tests marked slow (full lane; default is the <5 min "
+             "fast lane)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "needs_mesh: test requires an 8-device mesh (virtual "
+        "CPU devices or a real multi-chip slice)")
+    config.addinivalue_line(
+        "markers", "slow: long-running end-to-end test; excluded from the "
+        "default fast lane (opt in with --runslow or RAFT_FULL_TESTS=1)")
+
+
 def pytest_collection_modifyitems(config, items):
-    """On-device runs: skip tests needing more devices than exist."""
-    if not _ON_DEVICE:
-        return
-    import jax
     import pytest
 
-    if jax.device_count() >= 8:
-        return
-    needs_mesh = ("parallel", "ring", "sharding", "dist")
-    marker = pytest.mark.skip(reason="needs 8 devices; on-device run")
+    run_slow = (config.getoption("--runslow")
+                or os.environ.get("RAFT_FULL_TESTS", "") not in ("", "0"))
+    skip_slow = pytest.mark.skip(
+        reason="slow: fast lane (use --runslow for the full lane)")
+
+    import jax
+    few_devices = _ON_DEVICE and jax.device_count() < 8
+    skip_mesh = pytest.mark.skip(
+        reason="needs_mesh: fewer than 8 devices on this backend")
+
     for item in items:
-        if any(k in item.nodeid.lower() for k in needs_mesh):
-            item.add_marker(marker)
+        if not run_slow and "slow" in item.keywords:
+            item.add_marker(skip_slow)
+        if few_devices and "needs_mesh" in item.keywords:
+            item.add_marker(skip_mesh)

@@ -27,6 +27,7 @@ def _tiny_state():
                               iters=1)
 
 
+@pytest.mark.slow
 def test_async_checkpointer_roundtrip(tmp_path):
     from raft_tpu.training import AsyncCheckpointer
     from raft_tpu.training.state import restore_checkpoint

@@ -47,6 +47,7 @@ def frame_dir(tmp_path_factory):
     return str(d)
 
 
+@pytest.mark.slow
 def test_demo_flow_viz(small_ckpt, frame_dir, tmp_path):
     from raft_tpu.cli import demo
 
@@ -84,6 +85,7 @@ def test_demo_warp_imglist(small_ckpt, frame_dir, tmp_path):
     assert os.listdir(out) == ["collage_0000.png"]
 
 
+@pytest.mark.slow
 def test_demo_warp_folder_and_firstframe(small_ckpt, frame_dir, tmp_path):
     from raft_tpu.cli import demo_warp_folder, demo_warp_folder_firstframe
 

@@ -5,6 +5,7 @@ the lookup must preserve it through the B*Q reshape."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu.ops.corr import (all_pairs_correlation, build_corr_pyramid,
@@ -12,6 +13,8 @@ from raft_tpu.ops.corr import (all_pairs_correlation, build_corr_pyramid,
 from raft_tpu.ops.grid import coords_grid
 from raft_tpu.parallel import make_mesh
 from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
+
+pytestmark = pytest.mark.needs_mesh
 
 RNG = np.random.default_rng(3)
 
@@ -58,3 +61,38 @@ def test_pyramid_and_lookup_stay_sharded():
                           coords, radius=2)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_spatial_sharding_at_training_resolution():
+    """SURVEY §2.3 stretch config at the real chairs training shape:
+    368x496 images -> 46x62 fmaps (Q=2852), 4-level pyramid, spatial=4.
+    The direct pyramid + windowed lookup must stay query-sharded over
+    'spatial' and match the dense oracle (BASELINE config 5)."""
+    from raft_tpu.ops.corr import build_corr_pyramid_direct
+
+    mesh = make_mesh(data=2, spatial=4)
+    B, H, W, C = 2, 46, 62, 64  # C reduced from 256 for CPU runtime
+    f1 = jnp.asarray(RNG.standard_normal((B, H, W, C)).astype(np.float32))
+    f2 = jnp.asarray(RNG.standard_normal((B, H, W, C)).astype(np.float32))
+    coords = coords_grid(B, H, W) + 0.37
+
+    ref = corr_lookup(build_corr_pyramid_direct(f1, f2, 4), coords, radius=4)
+
+    with jax.set_mesh(mesh):
+        f1s = jax.device_put(f1, NamedSharding(mesh, P(DATA_AXIS)))
+        f2s = jax.device_put(f2, NamedSharding(mesh, P(DATA_AXIS)))
+        cs = jax.device_put(coords, NamedSharding(mesh, P(DATA_AXIS)))
+
+        @jax.jit
+        def fn(a, b, c):
+            pyr = [constrain(p, P(DATA_AXIS, SPATIAL_AXIS, None, None))
+                   for p in build_corr_pyramid_direct(a, b, 4)]
+            return corr_lookup(pyr, c, radius=4, shard=True)
+
+        out = fn(f1s, f2s, cs)
+        shard = out.sharding.shard_shape(out.shape)
+        assert shard[0] == out.shape[0] // 2, (shard, out.shape)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)

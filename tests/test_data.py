@@ -95,6 +95,45 @@ def test_dense_augmentor_shapes_and_determinism():
     np.testing.assert_array_equal(af, bf)
 
 
+def test_color_jitter_matches_torchvision_pil_semantics():
+    """Bound the photometric deviation from the reference recipe
+    (core/utils/augmentor.py:32 uses torchvision ColorJitter, whose uint8
+    path delegates to PIL ImageEnhance / HSV).  Brightness, contrast and
+    saturation must agree with PIL to within 1 LSB per channel; hue uses
+    cv2's 180-step HSV circle instead of PIL's 255-step one, so it is
+    bounded in the mean (documented deviation, PARITY.md)."""
+    from PIL import Image, ImageEnhance
+
+    from raft_tpu.data.augmentor import (_apply_brightness, _apply_contrast,
+                                         _apply_hue, _apply_saturation)
+
+    rng = np.random.default_rng(11)
+    img = rng.integers(0, 256, (64, 96, 3), dtype=np.uint8)
+    pil = Image.fromarray(img)
+
+    for f in (0.6, 0.8, 1.0, 1.2, 1.4):
+        for ours, enh in ((_apply_brightness, ImageEnhance.Brightness),
+                          (_apply_contrast, ImageEnhance.Contrast),
+                          (_apply_saturation, ImageEnhance.Color)):
+            ref = np.asarray(enh(pil).enhance(f), dtype=np.int32)
+            got = ours(img, f).astype(np.int32)
+            assert np.abs(got - ref).max() <= 1, (ours.__name__, f)
+
+    def pil_hue(arr, shift):  # torchvision F_pil.adjust_hue semantics
+        im = Image.fromarray(arr).convert("HSV")
+        h, s, v = im.split()
+        h = (np.asarray(h, np.int32) + int(round(shift * 255))) % 256
+        return np.asarray(Image.merge(
+            "HSV", (Image.fromarray(h.astype(np.uint8)), s, v)).convert("RGB"))
+
+    for shift in (-0.15, -0.05, 0.05, 0.15):
+        ref = pil_hue(img, shift).astype(np.int32)
+        got = _apply_hue(img, shift).astype(np.int32)
+        d = np.abs(got - ref)
+        assert d.mean() <= 2.5, shift
+        assert np.percentile(d, 99) <= 16, shift
+
+
 def test_sparse_augmentor_preserves_valid_semantics():
     img1 = RNG.integers(0, 255, (120, 160, 3), dtype=np.uint8)
     img2 = RNG.integers(0, 255, (120, 160, 3), dtype=np.uint8)

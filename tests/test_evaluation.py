@@ -143,3 +143,29 @@ def test_validate_synthetic_dataset_free(tmp_path):
     out = validate_synthetic(ev, root=str(tmp_path), iters=2, n_samples=2,
                              image_size=small)
     assert "synthetic" in out and np.isfinite(out["synthetic"])
+
+
+def test_evaluator_cache_is_lru_bounded():
+    """Heterogeneous frame sizes must not grow the compiled-fn cache
+    without bound (arbitrary-folder demos)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.evaluation.evaluate import Evaluator
+    from raft_tpu.models import RAFT
+
+    model = RAFT(RAFTConfig(small=True))
+    img = np.random.default_rng(0).uniform(
+        0, 255, (1, 64, 64, 3)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(img),
+                           jnp.asarray(img), iters=1)
+    ev = Evaluator(model, variables, max_cached_shapes=2)
+    for w in (64, 72, 80):
+        im = np.random.default_rng(1).uniform(
+            0, 255, (1, 64, w, 3)).astype(np.float32)
+        ev(im, im, iters=1)
+    assert len(ev._cache) == 2
+    # most-recent shapes survive
+    assert any(k[0] == (1, 64, 80, 3) for k in ev._cache)
+    assert not any(k[0] == (1, 64, 64, 3) for k in ev._cache)

@@ -61,6 +61,7 @@ def test_warm_start_changes_result(small_model):
     assert np.abs(np.asarray(f0) - np.asarray(f1)).max() > 1e-3
 
 
+@pytest.mark.slow
 def test_large_model_params_and_shapes():
     cfg = RAFTConfig(small=False)
     model = RAFT(cfg)
@@ -104,6 +105,7 @@ def test_alternate_corr_matches_all_pairs(small_model):
                                rtol=1e-2, atol=1e-1)
 
 
+@pytest.mark.slow
 def test_bfloat16_policy_runs(small_model):
     """bf16 is the TPU compute policy; with an untrained net on noise inputs
     the recurrence is chaotic, so closeness to f32 is not a meaningful check
@@ -133,3 +135,79 @@ def test_jit_and_determinism(small_model):
     f1 = fwd(variables, img1, img2)
     f2 = fwd(variables, img1, img2)
     np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+
+
+def test_deferred_corr_grad_matches_plain(small_model):
+    """cfg.deferred_corr_grad restructures only WHERE the pyramid
+    cotangent is accumulated (one stacked contraction after the scan vs
+    per-iteration adds inside it); loss and every parameter gradient must
+    be identical to the plain path."""
+    from raft_tpu.training.loss import sequence_loss
+
+    model, variables = small_model
+    img1, img2 = make_inputs()
+    gt = jnp.asarray((RNG.standard_normal((1, 64, 96, 2)) * 3)
+                     .astype(np.float32))
+    valid = jnp.ones((1, 64, 96), np.float32)
+    init = jnp.ones((1, 8, 12, 2)) * 1.5  # warm start: entry coords differ
+
+    def make_loss(m):
+        def loss_fn(p):
+            preds = m.apply({"params": p}, img1, img2, iters=3,
+                            flow_init=init)
+            return sequence_loss(preds, gt, valid)[0]
+        return loss_fn
+
+    on = RAFT(RAFTConfig(small=True, deferred_corr_grad=True))
+    off = RAFT(RAFTConfig(small=True, deferred_corr_grad=False))
+    l_on, g_on = jax.value_and_grad(make_loss(on))(variables["params"])
+    l_off, g_off = jax.value_and_grad(make_loss(off))(variables["params"])
+
+    np.testing.assert_allclose(float(l_on), float(l_off), rtol=1e-6)
+    for (p1, a), (p2, b) in zip(jax.tree_util.tree_leaves_with_path(g_on),
+                                jax.tree_util.tree_leaves_with_path(g_off)):
+        assert p1 == p2
+        # atol floor 1e-4: norm-cancelled grads (conv bias feeding
+        # instance norm) are exactly 0 in exact math; both paths produce
+        # only accumulation noise there (same as test_torch_parity.py)
+        scale = np.abs(np.asarray(b)).max()
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5,
+            atol=max(1e-4, 1e-5 * scale), err_msg=jax.tree_util.keystr(p1))
+
+
+def test_deferred_corr_grad_matches_plain_with_remat():
+    """Same equivalence through the remat'd scan (the bench config's
+    backward path)."""
+    from raft_tpu.training.loss import sequence_loss
+
+    img1, img2 = make_inputs()
+    gt = jnp.asarray((RNG.standard_normal((1, 64, 96, 2)) * 3)
+                     .astype(np.float32))
+    valid = jnp.ones((1, 64, 96), np.float32)
+
+    base = RAFT(RAFTConfig(small=True))
+    variables = base.init(jax.random.PRNGKey(1), img1, img2, iters=1)
+
+    def make_loss(m):
+        def loss_fn(p):
+            preds = m.apply({"params": p}, img1, img2, iters=2)
+            return sequence_loss(preds, gt, valid)[0]
+        return loss_fn
+
+    on = RAFT(RAFTConfig(small=True, deferred_corr_grad=True, remat=True,
+                         remat_policy="dots_saveable"))
+    off = RAFT(RAFTConfig(small=True, deferred_corr_grad=False, remat=True,
+                          remat_policy="dots_saveable"))
+    l_on, g_on = jax.value_and_grad(make_loss(on))(variables["params"])
+    l_off, g_off = jax.value_and_grad(make_loss(off))(variables["params"])
+    np.testing.assert_allclose(float(l_on), float(l_off), rtol=1e-6)
+    for (p1, a), (p2, b) in zip(jax.tree_util.tree_leaves_with_path(g_on),
+                                jax.tree_util.tree_leaves_with_path(g_off)):
+        # atol floor 1e-4: norm-cancelled grads (conv bias feeding
+        # instance norm) are exactly 0 in exact math; both paths produce
+        # only accumulation noise there (same as test_torch_parity.py)
+        scale = np.abs(np.asarray(b)).max()
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5,
+            atol=max(1e-4, 1e-5 * scale), err_msg=jax.tree_util.keystr(p1))

@@ -14,6 +14,8 @@ from raft_tpu.parallel.step import replicate_state
 from raft_tpu.training import create_train_state, make_optimizer
 from raft_tpu.training.step import make_train_step
 
+pytestmark = pytest.mark.needs_mesh
+
 RNG = np.random.default_rng(17)
 
 
@@ -30,6 +32,7 @@ def test_eight_virtual_devices():
     assert jax.device_count() == 8
 
 
+@pytest.mark.slow
 def test_data_parallel_step_runs_and_shards():
     mesh = make_mesh(data=8)
     batch = _batch(B=8)
@@ -51,6 +54,7 @@ def test_data_parallel_step_runs_and_shards():
     assert leaf.sharding.is_fully_replicated
 
 
+@pytest.mark.slow
 def test_parallel_matches_single_device():
     """Data-parallel gradients (psum over the mesh) must reproduce the
     single-device step: same params after one update."""
@@ -76,6 +80,7 @@ def test_parallel_matches_single_device():
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_corr_shard_spatial():
     """corr_shard partitions the (B, Q, H2, W2) volume's query axis over the
     'spatial' mesh axis and still computes the right answer."""

@@ -9,6 +9,7 @@ pyramid.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu.ops.corr import (all_pairs_correlation, build_corr_pyramid,
@@ -18,6 +19,8 @@ from raft_tpu.parallel import make_mesh
 from raft_tpu.parallel.mesh import SPATIAL_AXIS
 from raft_tpu.parallel.ring import (ring_all_pairs_correlation,
                                     ring_corr_pyramid)
+
+pytestmark = pytest.mark.needs_mesh
 
 RNG = np.random.default_rng(7)
 
@@ -84,6 +87,7 @@ def test_ring_rejects_indivisible_queries():
         ring_all_pairs_correlation(f1, f2, mesh)
 
 
+@pytest.mark.slow
 def test_ring_in_model_matches_dense_forward():
     """cfg.corr_shard_impl='ring': the RAFT forward with the ring-built
     pyramid must match the dense (unsharded) forward under the ambient
@@ -120,6 +124,7 @@ def test_ring_in_model_matches_dense_forward():
                                atol=2e-3 * scale)
 
 
+@pytest.mark.slow
 def test_ring_in_model_train_step():
     """One sharded train step with the ring-built volume: finite loss,
     grads flow through the ppermute construction."""

@@ -7,6 +7,7 @@ import tempfile
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import torch
 
 from raft_tpu.config import RAFTConfig
@@ -101,6 +102,7 @@ def _tiny_batch(B=2, H=64, W=64, shift=1.0):
     }
 
 
+@pytest.mark.slow
 def test_train_step_overfits_synthetic_shift():
     """A few steps on one synthetic pair must reduce the loss — the
     end-to-end 'it trains' check (reference has no equivalent; SURVEY.md §4)."""
@@ -119,6 +121,7 @@ def test_train_step_overfits_synthetic_shift():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip_and_params_only():
     batch = _tiny_batch(B=1, H=64, W=64)
     model = RAFT(RAFTConfig(small=True))
@@ -151,6 +154,7 @@ def test_checkpoint_roundtrip_and_params_only():
         assert int(partial.step) == 0
 
 
+@pytest.mark.slow
 def test_bn_freeze_keeps_stats():
     """freeze_bn: batch_stats must not change during training steps
     (train.py:147-148,201-202)."""
@@ -273,4 +277,12 @@ def test_restore_migrates_legacy_mask_head_location():
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         for a, b in zip(jax.tree.leaves(restored.opt_state),
                         jax.tree.leaves(state.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # The eval/demo CLI loader must apply the same migration
+        # (cli/evaluate.py::load_variables, advisor round-1 finding).
+        from raft_tpu.cli.evaluate import load_variables
+        variables = load_variables(path, model, sample_shape=(1, 64, 64, 3))
+        for a, b in zip(jax.tree.leaves(variables["params"]),
+                        jax.tree.leaves(state.params)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
