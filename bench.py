@@ -151,31 +151,47 @@ def main():
     # corr_dtype=bfloat16 halves the volume traffic and runs the lookup
     # matmuls at full MXU rate (f32 accumulation; ~0.5% relative error).
     cfg = dataclasses.replace(preset.model, corr_dtype="bfloat16")
-    model = RAFT(cfg)
-    tx, _ = make_optimizer(lr=4e-4, num_steps=1000, wdecay=1e-4)
-    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
-                               iters=iters)
-    step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0,
-                           donate=True)
+    deferred = True
 
-    # Compile once via lower/compile: the same executable serves the timing
-    # loop AND exposes XLA's FLOPs estimate for the MFU line.
-    flops_per_step = 0.0
+    def build(cfg):
+        model = RAFT(cfg)
+        tx, _ = make_optimizer(lr=4e-4, num_steps=1000, wdecay=1e-4)
+        state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                                   iters=iters)
+        step = make_train_step(model, iters=iters, gamma=0.8,
+                               max_flow=400.0, donate=True)
+        # Compile once via lower/compile: the same executable serves the
+        # timing loop AND exposes XLA's FLOPs estimate for the MFU line.
+        flops = 0.0
+        try:
+            compiled = step.lower(state, batch).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = float((ca or {}).get("flops", 0.0))
+            step = compiled
+        except Exception:
+            pass  # plain jitted step; mfu reported as 0
+        # Warmup / compile.  Synchronization must be a host copy: over the
+        # axon tunnel, block_until_ready returns before execution
+        # finishes, which silently times dispatch instead of compute.
+        state, metrics = step(state, batch)
+        float(metrics["loss"])
+        return step, state, flops
+
     try:
-        compiled = step.lower(state, batch).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops_per_step = float((ca or {}).get("flops", 0.0))
-        step = compiled
-    except Exception:
-        pass  # fall back to the plain jitted step; mfu reported as 0
-
-    # Warmup / compile.  Synchronization must be a host copy: over the
-    # axon tunnel, block_until_ready returns before execution finishes,
-    # which silently times dispatch instead of compute.
-    state, metrics = step(state, batch)
-    float(metrics["loss"])
+        step, state, flops_per_step = build(cfg)
+    except Exception as e:
+        # Protect the scoreboard: if the deferred-grad path blows HBM on
+        # this chip (its stacked d_win buffer is the config's dominant
+        # backward transient), fall back to the plain accumulation path
+        # and say so rather than dying.
+        print(f"bench: default config failed ({type(e).__name__}: "
+              f"{str(e)[:200]}); retrying with deferred_corr_grad=False",
+              file=sys.stderr)
+        deferred = False
+        cfg = dataclasses.replace(cfg, deferred_corr_grad=False)
+        step, state, flops_per_step = build(cfg)
 
     n_steps = 10
     t0 = time.perf_counter()
@@ -213,6 +229,7 @@ def main():
         "vs_baseline": round(pairs_per_s / A100_BASELINE_PAIRS_PER_S, 3),
         "mfu": round(mfu, 4),
         "fed_pairs_per_s": round(fed_pairs_per_s, 3),
+        "deferred_corr_grad": deferred,
     }))
 
 

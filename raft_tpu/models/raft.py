@@ -95,7 +95,12 @@ class RefinementStep(nn.Module):
             # Deferred-grad path: the pyramid above is stop_gradient'd and
             # this zero scanned input carries the window cotangent out of
             # the scan instead (see RAFT.__call__ / cfg.deferred_corr_grad).
-            corr = corr + corr_bias
+            # The bias rides in the pyramid's dtype: under corr_dtype=bf16
+            # its stacked cotangent (iters x B x Q x L*K^2 — the path's
+            # dominant backward buffer, ~2 GB f32 at the chairs config)
+            # halves, with rounding inside the bf16 path's existing error
+            # budget.  AD of this cast yields the bf16 cotangent directly.
+            corr = corr + corr_bias.astype(corr.dtype)
 
         flow = coords1 - coords0
         corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1) ** 2
@@ -228,7 +233,7 @@ class RAFT(nn.Module):
 
         if use_deferred:
             corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1) ** 2
-            win_zeros = jnp.zeros((iters, B, H8, W8, corr_ch), jnp.float32)
+            win_zeros = jnp.zeros((iters, B, H8, W8, corr_ch), corr_dt)
             level_shapes = [p.shape[2:] for p in corr_state]
             level_dtypes = [p.dtype for p in corr_state]
 

@@ -262,33 +262,57 @@ def stacked_pyramid_cotangent(d_win: jax.Array, entry_coords: jax.Array,
     Q = H1 * W1
     N = B * Q
     k1 = 2 * radius + 1
-    cx = entry_coords[..., 0].reshape(it * N, 1).astype(jnp.float32)
-    cy = entry_coords[..., 1].reshape(it * N, 1).astype(jnp.float32)
+    # Bound the one-hot/intermediate transients: the stacked contraction
+    # over all iterations at once would materialize ry/rx/tmp `iters`x
+    # larger than their per-iteration sizes (~1.7 GB extra at the chairs
+    # config).  Chunking iterations keeps the single-write-per-level
+    # structure (ceil(iters/chunk) accumulate-adds instead of `iters`)
+    # with per-chunk transients.
+    chunk = min(4, it)
+    cx = entry_coords[..., 0].reshape(it, N, 1).astype(jnp.float32)
+    cy = entry_coords[..., 1].reshape(it, N, 1).astype(jnp.float32)
+
+    def _constrain(x):
+        if not shard:
+            return x
+        from jax.sharding import PartitionSpec as P
+        from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
+        return constrain(x, P(None, (DATA_AXIS, SPATIAL_AXIS), None, None))
+
     out = []
     ofs = 0
     for lvl, ((H2, W2), dt) in enumerate(zip(level_shapes, level_dtypes)):
-        # (i, n, kx, ky) — x-major window flattening, as in corr_lookup
-        D = d_win[..., ofs:ofs + k1 * k1].reshape(it, N, k1, k1) \
-            .astype(jnp.float32)
+        # (i, n, kx, ky) — x-major window flattening, as in corr_lookup.
+        # Contraction precision mirrors corr_lookup's forward convention:
+        # bf16 inputs at DEFAULT (full MXU rate), f32 at HIGHEST — the
+        # deferred path must not silently degrade f32 gradients.
+        cdt = jnp.bfloat16 if dt == jnp.bfloat16 else jnp.float32
+        prec = (jax.lax.Precision.DEFAULT if cdt == jnp.bfloat16
+                else jax.lax.Precision.HIGHEST)
+        D_lvl = d_win[..., ofs:ofs + k1 * k1].reshape(it, N, k1, k1) \
+            .astype(cdt)
         ofs += k1 * k1
-        ry = onehot_lerp_weights(cy / (2.0 ** lvl), radius, H2) \
-            .reshape(it, N, k1, H2)
-        rx = onehot_lerp_weights(cx / (2.0 ** lvl), radius, W2) \
-            .reshape(it, N, k1, W2)
-        if shard:
-            from jax.sharding import PartitionSpec as P
-            from raft_tpu.parallel.mesh import (DATA_AXIS, SPATIAL_AXIS,
-                                                constrain)
-            spec = P(None, (DATA_AXIS, SPATIAL_AXIS), None, None)
-            D = constrain(D, spec)
-            ry = constrain(ry, spec)
-            rx = constrain(rx, spec)
-        # contract kx first, then (i, ky) in one batched matmul
-        tmp = jnp.einsum("injk,injw->inkw", D, rx,
-                         preferred_element_type=jnp.float32)
-        d_img = jnp.einsum("inkh,inkw->nhw", ry, tmp,
-                           preferred_element_type=jnp.float32)
-        out.append(d_img.reshape(B, Q, H2, W2).astype(dt))
+        acc = None
+        for c0 in range(0, it, chunk):
+            nc = min(chunk, it - c0) * N
+            ry = onehot_lerp_weights(
+                cy[c0:c0 + chunk].reshape(nc, 1) / (2.0 ** lvl),
+                radius, H2).reshape(-1, N, k1, H2).astype(cdt)
+            rx = onehot_lerp_weights(
+                cx[c0:c0 + chunk].reshape(nc, 1) / (2.0 ** lvl),
+                radius, W2).reshape(-1, N, k1, W2).astype(cdt)
+            D = _constrain(D_lvl[c0:c0 + chunk])
+            ry = _constrain(ry)
+            rx = _constrain(rx)
+            # contract kx first, then (chunk, ky) in one batched matmul
+            tmp = jnp.einsum("injk,injw->inkw", D, rx,
+                             preferred_element_type=jnp.float32,
+                             precision=prec)
+            part = jnp.einsum("inkh,inkw->nhw", ry, tmp,
+                              preferred_element_type=jnp.float32,
+                              precision=prec)
+            acc = part if acc is None else acc + part
+        out.append(acc.reshape(B, Q, H2, W2).astype(dt))
     return tuple(out)
 
 

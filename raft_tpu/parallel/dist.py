@@ -19,12 +19,19 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     """Initialize jax.distributed when running multi-host.
 
     No-ops on single-host (the common dev path).  On TPU pods the runtime
-    autodetects everything; explicit args support CPU/GPU fleets.
+    autodetects everything; explicit args support CPU/GPU fleets (and the
+    2-process localhost test in tests/test_dist_multiprocess.py).
+
+    Must run before any other jax call in the process:
+    ``jax.distributed.initialize`` refuses to run once a backend exists,
+    which is also why this function must not query ``jax.process_count()``
+    to decide whether to no-op (doing so initializes the single-process
+    backend and permanently breaks the multi-host path).
     """
     import jax
 
-    if jax.process_count() > 1:
-        return  # already initialized
+    if jax.distributed.is_initialized():
+        return
     if coordinator_address is None and "COORDINATOR_ADDRESS" in os.environ:
         coordinator_address = os.environ["COORDINATOR_ADDRESS"]
     if coordinator_address is None and num_processes is None:

@@ -13,6 +13,8 @@ Stages:
             vs chunked vs pallas on-demand (time + HBM sanity)
   train   - 60 steps of --stage synthetic on-chip with a mid-run
             checkpoint resume
+  probe   - perf_probe current vs no_deferred_grad (measures the deferred
+            corr-pyramid cotangent's step-time win on real hardware)
 """
 
 import os
@@ -118,8 +120,17 @@ def run_train():
     return ok
 
 
+def run_probe():
+    r = subprocess.run(
+        [sys.executable, "scripts/perf_probe.py", "current",
+         "no_deferred_grad"], cwd=ROOT)
+    print(f"[probe] deferred-vs-plain corr grad: "
+          f"{'OK' if r.returncode == 0 else 'FAILED'}")
+    return r.returncode == 0
+
+
 STAGES = {"kernel": run_kernel_tests, "bench": run_bench,
-          "highres": run_highres, "train": run_train}
+          "highres": run_highres, "train": run_train, "probe": run_probe}
 
 
 def main():

@@ -46,6 +46,7 @@ def test_async_checkpointer_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_async_checkpointer_serializes_saves(tmp_path):
     from raft_tpu.training import AsyncCheckpointer
 

@@ -1,0 +1,85 @@
+"""Execute the multi-host path for real: two OS processes, a localhost
+coordinator, and a cross-process collective.
+
+This is the code path a TPU pod runs (jax.distributed + XLA collectives
+over DCN); here each process is one virtual CPU "host" with one device.
+Round-1 review flagged `parallel/dist.py`'s explicit-args branch as never
+executed — this test runs it end to end (and pins the regression where
+querying process_count() before initialize bricked multi-host init).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, os.environ["RAFT_REPO"])
+
+    from raft_tpu.parallel import initialize_distributed
+
+    # MUST come before any other jax use in the process
+    initialize_distributed(
+        coordinator_address=os.environ["COORD"],
+        num_processes=2,
+        process_id=int(os.environ["PID"]))
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.device_count()
+    assert jax.local_device_count() == 1
+
+    # cross-process psum over a 2-device global mesh
+    mesh = Mesh(jax.devices(), ("data",))
+    pid = jax.process_index()
+    local = jnp.asarray([float(10 + pid)])
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local, (2,))
+
+    @jax.jit
+    def total(x):
+        return jnp.sum(x)
+
+    out = float(total(arr))  # 10 + 11
+    assert out == 21.0, out
+    print(f"proc {pid}: psum_total={out} OK", flush=True)
+""")
+
+
+def test_two_process_collective(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env_base["RAFT_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env_base["COORD"] = f"127.0.0.1:{port}"
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, PID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    assert any("psum_total=21.0 OK" in o for o in outs), outs

@@ -137,6 +137,7 @@ def test_jit_and_determinism(small_model):
     np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
 
 
+@pytest.mark.slow
 def test_deferred_corr_grad_matches_plain(small_model):
     """cfg.deferred_corr_grad restructures only WHERE the pyramid
     cotangent is accumulated (one stacked contraction after the scan vs
@@ -211,3 +212,39 @@ def test_deferred_corr_grad_matches_plain_with_remat():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5,
             atol=max(1e-4, 1e-5 * scale), err_msg=jax.tree_util.keystr(p1))
+
+
+@pytest.mark.slow
+def test_deferred_corr_grad_bf16_pyramid_close():
+    """Under corr_dtype=bfloat16 the deferred window cotangent rides in
+    bf16 (halves the path's dominant backward buffer); gradients must stay
+    within the bf16 path's error budget of the plain bf16 path."""
+    from raft_tpu.training.loss import sequence_loss
+
+    img1, img2 = make_inputs()
+    gt = jnp.asarray((RNG.standard_normal((1, 64, 96, 2)) * 3)
+                     .astype(np.float32))
+    valid = jnp.ones((1, 64, 96), np.float32)
+    base = RAFT(RAFTConfig(small=True))
+    variables = base.init(jax.random.PRNGKey(2), img1, img2, iters=1)
+
+    def make_loss(m):
+        def loss_fn(p):
+            preds = m.apply({"params": p}, img1, img2, iters=2)
+            return sequence_loss(preds, gt, valid)[0]
+        return loss_fn
+
+    on = RAFT(RAFTConfig(small=True, corr_dtype="bfloat16",
+                         deferred_corr_grad=True))
+    off = RAFT(RAFTConfig(small=True, corr_dtype="bfloat16",
+                          deferred_corr_grad=False))
+    l_on, g_on = jax.value_and_grad(make_loss(on))(variables["params"])
+    l_off, g_off = jax.value_and_grad(make_loss(off))(variables["params"])
+    np.testing.assert_allclose(float(l_on), float(l_off), rtol=1e-5)
+    for (p1, a), (p2, b) in zip(jax.tree_util.tree_leaves_with_path(g_on),
+                                jax.tree_util.tree_leaves_with_path(g_off)):
+        # 1e-3 floor: norm-cancelled grads are exact zeros + noise
+        scale = np.abs(np.asarray(b)).max()
+        d = np.abs(np.asarray(a) - np.asarray(b)).max()
+        assert d <= max(2e-2 * scale, 1e-3), (jax.tree_util.keystr(p1), d,
+                                              scale)

@@ -222,6 +222,7 @@ def test_sequence_loss_packed_equals_image_layout():
                                    rtol=1e-5, err_msg=k)
 
 
+@pytest.mark.slow
 def test_model_pack_output_matches_image_layout():
     """pack_output=True must be a pure re-layout of the train-mode output."""
     from raft_tpu.ops.grid import pack_fine
