@@ -24,6 +24,7 @@ speedup).  vs_baseline = measured / 7.0, so 2.0 meets the north-star
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -54,16 +55,19 @@ def _fail(reason: str) -> None:
     sys.exit(1)
 
 
-def preflight(attempts: int = 2, timeout_s: int = 150) -> None:
+def preflight(attempts: int = 2, timeout_s: int = 150) -> str:
     """Probe backend init in a subprocess so a hung tunnel cannot wedge the
     bench itself (round-1 failure mode: BENCH_r01 died 40 frames deep in
     device_put when the axon backend was down).  Also rejects a silent CPU
     fallback — a CPU run of the chairs config takes minutes per step and
     would poison the scoreboard; set RAFT_BENCH_ALLOW_CPU=1 to bench on
-    CPU deliberately."""
-    import os
-
-    code = ("import jax; d = jax.devices()[0]; "
+    CPU deliberately.  Returns the probed platform name."""
+    # ensure_platform: an explicit JAX_PLATFORMS=cpu must actually take
+    # effect in the probe (the env var alone does not beat the image's
+    # pinned axon plugin — utils/platform.py)
+    code = ("from raft_tpu.utils.platform import ensure_platform; "
+            "ensure_platform(honor_device_count_flag=False); "
+            "import jax; d = jax.devices()[0]; "
             "print(d.platform, '|', d.device_kind)")
     last = ""
     for i in range(attempts):
@@ -84,7 +88,7 @@ def preflight(attempts: int = 2, timeout_s: int = 150) -> None:
                 _fail("backend fell back to CPU (expected the tunneled "
                       "TPU; set RAFT_BENCH_ALLOW_CPU=1 to bench on CPU "
                       "anyway)")
-            return
+            return platform
         tail = (proc.stderr or "").strip().splitlines()
         last = tail[-1][:300] if tail else f"rc={proc.returncode}"
     _fail(f"backend unavailable ({last})")
@@ -114,7 +118,11 @@ def _make_fed_loader(B, H, W, seed: int = 1):
 
 
 def main():
-    preflight()
+    platform = preflight()
+
+    from raft_tpu.utils.platform import ensure_platform
+
+    ensure_platform(honor_device_count_flag=False)
 
     import jax
     import jax.numpy as jnp
@@ -135,6 +143,20 @@ def main():
     B = preset.data.batch_size
     H, W = preset.data.image_size
     iters = preset.train.iters
+
+    # RAFT_BENCH_TINY=1: shrink everything so the full bench path (incl.
+    # MFU line and fed lane) smoke-runs on CPU in tests — combine with
+    # RAFT_BENCH_ALLOW_CPU=1.  Numbers produced this way are meaningless,
+    # so tiny mode is CPU-only (a stale env var must not let a shrunk run
+    # masquerade as the real chairs-config scoreboard number) and the
+    # output line carries "tiny": true.
+    tiny = os.environ.get("RAFT_BENCH_TINY", "") not in ("", "0")
+    if tiny and platform != "cpu":
+        _fail("RAFT_BENCH_TINY is set but the backend is "
+              f"'{platform}' — tiny mode is for CPU smoke tests only; "
+              "unset it for a real benchmark run")
+    if tiny:
+        B, H, W, iters = 1, 64, 64, 2
 
     rng = np.random.default_rng(0)
     batch = {
@@ -193,7 +215,7 @@ def main():
         cfg = dataclasses.replace(cfg, deferred_corr_grad=False)
         step, state, flops_per_step = build(cfg)
 
-    n_steps = 10
+    n_steps = 2 if tiny else 10
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step(state, batch)
@@ -213,7 +235,7 @@ def main():
         fed0 = next(it)  # warm the pipeline (+ any reshape recompile)
         state, metrics = step(state, fed0)
         float(metrics["loss"])
-        n_fed = 10
+        n_fed = 2 if tiny else 10
         t0 = time.perf_counter()
         for _ in range(n_fed):
             state, metrics = step(state, next(it))
@@ -230,6 +252,7 @@ def main():
         "mfu": round(mfu, 4),
         "fed_pairs_per_s": round(fed_pairs_per_s, 3),
         "deferred_corr_grad": deferred,
+        **({"tiny": True} if tiny else {}),
     }))
 
 

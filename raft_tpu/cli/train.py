@@ -71,6 +71,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=["float32", "bfloat16"],
                    help="corr pyramid storage/contraction dtype; bfloat16 "
                         "is ~25%% faster end-to-end (f32 accumulation)")
+    p.add_argument("--no_deferred_corr_grad", action="store_true",
+                   help="disable the deferred corr-pyramid cotangent "
+                        "(one post-scan contraction per level; default on "
+                        "for the dense path — disable to trade backward "
+                        "HBM peak for per-iteration accumulate-adds)")
     p.add_argument("--datasets_root", default="datasets")
     p.add_argument("--checkpoint_dir", default="checkpoints")
     p.add_argument("--log_dir", default="runs")
@@ -99,6 +104,7 @@ def build_config(args):
         corr_impl=args.corr_impl,
         corr_shard=args.spatial_parallel > 1,
         corr_shard_impl=args.corr_shard_impl,
+        deferred_corr_grad=not args.no_deferred_corr_grad,
         **({"corr_dtype": args.corr_dtype} if args.corr_dtype else {}),
     )
     data = dataclasses.replace(

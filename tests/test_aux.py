@@ -95,3 +95,27 @@ def test_device_memory_stats_shape():
 
     stats = device_memory_stats()  # may be empty on CPU — just no crash
     assert isinstance(stats, dict)
+
+
+@pytest.mark.slow
+def test_bench_tiny_smoke(tmp_path):
+    """The full bench path (preflight, MFU line, fed lane, JSON contract)
+    smoke-run on CPU via RAFT_BENCH_TINY — catches bench-side drift
+    without hardware."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, RAFT_BENCH_TINY="1", RAFT_BENCH_ALLOW_CPU="1",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "bench.py"], cwd=root, env=env,
+                       capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = r.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "image-pairs/sec/chip"
+    assert out["value"] > 0
+    assert "mfu" in out and "fed_pairs_per_s" in out
+    assert out["deferred_corr_grad"] is True
+    assert out["tiny"] is True  # tiny runs must be self-identifying

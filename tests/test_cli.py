@@ -58,6 +58,7 @@ def test_demo_flow_viz(small_ckpt, frame_dir, tmp_path):
     assert files == ["flow_0000.png", "flow_0001.png"]
 
 
+@pytest.mark.slow
 def test_demo_warp_pair(small_ckpt, frame_dir, tmp_path):
     from raft_tpu.cli import demo_warp
 

@@ -356,3 +356,25 @@ def test_synthetic_shift_dataset_exact_correspondence():
 
     via_fetch = fetch_dataset("synthetic", (48, 64), root="nonexistent-dir")
     assert len(via_fetch) > 0 and via_fetch[0]["image1"].shape == (48, 64, 3)
+
+
+def test_synthetic_shift_with_augmentor_deterministic():
+    """SyntheticShift(aug_params=...) — the fed-bench/pipeline mode — must
+    crop to size, stay deterministic per (seed, epoch, index), and change
+    across epochs."""
+    from raft_tpu.data.datasets import SyntheticShift
+
+    aug = dict(crop_size=(64, 96), min_scale=0.0, max_scale=0.2,
+               do_flip=True)
+    ds = SyntheticShift(image_size=(96, 128), length=8, seed=5,
+                        aug_params=aug)
+    a = ds[0]
+    assert a["image1"].shape == (64, 96, 3)
+    assert a["flow"].shape == (64, 96, 2)
+    assert a["image1"].dtype == np.float32
+    b = ds[0]
+    np.testing.assert_array_equal(a["image1"], b["image1"])
+    np.testing.assert_array_equal(a["flow"], b["flow"])
+    ds.set_epoch(1)
+    c = ds[0]
+    assert not np.array_equal(a["image1"], c["image1"])

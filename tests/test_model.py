@@ -177,6 +177,7 @@ def test_deferred_corr_grad_matches_plain(small_model):
             atol=max(1e-4, 1e-5 * scale), err_msg=jax.tree_util.keystr(p1))
 
 
+@pytest.mark.slow
 def test_deferred_corr_grad_matches_plain_with_remat():
     """Same equivalence through the remat'd scan (the bench config's
     backward path)."""
