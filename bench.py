@@ -43,14 +43,17 @@ _PEAK_BF16 = [
 ]
 
 
-def _fail(reason: str) -> None:
+def _fail(reason: str, backend_down: bool = True) -> None:
     """The driver records this script's stdout as the round's scoreboard;
-    protect it — one parseable line with a diagnosis, not a traceback."""
+    protect it — one parseable line with a diagnosis, not a traceback.
+    ``backend_down=False`` drops the tunnel-recovery suffix (config-misuse
+    errors aren't fixed by recovering hardware)."""
+    suffix = (" — recover the TPU tunnel, then run "
+              "scripts/tpu_validation.py" if backend_down else "")
     print(json.dumps({
         "metric": "image-pairs/sec/chip", "value": 0.0, "unit": "pairs/s",
         "vs_baseline": 0.0,
-        "error": f"{reason} — recover the TPU tunnel, then run "
-                 "scripts/tpu_validation.py",
+        "error": reason + suffix,
     }))
     sys.exit(1)
 
@@ -74,9 +77,13 @@ def preflight(attempts: int = 2, timeout_s: int = 150) -> str:
         if i:
             time.sleep(20)
         try:
+            # cwd pinned to the repo root: the probe imports raft_tpu,
+            # which is not pip-installed
             proc = subprocess.run([sys.executable, "-c", code],
                                   capture_output=True, text=True,
-                                  timeout=timeout_s)
+                                  timeout=timeout_s,
+                                  cwd=os.path.dirname(
+                                      os.path.abspath(__file__)))
         except subprocess.TimeoutExpired:
             last = f"backend init timed out after {timeout_s}s"
             continue
@@ -154,7 +161,7 @@ def main():
     if tiny and platform != "cpu":
         _fail("RAFT_BENCH_TINY is set but the backend is "
               f"'{platform}' — tiny mode is for CPU smoke tests only; "
-              "unset it for a real benchmark run")
+              "unset it for a real benchmark run", backend_down=False)
     if tiny:
         B, H, W, iters = 1, 64, 64, 2
 

@@ -87,6 +87,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--no_tensorboard", action="store_true")
     p.add_argument("--max_steps_override", type=int, default=None,
                    help="debug: stop early regardless of schedule")
+    p.add_argument("--profile_dir", default=None,
+                   help="capture a jax.profiler trace of a few steady-"
+                        "state steps into this directory (inspect with "
+                        "scripts/trace_top.py or TensorBoard)")
+    p.add_argument("--profile_start", type=int, default=10,
+                   help="first step (relative to this run) to trace")
+    p.add_argument("--profile_steps", type=int, default=3,
+                   help="number of steps to trace")
     return p.parse_args(argv)
 
 
@@ -263,17 +271,37 @@ def train(args) -> str:
         ),
         sharding=sharding,
     )
+    # Optional profiling window: trace a few steady-state steps (past
+    # compile + warmup) so the capture shows real step composition.
+    from raft_tpu.training.profiler import sync as device_sync
+
+    profile_at = ((start_step + args.profile_start)
+                  if args.profile_dir else None)
+    tracing = False
     for batch in stream:
+        if profile_at is not None and total_steps == profile_at:
+            device_sync(state.params)  # don't trace earlier stragglers
+            jax.profiler.start_trace(args.profile_dir)
+            tracing = True
         state, metrics = step(state, batch)
         # Device scalars go in as-is; Logger converts at the sum_freq
         # window boundary, so there is no per-step host sync to stall
         # the dispatch pipeline.
         logger.push(metrics)
         total_steps += 1
+        if tracing and total_steps >= profile_at + args.profile_steps:
+            device_sync(metrics)  # capture through the traced steps' end
+            jax.profiler.stop_trace()
+            tracing = False
+            profile_at = None
+            print(f"profile trace written to {args.profile_dir}")
 
         if preempted():
             # SIGTERM/SIGINT: synchronous final save, then bail; --resume
             # picks up from here (the recovery path the reference lacks).
+            if tracing:
+                jax.profiler.stop_trace()
+                tracing = False
             path = os.path.join(train_cfg.checkpoint_dir,
                                 f"{total_steps}_{train_cfg.name}.msgpack")
             try:
@@ -307,6 +335,13 @@ def train(args) -> str:
 
         if total_steps >= num_steps:
             break
+
+    if tracing:  # run ended inside the profiling window
+        jax.profiler.stop_trace()
+    elif profile_at is not None:
+        print(f"warning: profiling window (step {profile_at}) was never "
+              f"reached — run ended at step {total_steps}; lower "
+              f"--profile_start or raise the step budget")
 
     final = os.path.join(train_cfg.checkpoint_dir,
                          f"{train_cfg.name}.msgpack")

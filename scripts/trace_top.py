@@ -1,0 +1,102 @@
+"""Summarize a jax.profiler trace: top ops by device time.
+
+The installed tensorboard_plugin_profile's converter is incompatible with
+this image's TF/protobuf, so this parses the Trace-Event JSON that
+``jax.profiler`` writes directly (the same data TensorBoard's trace viewer
+renders).  This is the tool behind docs/ARCHITECTURE.md's "What profiling
+changed" table.
+
+Usage:
+    python scripts/trace_top.py runs/profile            # newest trace under dir
+    python scripts/trace_top.py path/to/*.trace.json.gz [-n 30] [--group]
+
+--group merges ops by base name (fusion.123 -> fusion) to show where whole
+op classes spend time; default lists individual ops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+
+def find_trace(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    cands = glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not cands:
+        sys.exit(f"no *.trace.json.gz under {path}")
+    return max(cands, key=os.path.getmtime)
+
+
+def load_events(path: str):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        data = json.load(f)
+    return data.get("traceEvents", [])
+
+
+def device_pids(events) -> set:
+    """Process ids whose name looks like an accelerator (not python host)."""
+    pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name = e.get("args", {}).get("name", "").lower()
+            if any(k in name for k in ("tpu", "gpu", "device", "xla")) \
+                    and "python" not in name:
+                pids.add(e.get("pid"))
+    return pids
+
+
+def main():
+    ap = argparse.ArgumentParser("trace_top")
+    ap.add_argument("path", help="trace file or profile log dir")
+    ap.add_argument("-n", type=int, default=25)
+    ap.add_argument("--group", action="store_true",
+                    help="merge ops by base name (strip trailing .N digits)")
+    args = ap.parse_args()
+
+    path = find_trace(args.path)
+    events = load_events(path)
+    pids = device_pids(events)
+    if not pids:
+        print("# WARNING: no accelerator process metadata in this trace — "
+              "summing ALL streams (host dispatch/python included); on a "
+              "CPU trace this mixes dispatch with compute", file=sys.stderr)
+
+    durs = collections.Counter()
+    counts = collections.Counter()
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if pids and e.get("pid") not in pids:
+            continue
+        name = e.get("name", "?")
+        if args.group:
+            name = re.sub(r"[.\d]+$", "", name)
+        us = float(e["dur"])
+        durs[name] += us
+        counts[name] += 1
+        total += us
+
+    kind = "device-side" if pids else "all-stream"
+    print(f"# {path}")
+    print(f"# {kind} events: {sum(counts.values())}, "
+          f"total {total / 1e3:.2f} ms (sum over streams)")
+    print(f"{'op':<56} {'ms':>10} {'%':>6} {'calls':>7}")
+    for name, us in durs.most_common(args.n):
+        pct = 100.0 * us / total if total else 0.0
+        print(f"{name[:56]:<56} {us / 1e3:>10.3f} {pct:>5.1f}% "
+              f"{counts[name]:>7}")
+
+
+if __name__ == "__main__":
+    main()
