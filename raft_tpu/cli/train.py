@@ -337,6 +337,7 @@ def train(args) -> str:
             break
 
     if tracing:  # run ended inside the profiling window
+        device_sync(state.params)  # flush in-flight traced steps first
         jax.profiler.stop_trace()
     elif profile_at is not None:
         print(f"warning: profiling window (step {profile_at}) was never "

@@ -55,6 +55,25 @@ def device_pids(events) -> set:
     return pids
 
 
+def op_lane_tids(events, pids) -> set:
+    """(pid, tid) pairs of op-level lanes.
+
+    Device traces put a whole-module event ("jit_train_step") on an
+    'XLA Modules' lane AND its constituent ops on an 'XLA Ops' lane of
+    the same pid — summing both double-counts every op.  When op lanes
+    exist, restrict to them; otherwise use all lanes of the device pids.
+    """
+    tids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            if pids and e.get("pid") not in pids:
+                continue
+            name = e.get("args", {}).get("name", "").lower()
+            if "xla ops" in name:
+                tids.add((e.get("pid"), e.get("tid")))
+    return tids
+
+
 def main():
     ap = argparse.ArgumentParser("trace_top")
     ap.add_argument("path", help="trace file or profile log dir")
@@ -66,6 +85,7 @@ def main():
     path = find_trace(args.path)
     events = load_events(path)
     pids = device_pids(events)
+    lanes = op_lane_tids(events, pids)
     if not pids:
         print("# WARNING: no accelerator process metadata in this trace — "
               "summing ALL streams (host dispatch/python included); on a "
@@ -78,6 +98,8 @@ def main():
         if e.get("ph") != "X" or "dur" not in e:
             continue
         if pids and e.get("pid") not in pids:
+            continue
+        if lanes and (e.get("pid"), e.get("tid")) not in lanes:
             continue
         name = e.get("name", "?")
         if args.group:

@@ -168,3 +168,30 @@ def test_evaluate_load_variables_roundtrip(small_ckpt):
     assert "params" in variables
     n = sum(x.size for x in jax.tree.leaves(variables["params"]))
     assert n > 900_000  # RAFT-small ~1M params
+
+
+@pytest.mark.slow
+def test_train_cli_end_to_end_with_resume(tmp_path):
+    """The full training CLI on the dataset-free synthetic stage: run 3
+    steps, save, auto-resume to 5 — the step counter and schedule must
+    continue, and the final checkpoint must exist.  This is the CPU twin
+    of scripts/tpu_validation.py's 'train' stage."""
+    from raft_tpu.cli import train as train_cli
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    common = ["--stage", "synthetic", "--iters", "2", "--batch_size", "1",
+              "--image_size", "64", "64", "--small",
+              "--checkpoint_dir", ckpt_dir,
+              "--log_dir", str(tmp_path / "runs"), "--no_tensorboard",
+              "--val_freq", "1000000"]
+    train_cli.main(common + ["--num_steps", "3"])
+    final = os.path.join(ckpt_dir, "raft-synthetic.msgpack")
+    assert os.path.exists(final)
+
+    import flax.serialization
+    payload = flax.serialization.msgpack_restore(open(final, "rb").read())
+    assert int(np.asarray(payload["step"])) == 3
+
+    train_cli.main(common + ["--num_steps", "5", "--resume"])
+    payload = flax.serialization.msgpack_restore(open(final, "rb").read())
+    assert int(np.asarray(payload["step"])) == 5
