@@ -300,6 +300,7 @@ def train(args) -> str:
             # SIGTERM/SIGINT: synchronous final save, then bail; --resume
             # picks up from here (the recovery path the reference lacks).
             if tracing:
+                device_sync(metrics)  # flush in-flight traced steps
                 jax.profiler.stop_trace()
                 tracing = False
             path = os.path.join(train_cfg.checkpoint_dir,

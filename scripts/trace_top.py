@@ -63,10 +63,14 @@ def op_lane_tids(events, pids) -> set:
     the same pid — summing both double-counts every op.  When op lanes
     exist, restrict to them; otherwise use all lanes of the device pids.
     """
+    if not pids:
+        # no device metadata: the caller already warned that ALL streams
+        # are summed — restricting to op lanes here would contradict that
+        return set()
     tids = set()
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "thread_name":
-            if pids and e.get("pid") not in pids:
+            if e.get("pid") not in pids:
                 continue
             name = e.get("args", {}).get("name", "").lower()
             if "xla ops" in name:

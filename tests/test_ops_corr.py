@@ -5,6 +5,7 @@ equivalence."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import torch
 import torch.nn.functional as F
 
@@ -196,3 +197,29 @@ def test_chunked_equals_oracle_forward_and_grad():
     for a, b in zip(jax.tree.leaves(g_chk), jax.tree.leaves(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("shape", [(11, 13), (9, 16), (12, 10)])
+def test_corr_lookup_matches_reference_corrblock_odd_shapes(shape):
+    """Odd target extents exercise the floor-halving pyramid crop and the
+    window clipping differently from the power-of-two case; the torch
+    CorrBlock oracle is the judge (direct-matmul pyramid under test —
+    the production path)."""
+    from raft_tpu.ops.corr import build_corr_pyramid_direct
+
+    H, W = shape
+    B, C, levels, radius = 1, 16, 3, 3
+    f1 = RNG.standard_normal((B, H, W, C)).astype(np.float32)
+    f2 = RNG.standard_normal((B, H, W, C)).astype(np.float32)
+    coords = (RNG.uniform(-2, [W + 1, H + 1], size=(B, H, W, 2))
+              .astype(np.float32))
+
+    pyr = build_corr_pyramid_direct(jnp.asarray(f1), jnp.asarray(f2), levels)
+    ours = np.asarray(corr_lookup(pyr, jnp.asarray(coords), radius))
+
+    ref = ref_corrblock(
+        torch.from_numpy(f1).permute(0, 3, 1, 2),
+        torch.from_numpy(f2).permute(0, 3, 1, 2),
+        torch.from_numpy(coords), levels, radius,
+    ).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)

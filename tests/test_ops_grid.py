@@ -170,3 +170,44 @@ def test_forward_interpolate_identity_on_zero_flow_interior():
     assert out.shape == (5, 6, 2)
     # splatted values are 1.5 everywhere nearest-filled
     assert np.allclose(out[..., 0], 1.5)
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_bilinear_sample_fuzz_vs_grid_sample(trial):
+    """Heavier fuzz over the #1-ranked hard part (SURVEY.md §7): random
+    shapes (odd/even extents), coords saturating every edge case class —
+    deep OOB, boundary-straddling subpixels (W-1 +/- eps), exact integers,
+    exact half-pixels, negative zero — must agree with the reference's
+    bilinear_sampler semantics exactly.  (Extent-2 minimum: at extent 1
+    the REFERENCE itself divides by zero — see test_torch_parity.py.)"""
+    rng = np.random.default_rng(100 + trial)
+    B = int(rng.integers(1, 3))
+    H = int(rng.integers(2, 13))
+    W = int(rng.integers(2, 13))
+    C = int(rng.integers(1, 5))
+    img = rng.standard_normal((B, H, W, C)).astype(np.float32)
+
+    n = 100  # >= len(specials)^2 so the cartesian pairing fits
+    cx = rng.uniform(-2 * W, 3 * W, size=(B, 4, n)).astype(np.float32)
+    cy = rng.uniform(-2 * H, 3 * H, size=(B, 4, n)).astype(np.float32)
+    eps = np.float32(1e-4)
+    specials_x = np.array([0.0, -0.0, W - 1, W - 1 - eps, W - 1 + eps,
+                           0.5, W - 0.5, -eps, W // 2, -1.0],
+                          np.float32)
+    specials_y = np.array([0.0, -0.0, H - 1, H - 1 - eps, H - 1 + eps,
+                           0.5, H - 0.5, -eps, H // 2, -1.0],
+                          np.float32)
+    cx[:, 0, :10] = specials_x
+    cy[:, 0, :10] = specials_y
+    # full cartesian pairing of the special values on row 1
+    gx, gy = np.meshgrid(specials_x, specials_y)
+    cx[:, 1, :min(n, gx.size)] = gx.ravel()[:n]
+    cy[:, 1, :min(n, gy.size)] = gy.ravel()[:n]
+    coords = np.stack([cx, cy], axis=-1)
+
+    ours = np.asarray(bilinear_sample(jnp.asarray(img), jnp.asarray(coords)))
+    ref = torch_bilinear_sampler(
+        torch.from_numpy(img).permute(0, 3, 1, 2),
+        torch.from_numpy(coords)).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5,
+                               err_msg=f"B={B} H={H} W={W} C={C}")
