@@ -249,3 +249,35 @@ def test_deferred_corr_grad_bf16_pyramid_close():
         d = np.abs(np.asarray(a) - np.asarray(b)).max()
         assert d <= max(2e-2 * scale, 1e-3), (jax.tree_util.keystr(p1), d,
                                               scale)
+
+
+def test_backward_smoke_default_path(small_model):
+    """Fast-lane backward tripwire: one grad evaluation through the
+    default config (deferred corr cotangent + scan + out-of-scan mask
+    path) must produce finite, nonzero gradients for every parameter.
+    The full equivalence/parity suite runs in the slow lane
+    (test_deferred_corr_grad_matches_plain, test_torch_parity.py)."""
+    from raft_tpu.training.loss import sequence_loss
+
+    model, variables = small_model
+    img1, img2 = make_inputs()
+    gt = jnp.asarray((RNG.standard_normal((1, 64, 96, 2)) * 3)
+                     .astype(np.float32))
+    valid = jnp.ones((1, 64, 96), np.float32)
+
+    def loss_fn(p):
+        preds = model.apply({"params": p}, img1, img2, iters=2)
+        return sequence_loss(preds, gt, valid)[0]
+
+    loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+    assert np.isfinite(float(loss))
+    zero_leaves = []
+    for path, g in jax.tree_util.tree_leaves_with_path(grads):
+        arr = np.asarray(g)
+        assert np.isfinite(arr).all(), jax.tree_util.keystr(path)
+        if not np.any(arr):
+            zero_leaves.append(jax.tree_util.keystr(path))
+    # every parameter participates in the backward (a severed custom_vjp
+    # would zero out whole subtrees); allow a couple of degenerate leaves
+    # (norm-cancelled biases can be exactly 0 in exact arithmetic)
+    assert len(zero_leaves) <= 2, zero_leaves

@@ -244,8 +244,8 @@ def test_trained_checkpoint_eval_iters_parity(tmp_path):
     assert err_low.mean() <= 1e-2, err_low.mean()
 
 
-@pytest.mark.parametrize(
-    "small", [True, pytest.param(False, marks=pytest.mark.slow)])
+@pytest.mark.slow
+@pytest.mark.parametrize("small", [True, False])
 def test_gradient_parity_with_reference(small):
     """Backward parity: identical weights, the reference's training loss
     (train.py:174-177 — sequence_loss through all unrolled iterations,
