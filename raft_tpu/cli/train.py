@@ -71,6 +71,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=["float32", "bfloat16"],
                    help="corr pyramid storage/contraction dtype; bfloat16 "
                         "is ~25%% faster end-to-end (f32 accumulation)")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help="gradient accumulation micro-steps: batch_size "
+                        "must divide evenly; activation memory scales "
+                        "with batch_size/grad_accum (high-res stages on "
+                        "one chip)")
     p.add_argument("--no_deferred_corr_grad", action="store_true",
                    help="disable the deferred corr-pyramid cotangent "
                         "(one post-scan contraction per level; default on "
@@ -240,7 +245,8 @@ def train(args) -> str:
         step = make_parallel_train_step(
             model, mesh, iters=train_cfg.iters, gamma=train_cfg.gamma,
             max_flow=train_cfg.max_flow, freeze_bn=train_cfg.freeze_bn,
-            add_noise=train_cfg.add_noise, donate=True)
+            add_noise=train_cfg.add_noise, donate=True,
+            accum_steps=args.grad_accum)
         from jax.sharding import NamedSharding
         from raft_tpu.parallel.mesh import batch_spec
         sharding = NamedSharding(mesh, batch_spec())
@@ -248,7 +254,8 @@ def train(args) -> str:
         step = make_train_step(
             model, iters=train_cfg.iters, gamma=train_cfg.gamma,
             max_flow=train_cfg.max_flow, freeze_bn=train_cfg.freeze_bn,
-            add_noise=train_cfg.add_noise, donate=True)
+            add_noise=train_cfg.add_noise, donate=True,
+            accum_steps=args.grad_accum)
 
     logger = Logger(log_dir=os.path.join(args.log_dir, train_cfg.name),
                     scheduler_lr=lambda s: float(schedule(s)),

@@ -30,7 +30,8 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
 
 def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
                              max_flow: float, freeze_bn: bool = False,
-                             add_noise: bool = False, donate: bool = False):
+                             add_noise: bool = False, donate: bool = False,
+                             accum_steps: int = 1):
     """Build the mesh-aware train step.
 
     Usage:
@@ -40,11 +41,16 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
             state, metrics = step(state, shard_batch(batch, mesh))
 
     donate=True forwards state-buffer donation to the jitted step (see
-    make_train_step); only for linear-flow callers.
+    make_train_step); only for linear-flow callers.  accum_steps composes
+    with data parallelism: micro batches take interleaved batch elements
+    (training/step.py resh), so the contiguously-sharded batch axis stays
+    shard-local — each device accumulates its own rows sequentially, no
+    per-step resharding — when (batch / accum_steps) is a multiple of the
+    'data' axis size.
     """
     base = make_train_step(model, iters=iters, gamma=gamma, max_flow=max_flow,
                            freeze_bn=freeze_bn, add_noise=add_noise,
-                           donate=donate)
+                           donate=donate, accum_steps=accum_steps)
 
     def step(state: TrainState, batch: Dict):
         with jax.set_mesh(mesh):

@@ -20,7 +20,7 @@ from raft_tpu.training.state import TrainState
 
 def make_train_step(model, iters: int, gamma: float, max_flow: float,
                     freeze_bn: bool = False, add_noise: bool = False,
-                    donate: bool = False):
+                    donate: bool = False, accum_steps: int = 1):
     """Build a jit-compiled train step for ``model``.
 
     The optional noise augmentation matches train.py:167-170: N(0, sigma)
@@ -32,7 +32,25 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
     linearly (``state, _ = step(state, ...)`` and never touch the old
     object again) — the training loop and bench do; tests that diff
     pre/post states must not donate.
+
+    accum_steps>1: gradient accumulation.  The batch (leading axis must
+    divide evenly) is processed as ``accum_steps`` sequential micro
+    batches under a ``lax.scan``; gradients are averaged and ONE
+    optimizer update applied.  Activation memory scales with the micro
+    batch — the lever for running the reference's high-res stage batch
+    sizes (400x720 things/sintel, train_standard.sh:4-5) inside one
+    chip's HBM.  Micro batches take INTERLEAVED elements (i, accum+i,
+    ...) so a data-sharded batch axis stays shard-local through the
+    regrouping reshape (see parallel/step.py).  Because sequence_loss is
+    a mean over batch elements, the averaged micro gradients equal the
+    full-batch gradient exactly for BN-free, dropout-free configs (small
+    model / freeze_bn); live BatchNorm sees per-micro-batch statistics
+    (same class of deviation as data-parallel per-replica BN, which the
+    reference has, SURVEY.md §5), and dropout draws an independent mask
+    per micro batch.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState,
@@ -48,31 +66,72 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
             image2 = jnp.clip(
                 image2 + stdv * jax.random.normal(k2, image2.shape), 0.0, 255.0)
 
-        def loss_fn(params):
+        def loss_fn(params, batch_stats, rng_d, im1, im2, flow, valid):
             variables = {"params": params}
-            if state.batch_stats:
-                variables["batch_stats"] = state.batch_stats
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
             out = model.apply(
-                variables, image1, image2, iters=iters, train=True,
+                variables, im1, im2, iters=iters, train=True,
                 freeze_bn=freeze_bn, pack_output=True,
-                mutable=["batch_stats"] if state.batch_stats else [],
-                rngs={"dropout": step_rng})
+                mutable=["batch_stats"] if batch_stats else [],
+                rngs={"dropout": rng_d})
             preds, new_model_state = out
-            loss, metrics = sequence_loss(preds, batch["flow"], batch["valid"],
+            loss, metrics = sequence_loss(preds, flow, valid,
                                           gamma=gamma, max_flow=max_flow,
                                           packed=True)
             return loss, (metrics, new_model_state)
 
-        (loss, (metrics, new_model_state)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        if accum_steps == 1:
+            (loss, (metrics, new_model_state)), grads = grad_fn(
+                state.params, state.batch_stats, step_rng, image1, image2,
+                batch["flow"], batch["valid"])
+            metrics = dict(metrics)
+            metrics["loss"] = loss
+        else:
+            B = image1.shape[0]
+            if B % accum_steps:
+                raise ValueError(f"batch size {B} not divisible by "
+                                 f"accum_steps {accum_steps}")
+            mb = B // accum_steps
+
+            def resh(x):
+                # interleaved grouping: micro i holds elements i, accum+i,
+                # ... — a batch axis sharded contiguously over 'data' stays
+                # shard-local through the (mb, accum) split (mb major
+                # keeps the sharding; contiguous accum-major grouping
+                # would force an all-to-all every step)
+                x = x.reshape((mb, accum_steps) + x.shape[1:])
+                return jnp.moveaxis(x, 1, 0)
+
+            micro = (resh(image1), resh(image2), resh(batch["flow"]),
+                     resh(batch["valid"]),
+                     jax.random.split(step_rng, accum_steps))
+
+            def micro_step(carry, mbatch):
+                grads_acc, bs = carry
+                im1, im2, flow, valid, rng_d = mbatch
+                (loss, (metrics, new_ms)), g = grad_fn(
+                    state.params, bs, rng_d, im1, im2, flow, valid)
+                grads_acc = jax.tree.map(jnp.add, grads_acc, g)
+                bs = new_ms.get("batch_stats", bs)
+                metrics = dict(metrics)
+                metrics["loss"] = loss
+                return (grads_acc, bs), metrics
+
+            zero = jax.tree.map(jnp.zeros_like, state.params)
+            (gsum, new_bs), mstack = jax.lax.scan(
+                micro_step, (zero, state.batch_stats), micro)
+            grads = jax.tree.map(lambda x: x / accum_steps, gsum)
+            metrics = jax.tree.map(lambda x: jnp.mean(x, axis=0), mstack)
+            new_model_state = {"batch_stats": new_bs} if new_bs else {}
 
         new_state = state.apply_gradients(grads=grads)
         new_state = new_state.replace(
             rng=rng,
             batch_stats=new_model_state.get("batch_stats",
                                             state.batch_stats))
-        metrics = dict(metrics)
-        metrics["loss"] = loss
         metrics["grad_norm"] = optax_global_norm(grads)
         return new_state, metrics
 

@@ -116,3 +116,29 @@ def test_initialize_distributed_single_host_noop():
 
     initialize_distributed()  # must not raise or re-init
     assert jax.process_count() == 1
+
+
+@pytest.mark.slow
+def test_grad_accum_composes_with_data_parallel():
+    """accum_steps under the data mesh: each device accumulates its own
+    shard sequentially; the update must match the plain parallel step."""
+    batch = _batch(B=8)
+    model = RAFT(RAFTConfig(small=True))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=10, wdecay=1e-5)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=2)
+    mesh = make_mesh(data=4)
+
+    plain = make_parallel_train_step(model, mesh, iters=2, gamma=0.8,
+                                     max_flow=400.0)
+    s1, m1 = plain(replicate_state(state, mesh), shard_batch(batch, mesh))
+
+    accum = make_parallel_train_step(model, mesh, iters=2, gamma=0.8,
+                                     max_flow=400.0, accum_steps=2)
+    s2, m2 = accum(replicate_state(state, mesh), shard_batch(batch, mesh))
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=2e-5)
