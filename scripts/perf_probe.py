@@ -58,7 +58,7 @@ def time_step(cfg, batch, iters=12, n=10, fwd_only=False):
         for _ in range(n):
             out = fwd(state.params, batch)
         float(out)
-        return (time.perf_counter() - t0) / n
+        return (time.perf_counter() - t0) / n, -1
 
     step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0,
                            donate=True)
@@ -67,7 +67,18 @@ def time_step(cfg, batch, iters=12, n=10, fwd_only=False):
     for _ in range(n):
         state, m = step(state, batch)
     float(m["loss"])
-    return (time.perf_counter() - t0) / n
+    dt = (time.perf_counter() - t0) / n
+
+    # Max peak HBM across local devices, where the backend reports it —
+    # the number that decides whether a variant (esp. deferred_corr_grad's
+    # stacked d_win buffer) fits the chip at this config.  NOTE: the
+    # allocator's peak counter is monotone over the PROCESS, so only the
+    # first variant of a multi-variant run gets a clean per-variant
+    # reading; main() labels it accordingly.
+    from raft_tpu.training.profiler import device_memory_stats
+    peak = max((s.get("peak_bytes_in_use", -1)
+                for s in device_memory_stats().values()), default=-1)
+    return dt, peak
 
 
 def main():
@@ -104,12 +115,19 @@ def main():
     want = sys.argv[1:] or ["current", "alt_pallas", "fwd_only"]
     batch = make_batch()
     B = batch["image1"].shape[0]
-    for name in want:
+    for i, name in enumerate(want):
         cfg = variants[name]()
         try:
-            dt = time_step(cfg, batch, fwd_only=(name == "fwd_only"))
+            dt, peak = time_step(cfg, batch, fwd_only=(name == "fwd_only"))
+            hbm = ""
+            if peak > 0:
+                # the allocator peak is monotone per process: clean for
+                # the first variant only — run one variant per invocation
+                # for per-variant readings
+                label = "peak HBM" if i == 0 else "peak-so-far HBM"
+                hbm = f"  [{label}: {peak / 2**30:.2f} GiB]"
             print(f"{name:>16}: {dt * 1e3:8.1f} ms/step  "
-                  f"({B / dt:6.2f} pairs/s)")
+                  f"({B / dt:6.2f} pairs/s){hbm}")
         except Exception as e:  # OOM etc — report and continue
             print(f"{name:>16}: FAILED {type(e).__name__}: {str(e)[:200]}")
 
