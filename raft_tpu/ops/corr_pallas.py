@@ -51,6 +51,7 @@ inputs should lower ``q_tile``.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -163,6 +164,106 @@ def _lookup_level(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
     )(f1q, f2x, cx_col, cy_col)
 
 
+def _rowloop_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, rx_ref,
+                    *, radius: int, w2: int, q_tile: int):
+    """One (batch, query-block, target-row) grid step — the Mosaic-
+    conservative variant.
+
+    The row-major kernel (_level_kernel) reshapes its (q, T) correlation
+    scratch to (q, h2, w2) in VMEM, splitting the 128-lane T axis — a
+    relayout Mosaic may reject or lower slowly (flagged in PARITY.md's
+    pending-hardware list).  This variant never reshapes a lane dim:
+    the grid's third axis walks fmap2's rows, BlockSpec slices one
+    (W2, C) row per step, and the output accumulates across the
+    sequential grid —
+
+        out[q, kx, ky] += wy[q, ky] * sum_w rx[q, kx, w] corr_y[q, w]
+
+    where wy is the y-direction bilinear weight evaluated at THIS row
+    only.  VMEM holds one fmap2 row instead of all of it (smaller
+    footprint, larger feasible q_tile); the trade is H2 smaller matmuls
+    (N = W2 lanes) instead of one big one.
+
+    f1_ref: (1, q_tile, C); f2_ref: (1, 1, W2, C) — row y;
+    cx/cy_ref: (q_tile, 1); out_ref: (1, q_tile, k1, k1) accumulated;
+    rx_ref: (q_tile, k1, W2) scratch — rx depends only on (b, qb), so
+    it is built once per query block (y == 0) and reused for all rows.
+    """
+    r = radius
+    k1 = 2 * r + 1
+    c_dim = f1_ref.shape[-1]
+    scale = 1.0 / (c_dim ** 0.5)
+    y = pl.program_id(2)
+
+    @pl.when(y == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+        rx_ref[...] = onehot_lerp_weights(cx_ref[...], r, w2)
+
+    # correlation against this target row: (q, W2)
+    corr_y = jax.lax.dot_general(
+        f1_ref[0], f2_ref[0, 0],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST) * scale
+
+    # x-direction window weights: (q, k1, W2) -> s[q, kx]
+    s = jax.lax.dot_general(
+        rx_ref[...], corr_y,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)                # (q, k1)
+
+    # y-direction bilinear weight of THIS row for each query's ky taps:
+    # wy[q, ky] = (1-f)*[y == i0-r+ky] + f*[y == i0-r+ky+1]
+    cy = cy_ref[...]                                        # (q, 1)
+    i0 = jnp.floor(cy)
+    f = cy - i0                                             # (q, 1)
+    kk = jax.lax.broadcasted_iota(jnp.int32, (q_tile, k1), 1)
+    base = i0.astype(jnp.int32) - r + kk                    # (q, k1)
+    wy = ((base == y).astype(jnp.float32) * (1.0 - f)
+          + (base + 1 == y).astype(jnp.float32) * f)        # (q, k1)
+
+    out_ref[0] += s[:, :, None] * wy[:, None, :]            # (q, kx, ky)
+
+
+def _lookup_level_rowloop(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
+                          cy: jax.Array, radius: int, q_tile: int,
+                          interpret: bool) -> jax.Array:
+    """Row-loop variant of :func:`_lookup_level` (same contract)."""
+    B, NQ, C = f1q.shape
+    H2, W2 = f2.shape[1], f2.shape[2]
+    k1 = 2 * radius + 1
+    nqb = NQ // q_tile
+    cx_col = cx.reshape(B * NQ, 1)
+    cy_col = cy.reshape(B * NQ, 1)
+
+    kernel = functools.partial(_rowloop_kernel, radius=radius, w2=W2,
+                               q_tile=q_tile)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, nqb, H2),
+        in_specs=[
+            pl.BlockSpec((1, q_tile, C), lambda b, qb, y: (b, qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, W2, C), lambda b, qb, y: (b, y, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, 1), lambda b, qb, y: (b * nqb + qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, 1), lambda b, qb, y: (b * nqb + qb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, q_tile, k1, k1),
+                               lambda b, qb, y: (b, qb, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, NQ, k1, k1), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((q_tile, k1, W2), jnp.float32),
+        ],
+        interpret=interpret,
+    )(f1q, f2, cx_col, cy_col)
+
+
 def _pick_q_tile(T: int, C: int, radius: int) -> int:
     """Largest q_tile whose level-0 VMEM footprint fits the ~16 MB/core
     budget with headroom: double-buffered fmap2 + corr row block
@@ -183,13 +284,50 @@ def _pick_q_tile(T: int, C: int, radius: int) -> int:
     return 8
 
 
+def _pick_q_tile_rowloop(W2: int, C: int, radius: int) -> int:
+    """q_tile sizing for the rowloop variant: VMEM holds one (W2, C)
+    fmap2 row (double-buffered) instead of all of fmap2, plus the rx
+    scratch, corr row, and output per query."""
+    lane = 128
+    w2p = ((W2 + lane - 1) // lane) * lane
+    budget = 12 * 1024 * 1024 - 2 * 4 * w2p * C
+
+    def per_q(qt: int) -> int:
+        k1 = 2 * radius + 1
+        k1p = ((k1 + 7) // 8) * 8
+        rx = 4 * k1p * w2p          # rx scratch row per query
+        corr = 4 * w2p              # corr_y row
+        out = 2 * 4 * k1p * lane    # double-buffered output
+        return rx + corr + out + 2 * 4 * C
+
+    for qt in (512, 256, 128, 64, 32, 16, 8):
+        if qt * per_q(qt) <= budget:
+            return qt
+    return 8
+
+
 def _forward(fmap1: jax.Array, fmap2_pyramid: Tuple[jax.Array, ...],
              coords: jax.Array, radius: int, q_tile: int) -> jax.Array:
     B, H1, W1, C = fmap1.shape
     Q = H1 * W1
+
+    # Kernel variant: "rowmajor" (default — one fused (q, T) MXU block)
+    # or "rowloop" (grid over target rows; no lane-dim reshapes — the
+    # Mosaic-conservative fallback, selectable without a code change if
+    # hardware rejects the row-major lowering).
+    variant = os.environ.get("RAFT_PALLAS_VARIANT", "rowmajor")
+    if variant not in ("rowmajor", "rowloop"):
+        raise ValueError(f"RAFT_PALLAS_VARIANT must be 'rowmajor' or "
+                         f"'rowloop', got {variant!r}")
+    level_fn = (_lookup_level if variant == "rowmajor"
+                else _lookup_level_rowloop)
+
     if q_tile is None:
         f2 = fmap2_pyramid[0]
-        q_tile = _pick_q_tile(f2.shape[1] * f2.shape[2], C, radius)
+        if variant == "rowloop":
+            q_tile = _pick_q_tile_rowloop(f2.shape[2], C, radius)
+        else:
+            q_tile = _pick_q_tile(f2.shape[1] * f2.shape[2], C, radius)
     nq = ((Q + q_tile - 1) // q_tile) * q_tile
     pad = nq - Q
     interpret = not _on_tpu()
@@ -205,9 +343,9 @@ def _forward(fmap1: jax.Array, fmap2_pyramid: Tuple[jax.Array, ...],
     k = (2 * radius + 1) ** 2
     out = []
     for i, f2 in enumerate(fmap2_pyramid):
-        win = _lookup_level(f1q, f2.astype(jnp.float32),
-                            cx / (2.0 ** i), cy / (2.0 ** i),
-                            radius, q_tile, interpret)
+        win = level_fn(f1q, f2.astype(jnp.float32),
+                       cx / (2.0 ** i), cy / (2.0 ** i),
+                       radius, q_tile, interpret)
         win = win.reshape(B, nq, k)[:, :Q]
         out.append(win.reshape(B, H1, W1, k))
     return jnp.concatenate(out, axis=-1)

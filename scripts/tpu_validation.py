@@ -29,12 +29,21 @@ sys.path.insert(0, ROOT)
 def run_kernel_tests():
     env = dict(os.environ, RAFT_TESTS_ON_DEVICE="1")
     r = subprocess.run(
-        [sys.executable, "-m", "pytest", "tests/test_corr_pallas.py",
-         "tests/test_ops_corr.py", "-x", "-q"],
-        cwd=ROOT, env=env)
-    print(f"[kernel] on-device kernel tests: "
-          f"{'OK' if r.returncode == 0 else 'FAILED'}")
-    return r.returncode == 0
+        [sys.executable, "-m", "pytest", "tests/test_ops_corr.py",
+         "-x", "-q"], cwd=ROOT, env=env)
+    ok = r.returncode == 0
+    print(f"[kernel] on-device corr-op tests: {'OK' if ok else 'FAILED'}")
+    # Only the Pallas tests read RAFT_PALLAS_VARIANT — loop just those.
+    for variant in ("rowmajor", "rowloop"):
+        env = dict(os.environ, RAFT_TESTS_ON_DEVICE="1",
+                   RAFT_PALLAS_VARIANT=variant)
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_corr_pallas.py",
+             "-x", "-q"], cwd=ROOT, env=env)
+        print(f"[kernel] on-device Pallas tests ({variant}): "
+              f"{'OK' if r.returncode == 0 else 'FAILED'}")
+        ok = ok and r.returncode == 0
+    return ok
 
 
 def run_bench():

@@ -132,3 +132,53 @@ def test_model_with_pallas_corr():
     np.testing.assert_allclose(np.asarray(out_alt[1]),
                                np.asarray(out_dense[1]),
                                atol=5e-2, rtol=5e-3)
+
+
+@pytest.mark.parametrize("radius", [2, 4])
+def test_rowloop_variant_matches_oracle(radius, monkeypatch):
+    """RAFT_PALLAS_VARIANT=rowloop — the Mosaic-conservative kernel
+    (grid over target rows, no lane-dim reshapes) must match the lax
+    oracle and the row-major kernel exactly."""
+    monkeypatch.setenv("RAFT_PALLAS_VARIANT", "rowloop")
+    f1, _, pyr, coords = _inputs(seed=3)
+    ref = alternate_corr_lookup(f1, pyr, coords, radius)
+    out = ondemand_corr_lookup(f1, pyr, coords, radius, 32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    monkeypatch.setenv("RAFT_PALLAS_VARIANT", "rowmajor")
+    rowmajor = ondemand_corr_lookup(f1, pyr, coords, radius, 32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rowmajor),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_rowloop_variant_vjp_and_oob(monkeypatch):
+    """The custom VJP and far-OOB zeroing are variant-independent (the
+    backward never calls the kernel), but run them under rowloop to pin
+    the composition."""
+    monkeypatch.setenv("RAFT_PALLAS_VARIANT", "rowloop")
+    f1, _, pyr, coords = _inputs(B=1, H=8, W=8, seed=5)
+    radius = 3
+
+    def loss_pallas(f1, pyr):
+        return jnp.sum(ondemand_corr_lookup(f1, pyr, coords, radius) ** 2)
+
+    def loss_oracle(f1, pyr):
+        return jnp.sum(alternate_corr_lookup(f1, pyr, coords, radius) ** 2)
+
+    g1 = jax.grad(loss_pallas, argnums=(0, 1))(f1, pyr)
+    g2 = jax.grad(loss_oracle, argnums=(0, 1))(f1, pyr)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+    far = coords + 1000.0
+    out = ondemand_corr_lookup(f1, pyr, far, radius)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+def test_unknown_pallas_variant_rejected(monkeypatch):
+    monkeypatch.setenv("RAFT_PALLAS_VARIANT", "bogus")
+    f1, _, pyr, coords = _inputs(B=1, H=8, W=8, seed=5)
+    with pytest.raises(ValueError, match="RAFT_PALLAS_VARIANT"):
+        ondemand_corr_lookup(f1, pyr, coords, 2)
