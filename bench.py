@@ -208,15 +208,24 @@ def main():
         float(metrics["loss"])
         return step, state, flops
 
+    def _is_oom(e) -> bool:
+        # Only genuine resource exhaustion triggers the fallback; compile
+        # or trace bugs in the default path must fail loudly (a silent
+        # config downgrade would mask them — round-2 advisor finding).
+        return ("RESOURCE_EXHAUSTED" in str(e)
+                or "Out of memory" in str(e) or "out of memory" in str(e))
+
     try:
         step, state, flops_per_step = build(cfg)
     except Exception as e:
+        if not _is_oom(e):
+            raise
         # Protect the scoreboard: if the deferred-grad path blows HBM on
         # this chip (its stacked d_win buffer is the config's dominant
         # backward transient), fall back to the plain accumulation path
         # and say so rather than dying.
-        print(f"bench: default config failed ({type(e).__name__}: "
-              f"{str(e)[:200]}); retrying with deferred_corr_grad=False",
+        print(f"bench: default config exhausted memory "
+              f"({str(e)[:200]}); retrying with deferred_corr_grad=False",
               file=sys.stderr)
         deferred = False
         cfg = dataclasses.replace(cfg, deferred_corr_grad=False)

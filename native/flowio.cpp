@@ -9,9 +9,6 @@
 //   - binary PPM (P6) read            (FlyingChairs images)
 //   - KITTI 16-bit PNG flow read/write ((v*64)+2^15 encoding,
 //                                      frame_utils.py:102-120), via libpng
-//   - a thread-pool batch decoder that overlaps file reads and decodes
-//     across samples (the role of torch DataLoader's worker processes,
-//     reference datasets.py:230) behind one blocking call.
 //
 // Exposed as a plain C ABI consumed with ctypes from
 // raft_tpu/utils/native.py (no pybind11 in this environment).
@@ -22,7 +19,6 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
-#include <thread>
 #include <vector>
 
 #include <png.h>
@@ -306,52 +302,6 @@ int raftio_png16_flow_write(const char* path, const float* flow, int w,
     png_destroy_write_struct(&png, &info);
     fclose(f);
     return 0;
-}
-
-// ---------------------------------------------------------------------------
-// Thread-pool batch flow decode
-// ---------------------------------------------------------------------------
-
-// Decodes n .flo files concurrently into caller-provided per-item slots.
-// kinds[i]: 0 = .flo, 1 = .pfm (first 2 channels).  Returns the number
-// of failures; data[i] is null for failed items.
-int raftio_batch_flow_read(const char** paths, const int* kinds, int n,
-                           int n_threads, float** data, int* ws, int* hs) {
-    std::vector<int> errs(n, 0);
-    std::vector<std::thread> workers;
-    const int nt = n_threads < 1 ? 1 : (n_threads > n ? n : n_threads);
-    for (int t = 0; t < nt; ++t) {
-        workers.emplace_back([&, t]() {
-            for (int i = t; i < n; i += nt) {
-                data[i] = nullptr;
-                if (kinds[i] == 0) {
-                    errs[i] = raftio_flo_read(paths[i], &data[i], &ws[i],
-                                              &hs[i]);
-                } else {
-                    float* buf = nullptr;
-                    int w = 0, h = 0, ch = 0;
-                    errs[i] = raftio_pfm_read(paths[i], &buf, &w, &h, &ch);
-                    if (errs[i] == 0) {
-                        // keep (u, v): PFM flow files carry 3 channels
-                        float* fl = static_cast<float*>(
-                            malloc(size_t(w) * h * 2 * 4));
-                        for (int64_t p = 0; p < int64_t(w) * h; ++p) {
-                            fl[p * 2 + 0] = buf[p * ch + 0];
-                            fl[p * 2 + 1] = ch > 1 ? buf[p * ch + 1] : 0.f;
-                        }
-                        free(buf);
-                        data[i] = fl;
-                        ws[i] = w;
-                        hs[i] = h;
-                    }
-                }
-            }
-        });
-    }
-    for (auto& th : workers) th.join();
-    int fails = 0;
-    for (int e : errs) fails += e != 0;
-    return fails;
 }
 
 }  // extern "C"

@@ -272,10 +272,10 @@ class SyntheticShift(FlowDataset):
                  max_shift: int = 16, frames_dir: Optional[str] = None,
                  seed: int = 0, aug_params: Optional[dict] = None):
         # aug_params: optional dense FlowAugmentor (jitter/scale/crop) for
-        # pipeline/throughput runs (e.g. the fed bench lane).  With
-        # augmentation the wrap-band mask is approximated by the dense
-        # |flow|<1000 rule (the crop/scale moves the band), so exact-GT
-        # training should keep the default aug_params=None.
+        # pipeline/throughput runs (e.g. the fed bench lane).  The
+        # wrap-band mask rides through augmentation as a sentinel flow
+        # value that the dense |flow|<1000 pack rule maps back to
+        # valid=0, so augmented samples keep exact supervision too.
         super().__init__(aug_params=aug_params, seed=seed)
         self.image_size = tuple(image_size)
         self.length = length
@@ -336,6 +336,17 @@ class SyntheticShift(FlowDataset):
         elif dx < 0:
             valid[:, :-dx] = 0
         if self.augmentor is not None:
+            # Carry the wrap-band invalidity THROUGH the dense augmentor:
+            # a huge sentinel flow in the band survives crop/scale (scale
+            # multiplies it, interpolation at the band edge only spreads
+            # invalidity conservatively) and the dense |flow|<1000 pack
+            # rule turns it back into valid=0 — so augmented synthetic
+            # samples never train on wrapped pixels (round-2 advisor
+            # finding).  1e9, not 1e6: bilinear resize blends the band
+            # into neighbors with weights as small as ~1e-4, and the
+            # blended value must still exceed the 1000 threshold.
+            flow = flow.copy()
+            flow[valid == 0] = 1e9
             img1, img2, flow, _ = self._augment(
                 index, img1.astype(np.uint8), img2.astype(np.uint8), flow)
             return self._pack(img1, img2, flow)  # dense valid rule

@@ -11,28 +11,30 @@ Design (TPU-first, not a CUDA translation):
 - The CUDA kernel walks pixels with a 4x8 thread block and gathers the
   (2r+2)^2 neighborhood of fmap2 per pixel from HBM.  On TPU, scattered
   gathers starve the VPU, while the MXU is nearly free for matmuls — so
-  the kernel instead computes, per block of ``q_tile`` query pixels, the
-  *full* correlation row block ``fmap1_blk @ fmap2^T`` (q_tile, H2*W2)
+  the kernel instead computes, per (query-block, target-block) grid
+  step, a correlation tile ``fmap1_blk @ fmap2_blk^T`` (q_tile, t_tile)
   with one MXU contraction in VMEM.  HBM traffic stays O(H*W * C) — the
   full O((H*W)^2) volume never exists outside VMEM — which is exactly
   the memory win alt_cuda_corr exists for (README.md:115-121).
 
-- The per-query windowed *bilinear gather* becomes two one-hot
-  contractions (gather-as-matmul, the canonical TPU idiom): separable
-  row/column matrices RX[q, kx, w] and RY[q, ky, h] carry the bilinear
-  weights directly —
-      RX[q, kx, w] = (1-fx)*[w == x0-r+kx] + fx*[w == x0-r+kx+1]
-  so  out[q, kx, ky] = sum_{w,h} RX[q,kx,w] * corr_img[q,w,h] * RY[q,ky,h].
+- The per-query windowed *bilinear gather* becomes one-hot weight
+  tensors evaluated directly on the FLAT target index (gather-as-
+  matmul, the canonical TPU idiom): with (x, y) = (t mod W2, t div W2)
+  recovered by iota arithmetic in lanes,
+      wx[q, kx, s] = (1-fx)*[x(s) == x0-r+kx] + fx*[x(s) == x0-r+kx+1]
+  so  out[q, kx, ky] = sum_s corr[q,s] * wx[q,kx,s] * wy[q,ky,s].
   Everything is iota comparisons and reductions: no dynamic indexing
   (Mosaic requires lane-dim slice offsets to be multiples of 128), no
-  scalar loops, full VPU/MXU vectorization.  Out-of-window taps simply
-  never match the one-hot, reproducing bilinear_sampler's zero OOB
-  padding (core/utils/utils.py:61-65) without a padded border.
+  scalar loops, no lane-dim reshapes (Mosaic rejects splitting the lane
+  axis — the round-3 hardware finding that killed the original
+  "rowmajor" variant), full VPU/MXU vectorization.  Out-of-window taps
+  simply never match the one-hot, reproducing bilinear_sampler's zero
+  OOB padding (core/utils/utils.py:61-65) without a padded border.
 
 - Targets keep their natural row-major flattening (t = y*W2 + x); the
-  contraction order (w first, then h) yields the flat window index
-  k = kx*(2r+1) + ky directly, matching the reference's meshgrid
-  ordering (core/corr.py:37-44) with no re-layout pass.
+  output is produced [kx, ky]-indexed so the flat window index
+  k = kx*(2r+1) + ky matches the reference's meshgrid ordering
+  (core/corr.py:37-44) with no re-layout pass.
 
 - The backward pass is a hand-written VJP (the CUDA backward exists at
   correlation_kernel.cu:123-256 but is dead code — the Python side never
@@ -42,10 +44,11 @@ Design (TPU-first, not a CUDA translation):
   coords_grad (correlation_kernel.cu:307) and the model's per-iteration
   stop_gradient on coords (core/raft.py:123).
 
-VMEM budget per grid step (fp32): fmap2 (T*C) + corr row block
-(q_tile*T) — about 7 MB at the reference's largest training resolution
-(400x720/8, C=256, q_tile=128), within the ~16 MB/core budget.  Larger
-inputs should lower ``q_tile``.
+VMEM budget per grid step (fp32): a double-buffered (t_tile, C) fmap2
+block plus the (q_tile, k1, t_tile) weight/product slabs — about 8 MB at
+(q_tile=128, t_tile=512, C=256, r=4), independent of resolution (larger
+images add grid steps, not VMEM).  ``_pick_q_tile`` sizes the tile to
+the budget.
 """
 
 from __future__ import annotations
@@ -66,57 +69,86 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _level_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, corr_ref,
-                  *, radius: int, h2: int, w2: int, q_tile: int):
-    """One (batch, query-block) grid step.
+def _blocked_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref,
+                    *, radius: int, w2: int, q_tile: int, t_tile: int):
+    """One (batch, query-block, target-block) grid step — the default
+    variant.
 
-    f1_ref:  (1, q_tile, C) query features.
-    f2_ref:  (1, T, C) target features, row-major flattened (T = H2*W2,
-             t = y*W2 + x — the array's natural order, so the row block
-             reshapes to (q, H2, W2) for free; no re-layout scratch).
-    cx_ref/cy_ref: (q_tile, 1) query coords at this level's scale.
-    out_ref: (1, q_tile, 2r+1, 2r+1) window correlations, [kx, ky].
-    corr_ref: (q_tile, T) scratch for the correlation row block.
+    Round-3 hardware result: the original "rowmajor" kernel reshaped its
+    (q, T) correlation scratch to (q, H2, W2) in VMEM — splitting the
+    128-lane T axis, which Mosaic rejects ("infer-vector-layout:
+    unsupported shape cast").  This kernel never reshapes a lane dim:
+    fmap2 arrives pre-flattened (B, T, C), the grid's third axis walks T
+    in ``t_tile`` chunks, and the bilinear window weights are evaluated
+    directly on *flat* target indices by recovering (x, y) = (t mod W2,
+    t div W2) with iota arithmetic in lanes:
+
+        wx[q, kx, s] = [x(t0+s) == x0(q)-r+kx]*(1-fx) + [... +1]*fx
+        wy[q, ky, s] = same in y
+        out[q, kx, ky] += sum_s corr[q, s] * wx[q, kx, s] * wy[q, ky, s]
+
+    The division uses floor((t+0.5)/W2) in f32 — exact for all t < 2^23
+    and immune to one-ulp rounding at exact multiples — so the equality
+    tests compare exact small integers.  Out-of-range taps match nothing,
+    reproducing bilinear_sampler's zero OOB padding (utils.py:61-65);
+    zero-padded target tail blocks contribute zero through corr.
+
+    f1_ref: (1, q_tile, C); f2_ref: (1, t_tile, C) — flat target block;
+    cx/cy_ref: (q_tile, 1); out_ref: (1, q_tile, k1, k1), accumulated
+    across the sequential t grid axis.
     """
     r = radius
     k1 = 2 * r + 1
     c_dim = f1_ref.shape[-1]
     scale = 1.0 / (c_dim ** 0.5)
+    tb = pl.program_id(2)
 
-    # 1) MXU: correlation row block for these queries, fp32 accumulation
-    #    (parity with corr.py:50's .float()).
-    corr_ref[...] = jax.lax.dot_general(
+    @pl.when(tb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    # MXU: correlation rows of these queries against this target block,
+    # f32 accumulation (parity with corr.py:50's .float()).
+    corr = jax.lax.dot_general(
         f1_ref[0], f2_ref[0],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    ) * scale  # (q_tile, T) with t = y*W2 + x
+        precision=jax.lax.Precision.HIGHEST) * scale     # (q, t_tile)
 
-    # 2) Separable bilinear one-hot gather: two weighted contractions
-    #    (shared parity-critical construction, corr.py).  Contracting w
-    #    first and h second yields [kx, ky] directly — the reference's
-    #    x-major window order (corr.py:37-44) — from row-major rows.
-    rx = onehot_lerp_weights(cx_ref[...], r, w2)         # (q, k1, W2)
-    ry = onehot_lerp_weights(cy_ref[...], r, h2)         # (q, k1, H2)
-    img = corr_ref[...].reshape(q_tile, h2, w2)
+    # Flat target coordinates of this block, broadcast to (q, k1, t_tile).
+    # Mosaic's iota is integer-only; convert after.
+    t0 = (tb * t_tile).astype(jnp.float32)
+    s = jax.lax.broadcasted_iota(
+        jnp.int32, (q_tile, k1, t_tile), 2).astype(jnp.float32) + t0
+    yt = jnp.floor((s + 0.5) * (1.0 / w2))
+    xt = s - yt * w2
+    kk = jax.lax.broadcasted_iota(
+        jnp.int32, (q_tile, k1, t_tile), 1).astype(jnp.float32)
 
-    # A[q, kx, h] = sum_w rx[q, kx, w] * img[q, h, w]
-    a = jax.lax.dot_general(
-        rx, img,
-        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST)             # (q, k1, H2)
-    # out[q, kx, ky] = sum_h a[q, kx, h] * ry[q, ky, h]
-    out_ref[0] = jax.lax.dot_general(
-        a, ry,
+    cx = cx_ref[...][:, :, None]                         # (q, 1, 1)
+    cy = cy_ref[...][:, :, None]
+    x0 = jnp.floor(cx)
+    y0 = jnp.floor(cy)
+    fx = cx - x0
+    fy = cy - y0
+    bx = x0 - r + kk
+    by = y0 - r + kk
+    wx = ((xt == bx).astype(jnp.float32) * (1.0 - fx)
+          + (xt == bx + 1.0).astype(jnp.float32) * fx)   # (q, kx, s)
+    wy = ((yt == by).astype(jnp.float32) * (1.0 - fy)
+          + (yt == by + 1.0).astype(jnp.float32) * fy)   # (q, ky, s)
+
+    # out[q, kx, ky] += sum_s (corr*wx)[q, kx, s] * wy[q, ky, s]
+    out_ref[0] += jax.lax.dot_general(
+        corr[:, None, :] * wx, wy,
         dimension_numbers=(((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST)             # (q, k1, k1)
 
 
-def _lookup_level(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
-                  cy: jax.Array, radius: int, q_tile: int,
-                  interpret: bool) -> jax.Array:
+def _lookup_level_blocked(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
+                          cy: jax.Array, radius: int, q_tile: int,
+                          interpret: bool) -> jax.Array:
     """Windowed on-demand correlation for one pyramid level.
 
     Args:
@@ -132,50 +164,49 @@ def _lookup_level(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
     r = radius
     k1 = 2 * r + 1
     T = H2 * W2
-    # natural row-major target flattening: t = y*W2 + x
+    # natural row-major target flattening: t = y*W2 + x, zero-padded to a
+    # whole number of t_tile blocks (padded tail => corr rows of zero)
+    t_tile = min(512, ((T + 127) // 128) * 128)
+    nt = -(-T // t_tile)
     f2x = f2.reshape(B, T, C)
+    if nt * t_tile != T:
+        f2x = jnp.pad(f2x, ((0, 0), (0, nt * t_tile - T), (0, 0)))
     nqb = NQ // q_tile
     cx_col = cx.reshape(B * NQ, 1)
     cy_col = cy.reshape(B * NQ, 1)
 
-    kernel = functools.partial(_level_kernel, radius=r, h2=H2, w2=W2,
-                               q_tile=q_tile)
+    kernel = functools.partial(_blocked_kernel, radius=r, w2=W2,
+                               q_tile=q_tile, t_tile=t_tile)
     return pl.pallas_call(
         kernel,
-        grid=(B, nqb),
+        grid=(B, nqb, nt),
         in_specs=[
-            pl.BlockSpec((1, q_tile, C), lambda b, qb: (b, qb, 0),
+            pl.BlockSpec((1, q_tile, C), lambda b, qb, tb: (b, qb, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, C), lambda b, qb: (b, 0, 0),
+            pl.BlockSpec((1, t_tile, C), lambda b, qb, tb: (b, tb, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((q_tile, 1), lambda b, qb: (b * nqb + qb, 0),
+            pl.BlockSpec((q_tile, 1), lambda b, qb, tb: (b * nqb + qb, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((q_tile, 1), lambda b, qb: (b * nqb + qb, 0),
+            pl.BlockSpec((q_tile, 1), lambda b, qb, tb: (b * nqb + qb, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, q_tile, k1, k1),
-                               lambda b, qb: (b, qb, 0, 0),
+                               lambda b, qb, tb: (b, qb, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, NQ, k1, k1), jnp.float32),
-        scratch_shapes=[
-            pltpu.VMEM((q_tile, T), jnp.float32),
-        ],
         interpret=interpret,
     )(f1q, f2x, cx_col, cy_col)
 
 
 def _rowloop_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, rx_ref,
                     *, radius: int, w2: int, q_tile: int):
-    """One (batch, query-block, target-row) grid step — the Mosaic-
-    conservative variant.
+    """One (batch, query-block, target-row) grid step — the conservative
+    fallback variant.
 
-    The row-major kernel (_level_kernel) reshapes its (q, T) correlation
-    scratch to (q, h2, w2) in VMEM, splitting the 128-lane T axis — a
-    relayout Mosaic may reject or lower slowly (flagged in PARITY.md's
-    pending-hardware list).  This variant never reshapes a lane dim:
-    the grid's third axis walks fmap2's rows, BlockSpec slices one
-    (W2, C) row per step, and the output accumulates across the
-    sequential grid —
+    Like the blocked kernel it never reshapes a lane dim, but instead of
+    t-tiles it walks fmap2 one ROW at a time: the grid's third axis is
+    H2, BlockSpec slices one (W2, C) row per step, and the output
+    accumulates across the sequential grid —
 
         out[q, kx, ky] += wy[q, ky] * sum_w rx[q, kx, w] corr_y[q, w]
 
@@ -230,7 +261,7 @@ def _rowloop_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, rx_ref,
 def _lookup_level_rowloop(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
                           cy: jax.Array, radius: int, q_tile: int,
                           interpret: bool) -> jax.Array:
-    """Row-loop variant of :func:`_lookup_level` (same contract)."""
+    """Row-loop variant of :func:`_lookup_level_blocked` (same contract)."""
     B, NQ, C = f1q.shape
     H2, W2 = f2.shape[1], f2.shape[2]
     k1 = 2 * radius + 1
@@ -265,18 +296,19 @@ def _lookup_level_rowloop(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
 
 
 def _pick_q_tile(T: int, C: int, radius: int) -> int:
-    """Largest q_tile whose level-0 VMEM footprint fits the ~16 MB/core
-    budget with headroom: double-buffered fmap2 + corr row block
-    (lane-padded) + double-buffered output."""
-    f2_bytes = 2 * 4 * T * C
-    budget = 12 * 1024 * 1024 - f2_bytes
+    """Largest q_tile whose blocked-kernel VMEM footprint fits the
+    ~16 MB/core budget with headroom: double-buffered (t_tile, C) fmap2
+    block + per-query corr row, wx/wy/product slabs, and output."""
+    t_tile = min(512, ((T + 127) // 128) * 128)
+    budget = 12 * 1024 * 1024 - 2 * 4 * t_tile * C
 
     def per_q(qt: int) -> int:
-        lane = 128
-        corr = 4 * ((T + lane - 1) // lane) * lane
-        k1p = ((2 * radius + 1 + 7) // 8) * 8
-        out = 2 * 4 * k1p * lane
-        return corr + out + 2 * 4 * C
+        k1 = 2 * radius + 1
+        k1p = ((k1 + 7) // 8) * 8
+        corr = 4 * t_tile                 # correlation row
+        slabs = 3 * 4 * k1p * t_tile      # wx, wy, corr*wx
+        out = 2 * 4 * k1p * 128           # double-buffered output
+        return corr + slabs + out + 2 * 4 * C
 
     for qt in (256, 128, 64, 32, 16, 8):
         if qt * per_q(qt) <= budget:
@@ -311,15 +343,16 @@ def _forward(fmap1: jax.Array, fmap2_pyramid: Tuple[jax.Array, ...],
     B, H1, W1, C = fmap1.shape
     Q = H1 * W1
 
-    # Kernel variant: "rowmajor" (default — one fused (q, T) MXU block)
-    # or "rowloop" (grid over target rows; no lane-dim reshapes — the
-    # Mosaic-conservative fallback, selectable without a code change if
-    # hardware rejects the row-major lowering).
-    variant = os.environ.get("RAFT_PALLAS_VARIANT", "rowmajor")
-    if variant not in ("rowmajor", "rowloop"):
-        raise ValueError(f"RAFT_PALLAS_VARIANT must be 'rowmajor' or "
+    # Kernel variant: "blocked" (default — t-tiled flat-target MXU blocks;
+    # Mosaic-proven on v5e, see PARITY.md) or "rowloop" (grid over single
+    # target rows — the conservative fallback, slower on hardware).  The
+    # original "rowmajor" kernel was removed in round 3: Mosaic rejects
+    # its (q, T) -> (q, H2, W2) lane-dim reshape on real TPUs.
+    variant = os.environ.get("RAFT_PALLAS_VARIANT", "blocked")
+    if variant not in ("blocked", "rowloop"):
+        raise ValueError(f"RAFT_PALLAS_VARIANT must be 'blocked' or "
                          f"'rowloop', got {variant!r}")
-    level_fn = (_lookup_level if variant == "rowmajor"
+    level_fn = (_lookup_level_blocked if variant == "blocked"
                 else _lookup_level_rowloop)
 
     if q_tile is None:

@@ -51,8 +51,19 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
     base = make_train_step(model, iters=iters, gamma=gamma, max_flow=max_flow,
                            freeze_bn=freeze_bn, add_noise=add_noise,
                            donate=donate, accum_steps=accum_steps)
+    data_size = mesh.shape.get("data", 1)
 
     def step(state: TrainState, batch: Dict):
+        if accum_steps > 1:
+            mb = batch["image1"].shape[0] // accum_steps
+            if mb % data_size:
+                raise ValueError(
+                    f"micro-batch {mb} (batch "
+                    f"{batch['image1'].shape[0]} / accum_steps "
+                    f"{accum_steps}) is not a multiple of the 'data' mesh "
+                    f"axis ({data_size}): the shard-local accumulation "
+                    f"guarantee breaks and GSPMD would insert per-step "
+                    f"resharding")
         with jax.set_mesh(mesh):
             return base(state, batch)
 

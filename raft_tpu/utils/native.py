@@ -8,8 +8,10 @@ pure-Python implementations in raft_tpu/data/frame_utils.py.
 The reference's only native component is the CUDA correlation sampler
 (alt_cuda_corr/); its TPU equivalent is the Pallas kernel
 (ops/corr_pallas.py).  This library is the native half of the *data*
-plane: format decoders plus a thread-pool batch reader standing in for
-torch DataLoader's worker processes (reference datasets.py:230).
+plane: the per-format decoders on the hot read path.  Cross-sample
+concurrency lives in the DataLoader's sample-level thread pool
+(data/loader.py), standing in for torch DataLoader's worker processes
+(reference datasets.py:230).
 """
 
 from __future__ import annotations
@@ -63,10 +65,6 @@ def _bind(lib) -> None:
         ctypes.POINTER(ctypes.c_int)]
     lib.raftio_png16_flow_write.argtypes = [
         ctypes.c_char_p, _c_float_p, ctypes.c_int, ctypes.c_int]
-    lib.raftio_batch_flow_read.argtypes = [
-        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
-        ctypes.c_int, ctypes.c_int, ctypes.POINTER(_c_float_p),
-        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
 
 
 def get_lib():
@@ -182,29 +180,3 @@ def write_flow_kitti(path: str, flow: np.ndarray) -> bool:
     return lib.raftio_png16_flow_write(
         path.encode(), flow.ctypes.data_as(_c_float_p),
         flow.shape[1], flow.shape[0]) == 0
-
-
-def batch_read_flows(paths, n_threads: int = 4):
-    """Thread-pool decode of many .flo/.pfm flow files at once.
-
-    Returns a list of (H, W, 2) arrays (None per failed item), or None
-    when the native library is unavailable.
-    """
-    lib = get_lib()
-    if lib is None:
-        return None
-    n = len(paths)
-    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
-    kinds = (ctypes.c_int * n)(
-        *[1 if p.lower().endswith(".pfm") else 0 for p in paths])
-    datas = (_c_float_p * n)()
-    ws = (ctypes.c_int * n)()
-    hs = (ctypes.c_int * n)()
-    lib.raftio_batch_flow_read(c_paths, kinds, n, n_threads, datas, ws, hs)
-    out = []
-    for i in range(n):
-        if datas[i]:
-            out.append(_take_f32(lib, datas[i], (hs[i], ws[i], 2)))
-        else:
-            out.append(None)
-    return out

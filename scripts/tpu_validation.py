@@ -34,7 +34,7 @@ def run_kernel_tests():
     ok = r.returncode == 0
     print(f"[kernel] on-device corr-op tests: {'OK' if ok else 'FAILED'}")
     # Only the Pallas tests read RAFT_PALLAS_VARIANT — loop just those.
-    for variant in ("rowmajor", "rowloop"):
+    for variant in ("blocked", "rowloop"):
         env = dict(os.environ, RAFT_TESTS_ON_DEVICE="1",
                    RAFT_PALLAS_VARIANT=variant)
         r = subprocess.run(
@@ -70,10 +70,6 @@ def run_highres():
     i1 = jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32))
     i2 = jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32))
 
-    results = {}
-    # iters=1 for the cross-implementation field comparison: tiny numeric
-    # differences amplify chaotically through 20 recurrent iterations of
-    # an untrained model, so agreement is only meaningful per-lookup.
     for name, cfg in [
         ("all_pairs", RAFTConfig(compute_dtype="bfloat16",
                                  corr_dtype="bfloat16")),
@@ -86,9 +82,6 @@ def run_highres():
         v = model.init(jax.random.PRNGKey(0), i1, i2, iters=1)
         fn = jax.jit(lambda v, a, b, m=model: m.apply(v, a, b, iters=20,
                                                       test_mode=True))
-        one = jax.jit(lambda v, a, b, m=model: m.apply(v, a, b, iters=1,
-                                                       test_mode=True))
-        field = np.asarray(one(v, i1, i2)[1])
         out = fn(v, i1, i2)
         float(np.asarray(out[1]).mean())  # host sync
         t0 = time.perf_counter()
@@ -96,19 +89,40 @@ def run_highres():
             out = fn(v, i1, i2)
         float(np.asarray(out[1]).mean())
         dt = (time.perf_counter() - t0) / 5
-        results[name] = (dt, field)
         print(f"[highres] {name:10s}: {dt * 1e3:7.1f} ms / 20-iter pass "
               f"@ {H}x{W}")
-    # implementations must agree per-pixel after one iteration
+
+    # Correctness: the three corr implementations must agree on the raw
+    # LOOKUP (a linear op — a per-pixel flow comparison through a bf16
+    # untrained recurrent model amplifies benign precision differences
+    # chaotically; round-3 finding).  f32 inputs, HIGHEST matmuls.
+    from raft_tpu.ops.corr import (build_corr_pyramid_direct,
+                                   build_fmap_pyramid, chunked_corr_lookup,
+                                   corr_lookup)
+    from raft_tpu.ops.corr_pallas import ondemand_corr_lookup
+
+    h1, w1, C = H // 8, W // 8, 256
+    f1 = jnp.asarray(rng.standard_normal((1, h1, w1, C)).astype(np.float32))
+    f2 = jnp.asarray(rng.standard_normal((1, h1, w1, C)).astype(np.float32))
+    base = np.stack(np.meshgrid(np.arange(w1), np.arange(h1)), -1)
+    coords = jnp.asarray((rng.standard_normal((1, h1, w1, 2)) * 8
+                          + base[None]).astype(np.float32))
+    with jax.default_matmul_precision("highest"):
+        dense = np.asarray(corr_lookup(
+            build_corr_pyramid_direct(f1, f2), coords, 4))
+        pyr = tuple(build_fmap_pyramid(f2))
+        lookups = {
+            "chunked": np.asarray(chunked_corr_lookup(f1, pyr, coords, 4)),
+            "pallas": np.asarray(ondemand_corr_lookup(f1, pyr, coords, 4)),
+        }
     ok = True
-    ref = results["all_pairs"][1]
-    scale = max(1.0, float(np.abs(ref).max()))
-    for name in ("chunked", "pallas"):
-        d = float(np.abs(results[name][1] - ref).max())
-        if d > 1e-2 * scale:
-            print(f"[highres] FAIL: {name} flow field diverges from "
-                  f"all_pairs (max |d| = {d:.4f}, scale {scale:.1f})")
-            ok = False
+    scale = max(1.0, float(np.abs(dense).max()))
+    for name, val in lookups.items():
+        d = float(np.abs(val - dense).max())
+        status = "OK" if d <= 1e-3 * scale else "FAIL"
+        print(f"[highres] lookup parity {name} vs all_pairs: "
+              f"max |d| = {d:.2e} (scale {scale:.1f}) {status}")
+        ok = ok and d <= 1e-3 * scale
     return ok
 
 
@@ -129,6 +143,61 @@ def run_train():
     return ok
 
 
+def run_accuracy():
+    """On-chip accuracy round-trip: train 500 steps on the synthetic
+    stage, then measure held-out EPE (seed-disjoint SyntheticShift pairs)
+    from the saved checkpoint.  Writes the JSON artifact
+    docs/tpu_runs/synthetic_epe.json (checked in — the scripted
+    reproduction of round 1's 0.58 px run).  Pass bar: EPE <= 0.6 px."""
+    import json
+    import shutil
+
+    ckpt = "/tmp/tpu_val_acc"
+    shutil.rmtree(ckpt, ignore_errors=True)
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.cli.train", "--stage", "synthetic",
+         "--mixed_precision", "--corr_dtype", "bfloat16", "--iters", "12",
+         "--num_steps", "500", "--checkpoint_dir", ckpt, "--log_dir",
+         "/tmp/tpu_val_runs", "--no_tensorboard", "--val_freq", "1000000"],
+        cwd=ROOT)
+    if r.returncode != 0:
+        print("[accuracy] training run FAILED")
+        return False
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.cli.evaluate import load_variables
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.evaluation.evaluate import Evaluator, validate_synthetic
+    from raft_tpu.models import RAFT
+
+    model = RAFT(RAFTConfig(compute_dtype="bfloat16",
+                            corr_dtype="bfloat16"))
+    variables = load_variables(os.path.join(ckpt, "raft-synthetic.msgpack"),
+                               model, sample_shape=(1, 368, 496, 3))
+    results = validate_synthetic(Evaluator(model, variables))
+    epe = results["synthetic"]
+
+    commit = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                            cwd=ROOT, capture_output=True,
+                            text=True).stdout.strip()
+    artifact = {
+        "run": "synthetic-500-step train + held-out EPE",
+        "steps": 500, "epe_px": round(epe, 4), "pass_bar_px": 0.6,
+        "device": jax.devices()[0].device_kind, "commit": commit,
+    }
+    out = os.path.join(ROOT, "docs", "tpu_runs")
+    os.makedirs(out, exist_ok=True)
+    with open(os.path.join(out, "synthetic_epe.json"), "w") as f:
+        json.dump(artifact, f, indent=1)
+    ok = epe <= 0.6
+    print(f"[accuracy] held-out synthetic EPE after 500 steps: {epe:.3f} px "
+          f"({'OK' if ok else 'FAILED'}; artifact docs/tpu_runs/"
+          f"synthetic_epe.json)")
+    return ok
+
+
 def run_probe():
     r = subprocess.run(
         [sys.executable, "scripts/perf_probe.py", "current",
@@ -139,7 +208,8 @@ def run_probe():
 
 
 STAGES = {"kernel": run_kernel_tests, "bench": run_bench,
-          "highres": run_highres, "train": run_train, "probe": run_probe}
+          "highres": run_highres, "train": run_train,
+          "accuracy": run_accuracy, "probe": run_probe}
 
 
 def main():

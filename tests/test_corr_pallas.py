@@ -136,9 +136,9 @@ def test_model_with_pallas_corr():
 
 @pytest.mark.parametrize("radius", [2, 4])
 def test_rowloop_variant_matches_oracle(radius, monkeypatch):
-    """RAFT_PALLAS_VARIANT=rowloop — the Mosaic-conservative kernel
-    (grid over target rows, no lane-dim reshapes) must match the lax
-    oracle and the row-major kernel exactly."""
+    """RAFT_PALLAS_VARIANT=rowloop — the conservative fallback kernel
+    (grid over target rows) must match the lax oracle and the default
+    blocked kernel exactly."""
     monkeypatch.setenv("RAFT_PALLAS_VARIANT", "rowloop")
     f1, _, pyr, coords = _inputs(seed=3)
     ref = alternate_corr_lookup(f1, pyr, coords, radius)
@@ -146,10 +146,10 @@ def test_rowloop_variant_matches_oracle(radius, monkeypatch):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
 
-    monkeypatch.setenv("RAFT_PALLAS_VARIANT", "rowmajor")
-    rowmajor = ondemand_corr_lookup(f1, pyr, coords, radius, 32)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(rowmajor),
-                               atol=1e-6, rtol=1e-6)
+    monkeypatch.setenv("RAFT_PALLAS_VARIANT", "blocked")
+    blocked = ondemand_corr_lookup(f1, pyr, coords, radius, 32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(blocked),
+                               atol=1e-5, rtol=1e-5)
 
 
 def test_rowloop_variant_vjp_and_oob(monkeypatch):
