@@ -12,8 +12,13 @@ bf16 compute) — the primary metric named in BASELINE.json.
 - ``mfu``: model FLOPs utilization — XLA's analyzed FLOPs per step divided
   by (step time x chip peak bf16 FLOP/s).
 - ``fed_pairs_per_s``: same step fed by the real host pipeline
-  (SyntheticShift + dense augmentor -> DataLoader -> prefetch_to_device),
-  proving the loader sustains the device rate.
+  (SyntheticShift + dense augmentor -> DataLoader -> prefetch_to_device).
+  Interpret against ``host_cores``: generation + dense augmentation cost
+  ~27 ms of CPU per sample, so a 1-core host (this tunnel environment)
+  tops out near 5 fed pairs/s no matter the loader design — the loader
+  itself sustains 37 samples/s standalone-with-aug and 111/s without
+  (scripts/data_bench.py), and a real TPU VM host (>= 100 cores) feeds
+  the 31 pairs/s device rate with one core per worker x 4 workers.
 
 Baseline: the reference repo publishes no numbers (BASELINE.md).  The
 denominator used here is 7.0 pairs/s — an A100 estimate derived from the
@@ -58,13 +63,21 @@ def _fail(reason: str, backend_down: bool = True) -> None:
     sys.exit(1)
 
 
-def preflight(attempts: int = 2, timeout_s: int = 150) -> str:
+def preflight(timeout_s: int = 150) -> str:
     """Probe backend init in a subprocess so a hung tunnel cannot wedge the
     bench itself (round-1 failure mode: BENCH_r01 died 40 frames deep in
     device_put when the axon backend was down).  Also rejects a silent CPU
     fallback — a CPU run of the chairs config takes minutes per step and
     would poison the scoreboard; set RAFT_BENCH_ALLOW_CPU=1 to bench on
-    CPU deliberately.  Returns the probed platform name."""
+    CPU deliberately.  Returns the probed platform name.
+
+    Patient retry (round-2 verdict item 1a): the tunnel wedges and
+    recovers on minute scales, so a scoreboard artifact should not give
+    up after one probe window.  Re-probes every ~2.5 min until
+    RAFT_BENCH_RETRY_MINUTES (default 25) has elapsed; set it to 0 to
+    restore single-shot behavior."""
+    retry_min = float(os.environ.get("RAFT_BENCH_RETRY_MINUTES", "25"))
+    deadline = time.monotonic() + retry_min * 60
     # ensure_platform: an explicit JAX_PLATFORMS=cpu must actually take
     # effect in the probe (the env var alone does not beat the image's
     # pinned axon plugin — utils/platform.py)
@@ -73,9 +86,15 @@ def preflight(attempts: int = 2, timeout_s: int = 150) -> str:
             "import jax; d = jax.devices()[0]; "
             "print(d.platform, '|', d.device_kind)")
     last = ""
-    for i in range(attempts):
-        if i:
-            time.sleep(20)
+    attempt = 0
+    while True:
+        if attempt:
+            if time.monotonic() >= deadline:
+                break
+            print(f"bench preflight: backend not up ({last}); retrying "
+                  f"(attempt {attempt + 1})", file=sys.stderr)
+            time.sleep(150)
+        attempt += 1
         try:
             # cwd pinned to the repo root: the probe imports raft_tpu,
             # which is not pip-installed
@@ -180,7 +199,7 @@ def main():
     # corr_dtype=bfloat16 halves the volume traffic and runs the lookup
     # matmuls at full MXU rate (f32 accumulation; ~0.5% relative error).
     cfg = dataclasses.replace(preset.model, corr_dtype="bfloat16")
-    deferred = True
+    deferred = cfg.deferred_corr_grad
 
     def build(cfg):
         model = RAFT(cfg)
@@ -257,6 +276,9 @@ def main():
             state, metrics = step(state, next(it))
         float(metrics["loss"])
         fed_pairs_per_s = B * n_fed / (time.perf_counter() - t0)
+        it.close()  # join the loader's worker pool cleanly (an abandoned
+        # generator otherwise tears down its executor at interpreter
+        # exit, after threading internals are gone)
     except Exception as e:  # the fed lane must never sink the scoreboard
         print(f"fed bench failed: {type(e).__name__}: {e}", file=sys.stderr)
 
@@ -267,6 +289,7 @@ def main():
         "vs_baseline": round(pairs_per_s / A100_BASELINE_PAIRS_PER_S, 3),
         "mfu": round(mfu, 4),
         "fed_pairs_per_s": round(fed_pairs_per_s, 3),
+        "host_cores": os.cpu_count(),
         "deferred_corr_grad": deferred,
         **({"tiny": True} if tiny else {}),
     }))

@@ -72,10 +72,15 @@ class RAFTConfig:
     # stop_gradient'd pyramid plus a zero per-iteration window bias whose
     # cotangent captures each iteration's d_window; d_pyramid is then
     # rebuilt with ONE stacked contraction per level instead of `iters`
-    # volume-sized accumulate-adds in the backward scan (profiled at
-    # ~26 ms/step of select_add at the chairs config).  Gradients are
+    # volume-sized accumulate-adds in the backward scan.  Gradients are
     # identical (tests/test_model.py, tests/test_torch_parity.py).
-    deferred_corr_grad: bool = True
+    # Default OFF by round-3 on-chip measurement: the rebuild costs MORE
+    # than the select_add chain it replaces on v5e — 262-264 ms/step ON
+    # vs 248-249 OFF at the chairs config, reproduced in two sessions
+    # (docs/ARCHITECTURE.md round-3 table).  The stacked d_win buffer
+    # also adds an HBM transient.  Kept as an option: the reassociation
+    # may still win at configs with much larger volumes per iteration.
+    deferred_corr_grad: bool = False
 
     def __post_init__(self):
         if self.corr_impl not in CORR_IMPLS:

@@ -27,11 +27,27 @@ def main():
     p.add_argument("--image_size", type=int, nargs=2, default=(368, 496))
     p.add_argument("--num_workers", type=int, default=4)
     p.add_argument("--batches", type=int, default=30)
+    p.add_argument("--aug", action="store_true",
+                   help="run the dense augmentor too (the bench fed-lane "
+                        "configuration; measures the full per-sample host "
+                        "cost, not just decode/generation)")
     args = p.parse_args()
 
     from raft_tpu.data import DataLoader, fetch_dataset
 
-    ds = fetch_dataset(args.stage, tuple(args.image_size), root=args.root)
+    if args.aug and args.stage == "synthetic":
+        from raft_tpu.data.datasets import SyntheticShift
+
+        H, W = args.image_size
+        ds = SyntheticShift(
+            image_size=(H + 32, W + 32), length=512,
+            aug_params=dict(crop_size=(H, W), min_scale=0.0, max_scale=0.2,
+                            do_flip=True))
+    else:
+        ds = fetch_dataset(args.stage, tuple(args.image_size),
+                           root=args.root)
+        if args.aug:
+            sys.exit("--aug is only wired for --stage synthetic")
     loader = DataLoader(ds, args.batch_size, num_workers=args.num_workers)
     if len(loader) == 0:
         sys.exit(f"dataset too small: {len(ds)} samples < batch_size "

@@ -3,7 +3,9 @@ batch 8, 368x496, 12 iters) to guide optimization.  Not part of the test
 suite; run on the real chip:  python scripts/perf_probe.py [variant ...]
 
 Variants: current, alt_pallas, alt_lax, alt_chunked, no_remat_policy,
-no_deferred_grad, convs_saved, fwd_only
+no_deferred_grad, convs_saved, corr_f32, fwd_only, and the
+things-config gradient-accumulation sweep things_accum{1,2,3} (400x720,
+batch 6 — train_standard.sh:4's high-res stage inside one chip's HBM).
 """
 
 import os
@@ -32,7 +34,7 @@ def make_batch(B=None, H=None, W=None):
     }
 
 
-def time_step(cfg, batch, iters=12, n=10, fwd_only=False):
+def time_step(cfg, batch, iters=12, n=10, fwd_only=False, accum_steps=1):
     import jax
     from raft_tpu.models import RAFT
     from raft_tpu.training import create_train_state, make_optimizer
@@ -61,7 +63,7 @@ def time_step(cfg, batch, iters=12, n=10, fwd_only=False):
         return (time.perf_counter() - t0) / n, -1
 
     step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0,
-                           donate=True)
+                           donate=True, accum_steps=accum_steps)
     state, m = step(state, batch); float(m["loss"])
     t0 = time.perf_counter()
     for _ in range(n):
@@ -104,21 +106,36 @@ def main():
                                              "corr_impl": "chunked"}),
         # NOTE: an nn.scan unroll>1 variant was tried here and wedged the
         # remote XLA compile service for ~45 min at the chairs config —
-        # don't re-add without a compile-time budget.
+        # don't re-add without a compile-time budget.  alt_lax's TRAIN
+        # step also fails remote compile at this config (HTTP 500 from
+        # the compile helper; the gather-based backward is huge) — the
+        # oracle is for correctness tests, not training.
         "no_remat_policy": lambda: RAFTConfig(**{**base, "remat_policy": ""}),
         "no_deferred_grad": lambda: RAFTConfig(
             **{**base, "deferred_corr_grad": False}),
         "convs_saved": lambda: RAFTConfig(
             **{**base, "remat_policy": "convs_and_dots_saveable"}),
+        "corr_f32": lambda: RAFTConfig(**{**base, "corr_dtype": "float32"}),
         "fwd_only": lambda: RAFTConfig(**base),
+        # things-config accumulation sweep (batch 6 at 400x720,
+        # train_standard.sh:4): accum N trades step time for activation
+        # memory; the HBM column says which N the chip actually needs
+        "things_accum1": lambda: RAFTConfig(**base),
+        "things_accum2": lambda: RAFTConfig(**base),
+        "things_accum3": lambda: RAFTConfig(**base),
     }
     want = sys.argv[1:] or ["current", "alt_pallas", "fwd_only"]
-    batch = make_batch()
-    B = batch["image1"].shape[0]
+    chairs_batch = make_batch()
+    things_batch = (make_batch(B=6, H=400, W=720)
+                    if any(w.startswith("things_") for w in want) else None)
     for i, name in enumerate(want):
         cfg = variants[name]()
+        batch = things_batch if name.startswith("things_") else chairs_batch
+        B = batch["image1"].shape[0]
+        accum = int(name[-1]) if name.startswith("things_accum") else 1
         try:
-            dt, peak = time_step(cfg, batch, fwd_only=(name == "fwd_only"))
+            dt, peak = time_step(cfg, batch, fwd_only=(name == "fwd_only"),
+                                 accum_steps=accum)
             hbm = ""
             if peak > 0:
                 # the allocator peak is monotone per process: clean for

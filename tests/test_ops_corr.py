@@ -162,6 +162,37 @@ def test_direct_pyramid_equals_pooled_volume():
                                    atol=0.02 * scale)
 
 
+@pytest.mark.slow
+def test_bf16_corr_error_budget_realistic_scale():
+    """End-to-end bf16 corr path (bf16 direct pyramid + bf16 lookup
+    contractions, f32 accumulation — exactly cfg.corr_dtype="bfloat16")
+    at the chairs config's REAL channel width and fmap scale (C=256,
+    46x62 = 368x496/8).  The toy-scale test above cannot bound the
+    realistic error: input-rounding error grows with contraction length
+    (C) and value magnitude with sqrt(C) (round-2 verdict item 7).
+    Budget: max |err| <= 1% of the volume's max, rms <= 0.2%."""
+    from raft_tpu.ops.corr import build_corr_pyramid_direct
+
+    B, H, W, C = 1, 46, 62, 256
+    rng = np.random.default_rng(7)
+    f1 = jnp.asarray(rng.standard_normal((B, H, W, C)).astype(np.float32))
+    f2 = jnp.asarray(rng.standard_normal((B, H, W, C)).astype(np.float32))
+    base = np.stack(np.meshgrid(np.arange(W), np.arange(H)), -1)
+    coords = jnp.asarray((base[None] + rng.uniform(-8, 8, (B, H, W, 2)))
+                         .astype(np.float32))
+
+    ref = np.asarray(corr_lookup(
+        build_corr_pyramid_direct(f1, f2, 4, dtype=jnp.float32), coords, 4))
+    got = np.asarray(corr_lookup(
+        build_corr_pyramid_direct(f1, f2, 4, dtype=jnp.bfloat16), coords, 4))
+    assert got.dtype == np.float32
+    scale = np.abs(ref).max()
+    err = np.abs(got - ref)
+    assert err.max() <= 0.01 * scale, (err.max(), scale)
+    assert np.sqrt((err ** 2).mean()) <= 0.002 * scale, (
+        np.sqrt((err ** 2).mean()), scale)
+
+
 def test_chunked_equals_oracle_forward_and_grad():
     """chunked_corr_lookup (query-chunked matmul rows + one-hot windows)
     must match the gather-based oracle in value AND in d_fmap1/d_fmap2
