@@ -185,9 +185,13 @@ def main():
         B, H, W, iters = 1, 64, 64, 2
 
     rng = np.random.default_rng(0)
+    # Images are uint8 — the dtype the host pipeline now ships (see
+    # FlowDataset._pack), so the ONE compiled executable serves both the
+    # device lane and the fed lane (a dtype mismatch would make the fed
+    # lane silently recompile or fail against the lowered executable).
     batch = {
-        "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32)),
-        "image2": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.float32)),
+        "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.uint8)),
+        "image2": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.uint8)),
         "flow": jnp.asarray((rng.standard_normal((B, H, W, 2)) * 5).astype(np.float32)),
         "valid": jnp.ones((B, H, W), np.float32),
     }
@@ -237,7 +241,10 @@ def main():
     try:
         step, state, flops_per_step = build(cfg)
     except Exception as e:
-        if not _is_oom(e):
+        if not _is_oom(e) or not deferred:
+            # Not an OOM, or the fallback config IS the current config
+            # (deferred already off) — retrying identically would just
+            # fail again; propagate so _fail protects the scoreboard.
             raise
         # Protect the scoreboard: if the deferred-grad path blows HBM on
         # this chip (its stacked d_win buffer is the config's dominant

@@ -111,11 +111,11 @@ class RAFTConfig:
             raise ValueError(
                 "corr_shard_impl='ring' requires corr_shard=True — "
                 "without it the ring construction is silently skipped")
-        if self.alternate_corr and self.corr_dtype != "float32":
-            raise ValueError(
-                "corr_dtype applies to the materialized all-pairs pyramid; "
-                "the on-demand (alternate_corr) path computes from float32 "
-                "fmap pyramids and would silently ignore it")
+        # corr_dtype applies to BOTH corr paths since round 4: the
+        # all-pairs pyramid's storage/contraction dtype, and the
+        # on-demand path's feature-block dtype (models/raft.py casts the
+        # fmap pyramid; the Pallas kernels and chunked lookups contract
+        # bf16 blocks at full MXU rate with f32 accumulation).
         if self.remat_policy and self.remat_policy != "convs_and_dots_saveable":
             import jax
 

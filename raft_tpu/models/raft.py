@@ -157,16 +157,39 @@ class RAFT(nn.Module):
                                 dtype=dtype, train=train,
                                 norm_train=norm_train, name="cnet")
 
-        fmaps = fnet(jnp.concatenate([image1, image2], axis=0).astype(dtype))
+        # Pin the encoder path to batch-over-'data' sharding (replicated
+        # over 'spatial').  Without the pins, GSPMD auto-shards the 2B
+        # activations batch-8-way and then meets the corr pyramid's
+        # (data, spatial) constraint — an "involuntary full
+        # rematerialization" reshard (replicate + repartition) on every
+        # step (round-3 MULTICHIP gate finding).  constrain() no-ops
+        # without an ambient mesh, so the single-chip path is untouched.
+        batch_p = P(DATA_AXIS, None, None, None)
+        x2b = constrain(jnp.concatenate([image1, image2], axis=0)
+                        .astype(dtype), batch_p)
+        fmaps = constrain(fnet(x2b), batch_p)
         fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
-        # Correlation in float32 (raft.py:102-103, corr.py:50).
-        fmap1 = fmap1.astype(jnp.float32)
-        fmap2 = fmap2.astype(jnp.float32)
+        # Correlation in float32 (raft.py:102-103, corr.py:50).  The
+        # post-split constraints matter for the BACKWARD: a sharding
+        # constraint transposes to the same constraint on the cotangent,
+        # so d_fmap1/d_fmap2 (arriving (data, spatial)-sharded from the
+        # pyramid constraints) are re-pinned to batch-over-'data' BEFORE
+        # the split's cotangent concatenate — without them GSPMD falls
+        # back to replicate-then-repartition there (round-4 finding,
+        # same class as the round-3 fnet one).
+        fmap1 = constrain(fmap1.astype(jnp.float32), batch_p)
+        fmap2 = constrain(fmap2.astype(jnp.float32), batch_p)
 
         corr_dt = jnp.bfloat16 if cfg.corr_dtype == "bfloat16" else jnp.float32
         if cfg.alternate_corr:
-            corr_state = (fmap1, tuple(build_fmap_pyramid(fmap2,
-                                                          cfg.corr_levels)))
+            # The corr_dtype policy applies to the on-demand path too:
+            # bf16 feature blocks contract at full MXU rate inside the
+            # Pallas kernels / chunked matmuls (f32 accumulation), and
+            # halve the per-iteration fmap HBM reads.  Pooling stays f32
+            # (see build_corr_pyramid_direct) — the cast happens after.
+            corr_state = (fmap1.astype(corr_dt),
+                          tuple(p.astype(corr_dt) for p in
+                                build_fmap_pyramid(fmap2, cfg.corr_levels)))
         elif cfg.corr_shard and cfg.corr_shard_impl == "ring":
             # Explicit ring construction over the ambient mesh
             # (parallel/ring.py): fmap2 shards rotate via ppermute, the
@@ -192,7 +215,8 @@ class RAFT(nn.Module):
             corr_state = tuple(pyramid)
 
         # Context network on image1 only; split into GRU state + input.
-        ctx = cnet(image1.astype(dtype))
+        ctx = constrain(cnet(constrain(image1.astype(dtype), batch_p)),
+                        batch_p)
         net, inp = jnp.split(ctx, [hdim], axis=-1)
         net = jnp.tanh(net)
         inp = nn.relu(inp)

@@ -132,6 +132,15 @@ def _window_offsets(radius: int, dtype=jnp.float32) -> jax.Array:
     return jnp.stack([dx, dy], axis=-1).reshape(-1, 2)
 
 
+def feature_dtype(x: jax.Array):
+    """The corr_dtype policy's contraction dtype for feature blocks:
+    bf16 features contract at full MXU rate (callers always request f32
+    accumulation via preferred_element_type); anything else runs f32.
+    Single source of truth for the on-demand paths (chunked + both
+    Pallas directions) — a policy change must not diverge them."""
+    return jnp.bfloat16 if x.dtype == jnp.bfloat16 else jnp.float32
+
+
 def onehot_lerp_weights(coord: jax.Array, radius: int,
                         extent: int) -> jax.Array:
     """Bilinear-weighted one-hot gather matrix along one axis.
@@ -353,7 +362,8 @@ def chunked_corr_lookup(fmap1: jax.Array, fmap2_pyramid: Sequence[jax.Array],
     nc = -(-Q // chunk)
     pad = nc * chunk - Q
 
-    f1 = fmap1.astype(jnp.float32).reshape(B, Q, C)
+    fdt = feature_dtype(fmap1)
+    f1 = fmap1.astype(fdt).reshape(B, Q, C)
     cx = coords[..., 0].reshape(B, Q).astype(jnp.float32)
     cy = coords[..., 1].reshape(B, Q).astype(jnp.float32)
     if pad:
@@ -365,7 +375,7 @@ def chunked_corr_lookup(fmap1: jax.Array, fmap2_pyramid: Sequence[jax.Array],
         x = x.reshape((B, nc, chunk) + x.shape[2:])
         return jnp.moveaxis(x, 1, 0)
 
-    f2s = [f2.astype(jnp.float32) for f2 in fmap2_pyramid]
+    f2s = [f2.astype(fdt) for f2 in fmap2_pyramid]
 
     def one_chunk(args):
         f1_c, cx_c, cy_c = args              # (B, chunk, C), (B, chunk) x2

@@ -39,16 +39,25 @@ Design (TPU-first, not a CUDA translation):
 - The backward pass is a hand-written VJP (the CUDA backward exists at
   correlation_kernel.cu:123-256 but is dead code — the Python side never
   wraps it in an autograd.Function, so the reference's on-demand path is
-  inference-only; here gradients are a first-class capability).
-  d(coords) is zero by design, matching both the reference's dead
-  coords_grad (correlation_kernel.cu:307) and the model's per-iteration
-  stop_gradient on coords (core/raft.py:123).
+  inference-only; here gradients are a first-class capability).  Two
+  implementations: fused Pallas kernels with the forward's blocked
+  tiling and block-skip (default; the effective weight image M never
+  touches HBM) and the XLA einsum chain (``RAFT_PALLAS_BWD=xla``), kept
+  as the tested oracle.  d(coords) is zero by design, matching both the
+  reference's dead coords_grad (correlation_kernel.cu:307) and the
+  model's per-iteration stop_gradient on coords (core/raft.py:123).
 
 VMEM budget per grid step (fp32): a double-buffered (t_tile, C) fmap2
-block plus the (q_tile, k1, t_tile) weight/product slabs — about 8 MB at
-(q_tile=128, t_tile=512, C=256, r=4), independent of resolution (larger
-images add grid steps, not VMEM).  ``_pick_q_tile`` sizes the tile to
-the budget.
+block plus the (q_tile, k1, t_tile) weight/product slabs.  At
+(t_tile=512, C=256, r=4) each query costs ~116 KB (three 32 KB
+wx/wy/product slabs at k1 padded to 16, plus the corr row and output),
+so ``_pick_q_tile`` selects q_tile=64 (~7.3 MB slabs + ~1 MB fmap2
+double-buffer) against its 12 MB working budget; q_tile=128 would need
+~14.5 MB and is rejected.  The estimate deliberately excludes the
+elementwise (q, k1, t_tile) iota/xt/yt temporaries Mosaic materializes
+alongside the slabs — the 12-of-16 MB budget is the headroom for them.
+VMEM use is independent of resolution (larger images add grid steps,
+not VMEM).
 """
 
 from __future__ import annotations
@@ -62,11 +71,51 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu.ops.corr import onehot_lerp_weights
+from raft_tpu.ops.corr import feature_dtype, onehot_lerp_weights
+
+
+def _flatten_pad_targets(f2: jax.Array):
+    """Row-major flatten one pyramid level to (B, T, C) and zero-pad the
+    tail to whole t_tile blocks (padded rows contribute zero through the
+    correlation).  Shared by the forward and both backward kernels — the
+    tile rule must never diverge between directions.
+
+    Returns (f2x, t_tile, nt)."""
+    B, H2, W2, C = f2.shape
+    T = H2 * W2
+    t_tile = min(512, ((T + 127) // 128) * 128)
+    nt = -(-T // t_tile)
+    f2x = f2.reshape(B, T, C)
+    if nt * t_tile != T:
+        f2x = jnp.pad(f2x, ((0, 0), (0, nt * t_tile - T), (0, 0)))
+    return f2x, t_tile, nt
 
 
 def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
+
+
+def _precision_for(dtype):
+    """bf16 inputs run the MXU at full rate (f32 accumulation is always
+    requested via preferred_element_type); f32 inputs keep HIGHEST so the
+    kernel stays bit-comparable to the f32 oracle in the parity tests."""
+    return (jax.lax.Precision.DEFAULT if dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+
+
+def _block_intersects(cy_ref, radius: int, w2: int, t0, t_span):
+    """Does the flat-target range [t0, t0 + t_span) intersect ANY query's
+    bilinear window?  Row-major flattening means the window's target rows
+    [floor(min cy) - r, floor(max cy) + r + 1] map to the flat range
+    [ymin*w2, (ymax+1)*w2) — one scalar test per grid step that lets the
+    kernel skip its weight slabs and matmuls for the (typically ~90% of)
+    target blocks no window touches.  Queries whose coords sit anywhere
+    still get exact results: the skip bound is conservative (min/max over
+    the whole query block)."""
+    cy = cy_ref[...]
+    ymin = jnp.floor(jnp.min(cy)) - radius
+    ymax = jnp.floor(jnp.max(cy)) + radius + 1.0
+    return jnp.logical_and(t0 < (ymax + 1.0) * w2, t0 + t_span > ymin * w2)
 
 
 def _blocked_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref,
@@ -93,6 +142,12 @@ def _blocked_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref,
     reproducing bilinear_sampler's zero OOB padding (utils.py:61-65);
     zero-padded target tail blocks contribute zero through corr.
 
+    Round-4 additions: (a) the whole body runs under a window/target-
+    block intersection test (``_block_intersects``) — only blocks a
+    query window can actually touch pay the weight-slab + matmul cost;
+    (b) bf16 feature blocks contract at full MXU rate (f32 accumulation)
+    instead of the f32 HIGHEST 6-pass path.
+
     f1_ref: (1, q_tile, C); f2_ref: (1, t_tile, C) — flat target block;
     cx/cy_ref: (q_tile, 1); out_ref: (1, q_tile, k1, k1), accumulated
     across the sequential t grid axis.
@@ -101,49 +156,53 @@ def _blocked_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref,
     k1 = 2 * r + 1
     c_dim = f1_ref.shape[-1]
     scale = 1.0 / (c_dim ** 0.5)
+    prec = _precision_for(f1_ref.dtype)
     tb = pl.program_id(2)
 
     @pl.when(tb == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    # MXU: correlation rows of these queries against this target block,
-    # f32 accumulation (parity with corr.py:50's .float()).
-    corr = jax.lax.dot_general(
-        f1_ref[0], f2_ref[0],
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST) * scale     # (q, t_tile)
+    t0f = (tb * t_tile).astype(jnp.float32)
 
-    # Flat target coordinates of this block, broadcast to (q, k1, t_tile).
-    # Mosaic's iota is integer-only; convert after.
-    t0 = (tb * t_tile).astype(jnp.float32)
-    s = jax.lax.broadcasted_iota(
-        jnp.int32, (q_tile, k1, t_tile), 2).astype(jnp.float32) + t0
-    yt = jnp.floor((s + 0.5) * (1.0 / w2))
-    xt = s - yt * w2
-    kk = jax.lax.broadcasted_iota(
-        jnp.int32, (q_tile, k1, t_tile), 1).astype(jnp.float32)
+    @pl.when(_block_intersects(cy_ref, r, w2, t0f, float(t_tile)))
+    def _body():
+        # MXU: correlation rows of these queries against this target
+        # block, f32 accumulation (parity with corr.py:50's .float()).
+        corr = jax.lax.dot_general(
+            f1_ref[0], f2_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale                      # (q, t_tile)
 
-    cx = cx_ref[...][:, :, None]                         # (q, 1, 1)
-    cy = cy_ref[...][:, :, None]
-    x0 = jnp.floor(cx)
-    y0 = jnp.floor(cy)
-    fx = cx - x0
-    fy = cy - y0
-    bx = x0 - r + kk
-    by = y0 - r + kk
-    wx = ((xt == bx).astype(jnp.float32) * (1.0 - fx)
-          + (xt == bx + 1.0).astype(jnp.float32) * fx)   # (q, kx, s)
-    wy = ((yt == by).astype(jnp.float32) * (1.0 - fy)
-          + (yt == by + 1.0).astype(jnp.float32) * fy)   # (q, ky, s)
+        # Flat target coordinates of this block, broadcast to
+        # (q, k1, t_tile).  Mosaic's iota is integer-only; convert after.
+        s = jax.lax.broadcasted_iota(
+            jnp.int32, (q_tile, k1, t_tile), 2).astype(jnp.float32) + t0f
+        yt = jnp.floor((s + 0.5) * (1.0 / w2))
+        xt = s - yt * w2
+        kk = jax.lax.broadcasted_iota(
+            jnp.int32, (q_tile, k1, t_tile), 1).astype(jnp.float32)
 
-    # out[q, kx, ky] += sum_s (corr*wx)[q, kx, s] * wy[q, ky, s]
-    out_ref[0] += jax.lax.dot_general(
-        corr[:, None, :] * wx, wy,
-        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST)             # (q, k1, k1)
+        cx = cx_ref[...][:, :, None]                     # (q, 1, 1)
+        cy = cy_ref[...][:, :, None]
+        x0 = jnp.floor(cx)
+        y0 = jnp.floor(cy)
+        fx = cx - x0
+        fy = cy - y0
+        bx = x0 - r + kk
+        by = y0 - r + kk
+        wx = ((xt == bx).astype(jnp.float32) * (1.0 - fx)
+              + (xt == bx + 1.0).astype(jnp.float32) * fx)  # (q, kx, s)
+        wy = ((yt == by).astype(jnp.float32) * (1.0 - fy)
+              + (yt == by + 1.0).astype(jnp.float32) * fy)  # (q, ky, s)
+
+        # out[q, kx, ky] += sum_s (corr*wx)[q, kx, s] * wy[q, ky, s]
+        out_ref[0] += jax.lax.dot_general(
+            corr[:, None, :] * wx, wy,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+            precision=prec)                              # (q, k1, k1)
 
 
 def _lookup_level_blocked(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
@@ -163,14 +222,7 @@ def _lookup_level_blocked(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
     H2, W2 = f2.shape[1], f2.shape[2]
     r = radius
     k1 = 2 * r + 1
-    T = H2 * W2
-    # natural row-major target flattening: t = y*W2 + x, zero-padded to a
-    # whole number of t_tile blocks (padded tail => corr rows of zero)
-    t_tile = min(512, ((T + 127) // 128) * 128)
-    nt = -(-T // t_tile)
-    f2x = f2.reshape(B, T, C)
-    if nt * t_tile != T:
-        f2x = jnp.pad(f2x, ((0, 0), (0, nt * t_tile - T), (0, 0)))
+    f2x, t_tile, nt = _flatten_pad_targets(f2)
     nqb = NQ // q_tile
     cx_col = cx.reshape(B * NQ, 1)
     cy_col = cy.reshape(B * NQ, 1)
@@ -224,6 +276,7 @@ def _rowloop_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, rx_ref,
     k1 = 2 * r + 1
     c_dim = f1_ref.shape[-1]
     scale = 1.0 / (c_dim ** 0.5)
+    prec = _precision_for(f1_ref.dtype)
     y = pl.program_id(2)
 
     @pl.when(y == 0)
@@ -231,31 +284,40 @@ def _rowloop_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, rx_ref,
         out_ref[...] = jnp.zeros_like(out_ref)
         rx_ref[...] = onehot_lerp_weights(cx_ref[...], r, w2)
 
-    # correlation against this target row: (q, W2)
-    corr_y = jax.lax.dot_general(
-        f1_ref[0], f2_ref[0, 0],
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST) * scale
+    # Row-skip: target row y only matters if some query's window spans it
+    # ([floor(cy)-r, floor(cy)+r+1] in rows).
+    cy_all = cy_ref[...]
+    row_lo = jnp.floor(jnp.min(cy_all)) - r
+    row_hi = jnp.floor(jnp.max(cy_all)) + r + 1.0
+    yf = y.astype(jnp.float32)
 
-    # x-direction window weights: (q, k1, W2) -> s[q, kx]
-    s = jax.lax.dot_general(
-        rx_ref[...], corr_y,
-        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST)                # (q, k1)
+    @pl.when(jnp.logical_and(yf >= row_lo, yf <= row_hi))
+    def _body():
+        # correlation against this target row: (q, W2)
+        corr_y = jax.lax.dot_general(
+            f1_ref[0], f2_ref[0, 0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale
 
-    # y-direction bilinear weight of THIS row for each query's ky taps:
-    # wy[q, ky] = (1-f)*[y == i0-r+ky] + f*[y == i0-r+ky+1]
-    cy = cy_ref[...]                                        # (q, 1)
-    i0 = jnp.floor(cy)
-    f = cy - i0                                             # (q, 1)
-    kk = jax.lax.broadcasted_iota(jnp.int32, (q_tile, k1), 1)
-    base = i0.astype(jnp.int32) - r + kk                    # (q, k1)
-    wy = ((base == y).astype(jnp.float32) * (1.0 - f)
-          + (base + 1 == y).astype(jnp.float32) * f)        # (q, k1)
+        # x-direction window weights: (q, k1, W2) -> s[q, kx]
+        s = jax.lax.dot_general(
+            rx_ref[...], corr_y,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)            # (q, k1)
 
-    out_ref[0] += s[:, :, None] * wy[:, None, :]            # (q, kx, ky)
+        # y-direction bilinear weight of THIS row for each query's ky
+        # taps: wy[q, ky] = (1-f)*[y == i0-r+ky] + f*[y == i0-r+ky+1]
+        cy = cy_ref[...]                                    # (q, 1)
+        i0 = jnp.floor(cy)
+        f = cy - i0                                         # (q, 1)
+        kk = jax.lax.broadcasted_iota(jnp.int32, (q_tile, k1), 1)
+        base = i0.astype(jnp.int32) - r + kk                # (q, k1)
+        wy = ((base == y).astype(jnp.float32) * (1.0 - f)
+              + (base + 1 == y).astype(jnp.float32) * f)    # (q, k1)
+
+        out_ref[0] += s[:, :, None] * wy[:, None, :]        # (q, kx, ky)
 
 
 def _lookup_level_rowloop(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
@@ -293,6 +355,165 @@ def _lookup_level_rowloop(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
         ],
         interpret=interpret,
     )(f1q, f2, cx_col, cy_col)
+
+
+def _m_block(g_ref, cx_ref, cy_ref, *, radius: int, w2: int,
+             q_tile: int, t_tile: int, t0f):
+    """The effective per-query weight image of one target block,
+
+        M[q, t] = sum_{kx,ky} g[q,kx,ky] * wx[q,kx,t] * wy[q,ky,t],
+
+    built with the same flat-index iota arithmetic as the forward kernel
+    (no lane reshapes).  The ky contraction is an unrolled k1-step
+    multiply-reduce — k1 = 2r+1 = 9 is far below MXU-efficient K, so VPU
+    multiply-adds beat a degenerate batched matmul.  Shared by both
+    backward kernels.
+    """
+    r = radius
+    k1 = 2 * r + 1
+    s = jax.lax.broadcasted_iota(
+        jnp.int32, (q_tile, k1, t_tile), 2).astype(jnp.float32) + t0f
+    yt = jnp.floor((s + 0.5) * (1.0 / w2))
+    xt = s - yt * w2
+    kk = jax.lax.broadcasted_iota(
+        jnp.int32, (q_tile, k1, t_tile), 1).astype(jnp.float32)
+
+    cx = cx_ref[...][:, :, None]
+    cy = cy_ref[...][:, :, None]
+    x0 = jnp.floor(cx)
+    y0 = jnp.floor(cy)
+    fx = cx - x0
+    fy = cy - y0
+    bx = x0 - r + kk
+    by = y0 - r + kk
+    wx = ((xt == bx).astype(jnp.float32) * (1.0 - fx)
+          + (xt == bx + 1.0).astype(jnp.float32) * fx)   # (q, kx, t)
+    wy = ((yt == by).astype(jnp.float32) * (1.0 - fy)
+          + (yt == by + 1.0).astype(jnp.float32) * fy)   # (q, ky, t)
+
+    g = g_ref[0]                                         # (q, kx, ky)
+    m = jnp.zeros((q_tile, t_tile), jnp.float32)
+    for ky in range(k1):
+        b_ky = jnp.sum(g[:, :, ky][:, :, None] * wx, axis=1)  # (q, t)
+        m = m + b_ky * wy[:, ky, :]
+    return m
+
+
+def _bwd_df1_kernel(f2_ref, cx_ref, cy_ref, g_ref, out_ref,
+                    *, radius: int, w2: int, q_tile: int, t_tile: int):
+    """d_f1[q, :] = scale * sum_t M[q, t] * f2[t, :], accumulated over
+    the sequential target-block grid axis.  Grid (B, nqb, nt)."""
+    tb = pl.program_id(2)
+
+    @pl.when(tb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    t0f = (tb * t_tile).astype(jnp.float32)
+
+    @pl.when(_block_intersects(cy_ref, radius, w2, t0f, float(t_tile)))
+    def _body():
+        m = _m_block(g_ref, cx_ref, cy_ref, radius=radius, w2=w2,
+                     q_tile=q_tile, t_tile=t_tile, t0f=t0f)
+        f2 = f2_ref[0]
+        out_ref[0] += jax.lax.dot_general(
+            m.astype(f2.dtype), f2,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_precision_for(f2.dtype))          # (q, C)
+
+
+def _bwd_df2_kernel(f1_ref, cx_ref, cy_ref, g_ref, out_ref,
+                    *, radius: int, w2: int, q_tile: int, t_tile: int):
+    """d_f2[t, :] = scale * sum_q M[q, t] * f1[q, :], accumulated over
+    the sequential QUERY-block grid axis.  Grid (B, nt, nqb) — the
+    target block is pinned while query blocks sweep, so the output
+    window accumulates without revisits."""
+    qb = pl.program_id(2)
+    tb = pl.program_id(1)
+
+    @pl.when(qb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    t0f = (tb * t_tile).astype(jnp.float32)
+
+    @pl.when(_block_intersects(cy_ref, radius, w2, t0f, float(t_tile)))
+    def _body():
+        m = _m_block(g_ref, cx_ref, cy_ref, radius=radius, w2=w2,
+                     q_tile=q_tile, t_tile=t_tile, t0f=t0f)
+        f1 = f1_ref[0]
+        out_ref[0] += jax.lax.dot_general(
+            m.astype(f1.dtype), f1,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_precision_for(f1.dtype))          # (t, C)
+
+
+def _bwd_level_pallas(f1q, f2, cxl, cyl, gl, radius: int, q_tile: int,
+                      interpret: bool):
+    """Fused backward for one pyramid level.
+
+    Args:
+      f1q: (B, NQ, C) padded query features (forward layout).
+      f2:  (B, H2, W2, C) target features.
+      cxl, cyl: (B, NQ) level-scaled coords (edge-padded like forward).
+      gl: (B, NQ, k1, k1) windowed cotangent, zero-padded, pre-scaled.
+
+    Returns (d_f1q (B, NQ, C) f32, d_f2 (B, H2, W2, C) f32).
+    """
+    B, NQ, C = f1q.shape
+    H2, W2 = f2.shape[1], f2.shape[2]
+    k1 = 2 * radius + 1
+    T = H2 * W2
+    f2x, t_tile, nt = _flatten_pad_targets(f2)
+    nqb = NQ // q_tile
+    cx_col = cxl.reshape(B * NQ, 1)
+    cy_col = cyl.reshape(B * NQ, 1)
+
+    df1 = pl.pallas_call(
+        functools.partial(_bwd_df1_kernel, radius=radius, w2=W2,
+                          q_tile=q_tile, t_tile=t_tile),
+        grid=(B, nqb, nt),
+        in_specs=[
+            pl.BlockSpec((1, t_tile, C), lambda b, qb, tb: (b, tb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, 1), lambda b, qb, tb: (b * nqb + qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, 1), lambda b, qb, tb: (b * nqb + qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, q_tile, k1, k1),
+                         lambda b, qb, tb: (b, qb, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, q_tile, C), lambda b, qb, tb: (b, qb, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, NQ, C), jnp.float32),
+        interpret=interpret,
+    )(f2x, cx_col, cy_col, gl)
+
+    df2 = pl.pallas_call(
+        functools.partial(_bwd_df2_kernel, radius=radius, w2=W2,
+                          q_tile=q_tile, t_tile=t_tile),
+        grid=(B, nt, nqb),
+        in_specs=[
+            pl.BlockSpec((1, q_tile, C), lambda b, tb, qb: (b, qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, 1), lambda b, tb, qb: (b * nqb + qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, 1), lambda b, tb, qb: (b * nqb + qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, q_tile, k1, k1),
+                         lambda b, tb, qb: (b, qb, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, t_tile, C), lambda b, tb, qb: (b, tb, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, nt * t_tile, C), jnp.float32),
+        interpret=interpret,
+    )(f1q, cx_col, cy_col, gl)
+
+    return df1, df2[:, :T].reshape(B, H2, W2, C)
 
 
 def _pick_q_tile(T: int, C: int, radius: int) -> int:
@@ -365,18 +586,22 @@ def _forward(fmap1: jax.Array, fmap2_pyramid: Tuple[jax.Array, ...],
     pad = nq - Q
     interpret = not _on_tpu()
 
-    f1q = fmap1.astype(jnp.float32).reshape(B, Q, C)
+    fdt = feature_dtype(fmap1)
+    f1q = fmap1.astype(fdt).reshape(B, Q, C)
     cx = coords[..., 0].reshape(B, Q).astype(jnp.float32)
     cy = coords[..., 1].reshape(B, Q).astype(jnp.float32)
     if pad:
         f1q = jnp.pad(f1q, ((0, 0), (0, pad), (0, 0)))
-        cx = jnp.pad(cx, ((0, 0), (0, pad)))
-        cy = jnp.pad(cy, ((0, 0), (0, pad)))
+        # edge-pad the coords (not zero-pad): padded queries then share
+        # the last real query's window, so they never widen the min/max
+        # coord range the kernels' block-skip test is built from
+        cx = jnp.pad(cx, ((0, 0), (0, pad)), mode="edge")
+        cy = jnp.pad(cy, ((0, 0), (0, pad)), mode="edge")
 
     k = (2 * radius + 1) ** 2
     out = []
     for i, f2 in enumerate(fmap2_pyramid):
-        win = level_fn(f1q, f2.astype(jnp.float32),
+        win = level_fn(f1q, f2.astype(fdt),
                        cx / (2.0 ** i), cy / (2.0 ** i),
                        radius, q_tile, interpret)
         win = win.reshape(B, nq, k)[:, :Q]
@@ -413,6 +638,68 @@ def _fwd(fmap1, fmap2_pyramid, coords, radius, q_tile):
 
 
 def _bwd(radius, q_tile, residuals, g):
+    """VJP dispatch: the fused Pallas backward (default) or the XLA
+    einsum chain (``RAFT_PALLAS_BWD=xla`` — the conservative fallback,
+    and the oracle the fused path is tested against)."""
+    variant = os.environ.get("RAFT_PALLAS_BWD", "fused")
+    if variant not in ("fused", "xla"):
+        raise ValueError(f"RAFT_PALLAS_BWD must be 'fused' or 'xla', "
+                         f"got {variant!r}")
+    if variant == "fused":
+        return _bwd_fused(radius, q_tile, residuals, g)
+    return _bwd_xla(radius, q_tile, residuals, g)
+
+
+def _bwd_fused(radius, q_tile, residuals, g):
+    """Fused Pallas backward: per level, two kernels with the forward's
+    blocked tiling and block-skip rebuild d_f1 and d_f2 without ever
+    writing the effective weight image M (see ``_m_block``) to HBM —
+    the XLA chain materializes M in ~64 MB chunks per scan step.  The
+    CUDA backward this replaces (correlation_kernel.cu:123-256) does the
+    same accumulation with atomicAdd; here each output block has exactly
+    one writer grid position."""
+    fmap1, fmap2_pyramid, coords = residuals
+    B, H1, W1, C = fmap1.shape
+    Q = H1 * W1
+    r = radius
+    k1 = 2 * r + 1
+    k_win = k1 * k1
+    scale = 1.0 / (C ** 0.5)
+    fdt = feature_dtype(fmap1)
+    interpret = not _on_tpu()
+
+    if q_tile is None:
+        f2l0 = fmap2_pyramid[0]
+        q_tile = _pick_q_tile(f2l0.shape[1] * f2l0.shape[2], C, r)
+    nq = ((Q + q_tile - 1) // q_tile) * q_tile
+    pad = nq - Q
+
+    f1q = fmap1.astype(fdt).reshape(B, Q, C)
+    cx = coords[..., 0].reshape(B, Q).astype(jnp.float32)
+    cy = coords[..., 1].reshape(B, Q).astype(jnp.float32)
+    gq = (g.astype(jnp.float32).reshape(B, Q, -1) * scale)
+    if pad:
+        f1q = jnp.pad(f1q, ((0, 0), (0, pad), (0, 0)))
+        cx = jnp.pad(cx, ((0, 0), (0, pad)), mode="edge")
+        cy = jnp.pad(cy, ((0, 0), (0, pad)), mode="edge")
+        # zero-padded cotangents: padded queries contribute nothing
+        gq = jnp.pad(gq, ((0, 0), (0, pad), (0, 0)))
+
+    d_f1 = jnp.zeros((B, nq, C), jnp.float32)
+    d_f2s = []
+    for i, f2 in enumerate(fmap2_pyramid):
+        gl = gq[..., i * k_win:(i + 1) * k_win].reshape(B, nq, k1, k1)
+        df1_l, df2_l = _bwd_level_pallas(
+            f1q, f2.astype(fdt), cx / (2.0 ** i), cy / (2.0 ** i), gl,
+            r, q_tile, interpret)
+        d_f1 = d_f1 + df1_l
+        d_f2s.append(df2_l.astype(f2.dtype))
+
+    d_fmap1 = d_f1[:, :Q].reshape(B, H1, W1, C).astype(fmap1.dtype)
+    return d_fmap1, tuple(d_f2s), jnp.zeros_like(coords)
+
+
+def _bwd_xla(radius, q_tile, residuals, g):
     """Hand-written VJP, fully matmul-ized (no gathers, no scatters).
 
     For out[q, kx, ky] = scale * sum_c f1[q,c] * sum_{h,w} RY[q,ky,h]

@@ -43,11 +43,13 @@ def main():
             image_size=(H + 32, W + 32), length=512,
             aug_params=dict(crop_size=(H, W), min_scale=0.0, max_scale=0.2,
                             do_flip=True))
+    elif args.aug:
+        # reject the combination before touching the dataset — fetch can
+        # be slow (or error on missing data) and would mask this message
+        sys.exit("--aug is only wired for --stage synthetic")
     else:
         ds = fetch_dataset(args.stage, tuple(args.image_size),
                            root=args.root)
-        if args.aug:
-            sys.exit("--aug is only wired for --stage synthetic")
     loader = DataLoader(ds, args.batch_size, num_workers=args.num_workers)
     if len(loader) == 0:
         sys.exit(f"dataset too small: {len(ds)} samples < batch_size "

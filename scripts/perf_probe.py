@@ -113,6 +113,10 @@ def main():
         "no_remat_policy": lambda: RAFTConfig(**{**base, "remat_policy": ""}),
         "no_deferred_grad": lambda: RAFTConfig(
             **{**base, "deferred_corr_grad": False}),
+        # deferred ON (the non-default since round 3's measurement):
+        # compare against "current" to re-measure the knob on new configs
+        "deferred_grad": lambda: RAFTConfig(
+            **{**base, "deferred_corr_grad": True}),
         "convs_saved": lambda: RAFTConfig(
             **{**base, "remat_policy": "convs_and_dots_saveable"}),
         "corr_f32": lambda: RAFTConfig(**{**base, "corr_dtype": "float32"}),

@@ -13,8 +13,9 @@ Stages:
             vs chunked vs pallas on-demand (time + HBM sanity)
   train   - 60 steps of --stage synthetic on-chip with a mid-run
             checkpoint resume
-  probe   - perf_probe current vs no_deferred_grad (measures the deferred
-            corr-pyramid cotangent's step-time win on real hardware)
+  probe   - perf_probe current vs deferred_grad (re-measures the deferred
+            corr-pyramid cotangent knob on real hardware; OFF is the
+            measured-faster default since round 3)
 """
 
 import os
@@ -73,10 +74,22 @@ def run_highres():
     for name, cfg in [
         ("all_pairs", RAFTConfig(compute_dtype="bfloat16",
                                  corr_dtype="bfloat16")),
+        # bf16 corr applies to the on-demand paths too (round 4): the
+        # kernels/chunks contract bf16 feature blocks at full MXU rate
         ("chunked", RAFTConfig(compute_dtype="bfloat16",
+                               corr_dtype="bfloat16",
                                alternate_corr=True, corr_impl="chunked")),
         ("pallas", RAFTConfig(compute_dtype="bfloat16",
+                              corr_dtype="bfloat16",
                               alternate_corr=True, corr_impl="pallas")),
+        # f32 on-demand rows (the round-3 matchup conditions), for the
+        # bf16-vs-f32 delta in one run
+        ("chunked_f32", RAFTConfig(compute_dtype="bfloat16",
+                                   alternate_corr=True,
+                                   corr_impl="chunked")),
+        ("pallas_f32", RAFTConfig(compute_dtype="bfloat16",
+                                  alternate_corr=True,
+                                  corr_impl="pallas")),
     ]:
         model = RAFT(cfg)
         v = model.init(jax.random.PRNGKey(0), i1, i2, iters=1)
@@ -160,12 +173,16 @@ def run_accuracy():
     frames = os.environ.get("RAFT_ACC_FRAMES",
                             "/root/reference/demo-static")
     root = frames if os.path.isdir(frames) else "datasets"
+    # NOTE: the flag is --datasets_root (round-3 shipped "--root" here,
+    # which argparse rejects — the whole frames-based recipe silently
+    # never ran and the committed artifact came from an older script's
+    # procedural-texture fallback).
     r = subprocess.run(
         [sys.executable, "-m", "raft_tpu.cli.train", "--stage", "synthetic",
          "--mixed_precision", "--corr_dtype", "bfloat16", "--iters", "12",
          "--num_steps", "500", "--checkpoint_dir", ckpt, "--log_dir",
          "/tmp/tpu_val_runs", "--no_tensorboard", "--val_freq", "1000000",
-         "--root", root],
+         "--datasets_root", root],
         cwd=ROOT)
     if r.returncode != 0:
         print("[accuracy] training run FAILED")
@@ -209,7 +226,7 @@ def run_accuracy():
 def run_probe():
     r = subprocess.run(
         [sys.executable, "scripts/perf_probe.py", "current",
-         "no_deferred_grad"], cwd=ROOT)
+         "deferred_grad"], cwd=ROOT)
     print(f"[probe] deferred-vs-plain corr grad: "
           f"{'OK' if r.returncode == 0 else 'FAILED'}")
     return r.returncode == 0

@@ -117,5 +117,6 @@ def test_bench_tiny_smoke(tmp_path):
     assert out["metric"] == "image-pairs/sec/chip"
     assert out["value"] > 0
     assert "mfu" in out and "fed_pairs_per_s" in out
-    assert out["deferred_corr_grad"] is True
+    from raft_tpu.config import RAFTConfig
+    assert out["deferred_corr_grad"] is RAFTConfig().deferred_corr_grad
     assert out["tiny"] is True  # tiny runs must be self-identifying

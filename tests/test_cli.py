@@ -157,6 +157,27 @@ def test_build_config_merges_presets_and_overrides():
     assert train.freeze_bn is True            # preset (post-chairs stage)
 
 
+def test_build_config_defaults_match_preset():
+    """With no override flags, the CLI-built model config must equal the
+    stage preset on EVERY field — including the fields the CLI wires
+    explicitly (small/dropout/deferred_corr_grad/...): their flag
+    defaults must reproduce the preset values, or a config-default flip
+    landed in config.py but not in the CLI (the round-3
+    deferred_corr_grad regression this guards against)."""
+    import dataclasses
+
+    from raft_tpu.cli.train import build_config, parse_args
+    from raft_tpu.config import STAGE_PRESETS
+
+    args = parse_args(["--stage", "chairs", "--mixed_precision"])
+    model, data, train = build_config(args)
+    preset = STAGE_PRESETS["chairs_mixed"].model
+    for f in dataclasses.fields(preset):
+        assert getattr(model, f.name) == getattr(preset, f.name), (
+            f"CLI default for {f.name} diverges from preset: "
+            f"{getattr(model, f.name)!r} != {getattr(preset, f.name)!r}")
+
+
 def test_evaluate_load_variables_roundtrip(small_ckpt):
     from raft_tpu.cli.evaluate import load_variables
     from raft_tpu.config import RAFTConfig

@@ -177,6 +177,61 @@ def test_rowloop_variant_vjp_and_oob(monkeypatch):
     np.testing.assert_array_equal(np.asarray(out), 0.0)
 
 
+def test_bwd_fused_matches_xla_variant(monkeypatch):
+    """The fused Pallas backward (default) and the XLA einsum-chain
+    backward (RAFT_PALLAS_BWD=xla) must produce identical gradients —
+    the chain is the oracle the kernels were derived from."""
+    f1, _, pyr, coords = _inputs(H=6, W=8, C=8, levels=2, seed=7)
+    radius = 2
+    key = jnp.asarray(np.random.default_rng(2).standard_normal(
+        (2, 6, 8, 2 * (2 * radius + 1) ** 2)).astype(np.float32))
+
+    def loss(f1, pyr):
+        return jnp.sum(ondemand_corr_lookup(f1, pyr, coords, radius, 16)
+                       * key)
+
+    monkeypatch.setenv("RAFT_PALLAS_BWD", "fused")
+    g_fused = jax.grad(loss, argnums=(0, 1))(f1, pyr)
+    monkeypatch.setenv("RAFT_PALLAS_BWD", "xla")
+    g_xla = jax.grad(loss, argnums=(0, 1))(f1, pyr)
+    for a, b in zip(jax.tree.leaves(g_fused), jax.tree.leaves(g_xla)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_bf16_features_close_to_f32():
+    """bf16 feature blocks (the corr_dtype policy) run the fast MXU path
+    in both forward and backward; results stay within the bf16 error
+    budget of the f32 oracle."""
+    f1, _, pyr, coords = _inputs(seed=11)
+    ref = np.asarray(alternate_corr_lookup(f1, pyr, coords, 3))
+    out = np.asarray(ondemand_corr_lookup(
+        f1.astype(jnp.bfloat16),
+        tuple(p.astype(jnp.bfloat16) for p in pyr), coords, 3))
+    scale = max(1.0, np.abs(ref).max())
+    assert np.abs(out - ref).max() <= 2e-2 * scale
+
+    radius = 2
+    k = (2 * radius + 1) ** 2
+    key = jnp.asarray(np.random.default_rng(3).standard_normal(
+        (2, 8, 12, 3 * k)).astype(np.float32))
+
+    def loss(f1b, pyrb):
+        return jnp.sum(ondemand_corr_lookup(f1b, pyrb, coords, radius, 32)
+                       * key)
+
+    g16 = jax.grad(loss, argnums=(0, 1))(
+        f1.astype(jnp.bfloat16),
+        tuple(p.astype(jnp.bfloat16) for p in pyr))
+    gref = jax.grad(lambda a, p: jnp.sum(
+        alternate_corr_lookup(a, p, coords, radius) * key),
+        argnums=(0, 1))(f1, pyr)
+    for a, b in zip(jax.tree.leaves(g16), jax.tree.leaves(gref)):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        s = max(1.0, np.abs(b).max())
+        assert np.abs(a - b).max() <= 3e-2 * s
+
+
 def test_unknown_pallas_variant_rejected(monkeypatch):
     monkeypatch.setenv("RAFT_PALLAS_VARIANT", "bogus")
     f1, _, pyr, coords = _inputs(B=1, H=8, W=8, seed=5)

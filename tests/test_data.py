@@ -371,7 +371,7 @@ def test_synthetic_shift_with_augmentor_deterministic():
     a = ds[0]
     assert a["image1"].shape == (64, 96, 3)
     assert a["flow"].shape == (64, 96, 2)
-    assert a["image1"].dtype == np.float32
+    assert a["image1"].dtype == np.uint8  # uint8 host pipeline end-to-end
     b = ds[0]
     np.testing.assert_array_equal(a["image1"], b["image1"])
     np.testing.assert_array_equal(a["flow"], b["flow"])
