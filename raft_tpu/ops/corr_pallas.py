@@ -250,6 +250,165 @@ def _lookup_level_blocked(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
     )(f1q, f2x, cx_col, cy_col)
 
 
+def _rowpad_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref,
+                   *, radius: int, w2: int, w2p: int, r_tile: int,
+                   q_tile: int):
+    """One (batch, query-block, row-block) grid step — the separable
+    variant (round 4).
+
+    The blocked kernel's cost on hardware is NOT its matmuls but the
+    three (q_tile, k1, t_tile) weight/product slabs it builds per grid
+    step (VPU-bound; measured 161.8 ms vs chunked's 101-120 at
+    1024x440).  This variant restores the SEPARABILITY of the bilinear
+    window that the flat-t formulation gave up: each target row is
+    padded to ``w2p`` (a whole number of 128-lane groups), so the flat
+    index t = row*w2p + x splits as a LANE-PRESERVING reshape
+    (q, r_tile*w2p) -> (q, r_tile, w2p) — the element's lane (t mod
+    128) never moves, unlike the round-3-rejected (q, T) -> (q, H2, W2)
+    split at W2=55.  The window weights then factor into two TINY slabs,
+
+        wx[q, kx, x]   (q, k1, w2p)   — x weights, shared by all rows
+        wy[q, ky, row] (q, k1, r_tile) — y weights of this row block
+
+    and the windowing is two small batched contractions instead of
+    slab-sized elementwise work:
+
+        a[q, kx, row]  = sum_x  wx[q,kx,x] * corr3[q,row,x]   (K = w2p)
+        out[q, kx, ky] += sum_r a[q,kx,r] * wy[q,ky,r]        (K = r_tile)
+
+    Padded x-columns carry f2 = 0, so their corr is 0 and any wx match
+    there contributes nothing — identical zero-OOB semantics.
+
+    f1_ref: (1, q_tile, C); f2_ref: (1, r_tile*w2p, C) — row-padded flat
+    block; cx/cy_ref: (q_tile, 1); out_ref: (1, q_tile, k1, k1).
+    """
+    r = radius
+    k1 = 2 * r + 1
+    c_dim = f1_ref.shape[-1]
+    scale = 1.0 / (c_dim ** 0.5)
+    prec = _precision_for(f1_ref.dtype)
+    tb = pl.program_id(2)
+
+    @pl.when(tb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    cy_all = cy_ref[...]
+    row_lo = jnp.floor(jnp.min(cy_all)) - r
+    row_hi = jnp.floor(jnp.max(cy_all)) + r + 1.0
+    blk_lo = (tb * r_tile).astype(jnp.float32)
+
+    @pl.when(jnp.logical_and(blk_lo <= row_hi,
+                             blk_lo + r_tile > row_lo))
+    def _body():
+        corr = jax.lax.dot_general(
+            f1_ref[0], f2_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale                  # (q, r_tile*w2p)
+        corr3 = corr.reshape(q_tile, r_tile, w2p)    # lane-preserving
+
+        cx = cx_ref[...][:, :, None]                 # (q, 1, 1)
+        cy = cy_ref[...][:, :, None]
+        x0 = jnp.floor(cx)
+        y0 = jnp.floor(cy)
+        fx = cx - x0
+        fy = cy - y0
+
+        kk = jax.lax.broadcasted_iota(
+            jnp.int32, (q_tile, k1, w2p), 1).astype(jnp.float32)
+        xt = jax.lax.broadcasted_iota(
+            jnp.int32, (q_tile, k1, w2p), 2).astype(jnp.float32)
+        bx = x0 - r + kk
+        wx = ((xt == bx).astype(jnp.float32) * (1.0 - fx)
+              + (xt == bx + 1.0).astype(jnp.float32) * fx)  # (q, kx, x)
+
+        kk_y = jax.lax.broadcasted_iota(
+            jnp.int32, (q_tile, k1, r_tile), 1).astype(jnp.float32)
+        yr = jax.lax.broadcasted_iota(
+            jnp.int32, (q_tile, k1, r_tile), 2).astype(jnp.float32) + blk_lo
+        by = y0 - r + kk_y
+        wy = ((yr == by).astype(jnp.float32) * (1.0 - fy)
+              + (yr == by + 1.0).astype(jnp.float32) * fy)  # (q, ky, row)
+
+        # a[q, kx, row] = sum_x wx[q,kx,x] * corr3[q,row,x]
+        a = jax.lax.dot_general(
+            wx, corr3,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)     # (q, kx, row)
+        # out[q, kx, ky] += sum_row a[q,kx,row] * wy[q,ky,row]
+        out_ref[0] += jax.lax.dot_general(
+            a, wy,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)     # (q, kx, ky)
+
+
+def _pick_q_tile_rowpad(w2p: int, r_tile: int, C: int, radius: int) -> int:
+    """q_tile sizing for the rowpad variant: the slabs are tiny (separable
+    weights), so the budget is dominated by the double-buffered
+    (r_tile*w2p, C) fmap2 block and the (q, r_tile*w2p) corr tile."""
+    t_tile = r_tile * w2p
+    budget = 12 * 1024 * 1024 - 2 * 4 * t_tile * C
+
+    k1 = 2 * radius + 1
+    k1p = ((k1 + 7) // 8) * 8
+    lane = 128
+    per_q = (4 * t_tile            # corr row (+ corr3 alias)
+             + 4 * k1p * w2p       # wx
+             + 4 * k1p * lane      # wy (r_tile lanes padded)
+             + 4 * k1p * lane      # a
+             + 2 * 4 * k1p * lane  # double-buffered output
+             + 2 * 4 * C)
+    for qt in (256, 128, 64, 32, 16, 8):
+        if qt * per_q <= budget:
+            return qt
+    return 8
+
+
+def _lookup_level_rowpad(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
+                         cy: jax.Array, radius: int, q_tile: int,
+                         interpret: bool) -> jax.Array:
+    """Rowpad variant of :func:`_lookup_level_blocked` (same contract)."""
+    B, NQ, C = f1q.shape
+    H2, W2 = f2.shape[1], f2.shape[2]
+    k1 = 2 * radius + 1
+    lane = 128
+    w2p = ((W2 + lane - 1) // lane) * lane
+    r_tile = max(1, 512 // w2p)
+    nt = -(-H2 // r_tile)
+    f2p = jnp.pad(f2, ((0, 0), (0, nt * r_tile - H2), (0, w2p - W2),
+                       (0, 0)))
+    f2x = f2p.reshape(B, nt * r_tile * w2p, C)
+    nqb = NQ // q_tile
+    cx_col = cx.reshape(B * NQ, 1)
+    cy_col = cy.reshape(B * NQ, 1)
+
+    kernel = functools.partial(_rowpad_kernel, radius=radius, w2=W2,
+                               w2p=w2p, r_tile=r_tile, q_tile=q_tile)
+    t_tile = r_tile * w2p
+    return pl.pallas_call(
+        kernel,
+        grid=(B, nqb, nt),
+        in_specs=[
+            pl.BlockSpec((1, q_tile, C), lambda b, qb, tb: (b, qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t_tile, C), lambda b, qb, tb: (b, tb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, 1), lambda b, qb, tb: (b * nqb + qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, 1), lambda b, qb, tb: (b * nqb + qb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, q_tile, k1, k1),
+                               lambda b, qb, tb: (b, qb, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, NQ, k1, k1), jnp.float32),
+        interpret=interpret,
+    )(f1q, f2x, cx_col, cy_col)
+
+
 def _rowloop_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, rx_ref,
                     *, radius: int, w2: int, q_tile: int):
     """One (batch, query-block, target-row) grid step — the conservative
@@ -564,22 +723,31 @@ def _forward(fmap1: jax.Array, fmap2_pyramid: Tuple[jax.Array, ...],
     B, H1, W1, C = fmap1.shape
     Q = H1 * W1
 
-    # Kernel variant: "blocked" (default — t-tiled flat-target MXU blocks;
-    # Mosaic-proven on v5e, see PARITY.md) or "rowloop" (grid over single
+    # Kernel variant: "blocked" (default — flat-t weight slabs;
+    # Mosaic-proven on v5e, see PARITY.md), "rowpad" (separable weights
+    # on row-padded lane groups) or "rowloop" (grid over single
     # target rows — the conservative fallback, slower on hardware).  The
     # original "rowmajor" kernel was removed in round 3: Mosaic rejects
-    # its (q, T) -> (q, H2, W2) lane-dim reshape on real TPUs.
+    # its (q, T) -> (q, H2, W2) lane-dim reshape on real TPUs (the
+    # rowpad variant's reshape splits at a 128 boundary instead, which
+    # is lane-preserving).
     variant = os.environ.get("RAFT_PALLAS_VARIANT", "blocked")
-    if variant not in ("blocked", "rowloop"):
-        raise ValueError(f"RAFT_PALLAS_VARIANT must be 'blocked' or "
-                         f"'rowloop', got {variant!r}")
-    level_fn = (_lookup_level_blocked if variant == "blocked"
-                else _lookup_level_rowloop)
+    if variant not in ("rowpad", "blocked", "rowloop"):
+        raise ValueError(f"RAFT_PALLAS_VARIANT must be 'rowpad', "
+                         f"'blocked' or 'rowloop', got {variant!r}")
+    level_fn = {"rowpad": _lookup_level_rowpad,
+                "blocked": _lookup_level_blocked,
+                "rowloop": _lookup_level_rowloop}[variant]
 
     if q_tile is None:
         f2 = fmap2_pyramid[0]
         if variant == "rowloop":
             q_tile = _pick_q_tile_rowloop(f2.shape[2], C, radius)
+        elif variant == "rowpad":
+            lane = 128
+            w2p = ((f2.shape[2] + lane - 1) // lane) * lane
+            q_tile = _pick_q_tile_rowpad(w2p, max(1, 512 // w2p), C,
+                                         radius)
         else:
             q_tile = _pick_q_tile(f2.shape[1] * f2.shape[2], C, radius)
     nq = ((Q + q_tile - 1) // q_tile) * q_tile

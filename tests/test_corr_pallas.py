@@ -135,6 +135,30 @@ def test_model_with_pallas_corr():
 
 
 @pytest.mark.parametrize("radius", [2, 4])
+def test_rowpad_variant_matches_oracle(radius, monkeypatch):
+    """RAFT_PALLAS_VARIANT=rowpad — the separable-weights variant
+    (lane-preserving row-padded reshape) must match the lax oracle and
+    the default blocked kernel, including the query-padding path."""
+    monkeypatch.setenv("RAFT_PALLAS_VARIANT", "rowpad")
+    f1, _, pyr, coords = _inputs(seed=13)
+    ref = alternate_corr_lookup(f1, pyr, coords, radius)
+    out = ondemand_corr_lookup(f1, pyr, coords, radius, 32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    f1b, _, pyrb, coordsb = _inputs(H=6, W=6, seed=14)  # Q=36: pad path
+    refb = alternate_corr_lookup(f1b, pyrb, coordsb, radius)
+    outb = ondemand_corr_lookup(f1b, pyrb, coordsb, radius, 32)
+    np.testing.assert_allclose(np.asarray(outb), np.asarray(refb),
+                               atol=1e-5, rtol=1e-5)
+
+    monkeypatch.setenv("RAFT_PALLAS_VARIANT", "blocked")
+    blocked = ondemand_corr_lookup(f1, pyr, coords, radius, 32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(blocked),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("radius", [2, 4])
 def test_rowloop_variant_matches_oracle(radius, monkeypatch):
     """RAFT_PALLAS_VARIANT=rowloop — the conservative fallback kernel
     (grid over target rows) must match the lax oracle and the default
