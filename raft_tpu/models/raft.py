@@ -317,9 +317,10 @@ class RAFT(nn.Module):
             return flow_lr, upsample(flow_lr, net)
 
         # Batch the upsample over all iterates: (iters, B, ...) -> (iters*B, ...)
-        # pack_output=True keeps the result in pack_fine's (B, H, W, 64, 2)
-        # layout — the training loss brings the TARGETS into this layout
-        # instead of transposing 12 full-res iterates back to image layout.
+        # pack_output=True keeps the result in pack_fine's c-major-merged
+        # (B, H, W, 128) layout — the training loss brings the TARGETS
+        # into this layout instead of transposing 12 full-res iterates
+        # back to image layout.
         n_it = flows_lr.shape[0]
         flat = lambda x: x.reshape((n_it * B,) + x.shape[2:])
         ups = upsample(flat(flows_lr), flat(nets), packed=pack_output)

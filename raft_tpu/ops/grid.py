@@ -127,21 +127,28 @@ def avg_pool2x(x: jax.Array) -> jax.Array:
 
 
 def pack_fine(x: jax.Array) -> jax.Array:
-    """(B, 8H, 8W, ...) image-layout array -> packed (B, H, W, 64, ...).
+    """(B, 8H, 8W, C) image-layout array -> packed (B, H, W, C*64).
 
     The packed layout is the one ``convex_upsample(..., packed=True)``
-    produces natively (coarse pixel major, subpixel s = 8*sy + sx next).
-    Used to bring the training TARGETS (gt flow, valid mask) into the
+    produces natively: coarse pixel major, then CHANNEL-major over the
+    merged trailing axis — lane index = c*64 + (8*sy + sx).  Used to
+    bring the training TARGETS (gt flow, valid mask) into the
     predictions' layout once per step, instead of transposing every
     iterate's 8x-upsampled prediction into image layout (~140 MB of pure
     data movement per direction at training resolution).
+
+    Why c-major-merged (round-4 trace finding): the previous
+    (B, H, W, 64, C) layout put C=2 in the minor dim, forcing XLA into
+    T(2,128) tilings — 2 of 128 vector lanes — for every op touching
+    the packed predictions; the upsampler+loss cluster cost ~40 ms/step
+    in layout copies and starved fusions.  A merged 128-lane trailing
+    axis keeps every elementwise op in the loss at full lane width.
     """
-    B, HF, WF = x.shape[:3]
-    rest = x.shape[3:]
+    B, HF, WF, C = x.shape
     H, W = HF // 8, WF // 8
-    x = x.reshape((B, H, 8, W, 8) + rest)
-    x = jnp.moveaxis(x, 2, 3)  # (B, H, W, 8, 8, ...)
-    return x.reshape((B, H, W, 64) + rest)
+    x = x.reshape(B, H, 8, W, 8, C)
+    x = x.transpose(0, 1, 3, 5, 2, 4)  # (B, H, W, C, 8, 8)
+    return x.reshape(B, H, W, C * 64)
 
 
 def convex_upsample(flow: jax.Array, mask: jax.Array,
@@ -161,9 +168,10 @@ def convex_upsample(flow: jax.Array, mask: jax.Array,
 
     Returns:
       (B, 8H, 8W, 2) upsampled flow; or, with ``packed=True``, the same
-      values in the (B, H, W, 64, 2) layout of ``pack_fine`` — skipping
-      the subpixel-to-image transpose (training consumes predictions via
-      the loss only, which works in either layout).
+      values in the (B, H, W, 128) c-major-merged layout of
+      ``pack_fine`` — skipping the subpixel-to-image transpose (training
+      consumes predictions via the loss only, which works in either
+      layout).
     """
     B, H, W, _ = flow.shape
     # TPU layout note: keep the subpixel axis fused as s = 8*sy + sx (64
@@ -175,16 +183,31 @@ def convex_upsample(flow: jax.Array, mask: jax.Array,
 
     up = 8.0 * flow
     up_pad = jnp.pad(up, ((0, 0), (1, 1), (1, 1), (0, 0)))
-    # 3x3 neighborhood, row-major over (dy, dx) to match F.unfold ordering.
-    neighbors = jnp.stack(
-        [up_pad[:, dy : dy + H, dx : dx + W, :] for dy in range(3) for dx in range(3)],
-        axis=3,
-    )  # (B, H, W, 9, 2)
 
-    # out[b,h,w,s,c] = sum_k m[b,h,w,k,s] * neighbors[b,h,w,k,c]
-    out = jnp.einsum("bhwks,bhwkc->bhwsc", m, neighbors)
+    # Convex combination as 9 unrolled fused multiply-adds per flow
+    # channel, every operand a full-rank-4 (B, H, W, 64) tensor.  NOT an
+    # einsum over a stacked (B, H, W, 9, 2) neighborhood: any tensor
+    # with the size-2 flow channel in a minor dim gets a T(2,128) tiling
+    # (2 of 128 lanes) and the einsum's dot lowering inserted ~40
+    # ms/step of layout copies and half-empty fusions around it (round-4
+    # trace, the former grid.py:173-185 cluster).  XLA fuses each
+    # channel's chain into one loop fusion: m is read once per channel,
+    # the up_pad window slices are free, one output pass.
+    taps = [(dy, dx) for dy in range(3) for dx in range(3)]  # F.unfold order
+
+    def combine(c):
+        acc = None
+        for k, (dy, dx) in enumerate(taps):
+            t = m[:, :, :, k, :] * up_pad[:, dy:dy + H, dx:dx + W,
+                                          c][..., None]
+            acc = t if acc is None else acc + t
+        return acc  # (B, H, W, 64)
+
+    outx, outy = combine(0), combine(1)
     if packed:
-        return out  # (B, H, W, 64, 2)
-    # (B, H, W, (sy, sx), 2) -> (B, H, 8, W, 8, 2) -> (B, 8H, 8W, 2)
+        # c-major merged lanes: lane = c*64 + s (pack_fine's layout)
+        return jnp.concatenate([outx, outy], axis=-1)  # (B, H, W, 128)
+    # (B, H, W, (sy, sx), 2) -> (B, H, sy, W, sx, 2) -> (B, 8H, 8W, 2)
+    out = jnp.stack([outx, outy], axis=-1)
     out = out.reshape(B, H, W, 8, 8, 2).transpose(0, 1, 3, 2, 4, 5)
     return out.reshape(B, 8 * H, 8 * W, 2)

@@ -20,8 +20,9 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
 
     Args:
       flow_preds: (iters, B, H, W, 2) stacked iterates (scan output); with
-        ``packed=True``, (iters, B, H/8, W/8, 64, 2) in the model's
-        pack_output layout (see ops/grid.py pack_fine).
+        ``packed=True``, (iters, B, H/8, W/8, 128) in the model's
+        c-major-merged pack_output layout (lane = c*64 + subpixel; see
+        ops/grid.py pack_fine).
       flow_gt: (B, H, W, 2), always image layout.
       valid: (B, H, W) 0/1 mask, always image layout.
       gamma: decay.
@@ -33,17 +34,32 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
       both layouts — packed just transposes the two targets once instead
       of every prediction iterate.
     """
+    n = flow_preds.shape[0]
+    weights = gamma ** (n - 1 - jnp.arange(n, dtype=jnp.float32))
+
     if packed:
         from raft_tpu.ops.grid import pack_fine
-        flow_gt = pack_fine(flow_gt)                    # (B, H, W, 64, 2)
-        valid = pack_fine(valid[..., None])[..., 0]     # (B, H, W, 64)
+        gt = pack_fine(flow_gt).astype(jnp.float32)     # (B, H, W, 128)
+        v64 = pack_fine(valid[..., None])               # (B, H, W, 64)
+        gx, gy = gt[..., :64], gt[..., 64:]             # c-major lanes
+        mag = jnp.sqrt(gx * gx + gy * gy)               # (B, H, W, 64)
+        vmask = (v64 >= 0.5) & (mag < max_flow)
+        vf = vmask.astype(jnp.float32)
+        vw = jnp.concatenate([vf, vf], axis=-1)[None]   # (1, B, H, W, 128)
+        abs_err = jnp.abs(flow_preds.astype(jnp.float32) - gt[None])
+        per_iter = jnp.mean(vw * abs_err,
+                            axis=tuple(range(1, abs_err.ndim)))
+        loss = jnp.sum(weights * per_iter)
 
-    n = flow_preds.shape[0]
+        last = flow_preds[-1].astype(jnp.float32)
+        ex, ey = last[..., :64] - gx, last[..., 64:] - gy
+        metrics = _epe_metrics(jnp.sqrt(ex * ex + ey * ey), vf)
+        return loss, metrics
+
     mag = jnp.sqrt(jnp.sum(flow_gt.astype(jnp.float32) ** 2, axis=-1))
     valid = (valid >= 0.5) & (mag < max_flow)
     vw = valid.astype(jnp.float32)[None, ..., None]
 
-    weights = gamma ** (n - 1 - jnp.arange(n, dtype=jnp.float32))
     abs_err = jnp.abs(flow_preds.astype(jnp.float32) - flow_gt[None])
     # mean over everything per-iterate (the reference takes .mean() of the
     # masked per-pixel loss, i.e. including masked zeros in the denominator:
@@ -55,12 +71,10 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array,
     return loss, metrics
 
 
-def flow_metrics(flow: jax.Array, flow_gt: jax.Array,
-                 valid: jax.Array) -> Dict[str, jax.Array]:
-    """EPE and 1/3/5px outlier rates over valid pixels (train.py:62-70)."""
-    epe = jnp.sqrt(jnp.sum((flow.astype(jnp.float32)
-                            - flow_gt.astype(jnp.float32)) ** 2, axis=-1))
-    v = valid.astype(jnp.float32)
+def _epe_metrics(epe: jax.Array, v: jax.Array) -> Dict[str, jax.Array]:
+    """epe/1px/3px/5px from a per-pixel EPE map and float valid mask of
+    the same shape (layout-agnostic — the masked means see every pixel
+    exactly once in any layout)."""
     denom = jnp.maximum(v.sum(), 1.0)
 
     def masked_mean(x):
@@ -72,3 +86,11 @@ def flow_metrics(flow: jax.Array, flow_gt: jax.Array,
         "3px": masked_mean((epe < 3.0).astype(jnp.float32)),
         "5px": masked_mean((epe < 5.0).astype(jnp.float32)),
     }
+
+
+def flow_metrics(flow: jax.Array, flow_gt: jax.Array,
+                 valid: jax.Array) -> Dict[str, jax.Array]:
+    """EPE and 1/3/5px outlier rates over valid pixels (train.py:62-70)."""
+    epe = jnp.sqrt(jnp.sum((flow.astype(jnp.float32)
+                            - flow_gt.astype(jnp.float32)) ** 2, axis=-1))
+    return _epe_metrics(epe, valid.astype(jnp.float32))

@@ -84,6 +84,11 @@ def main():
     ap.add_argument("-n", type=int, default=25)
     ap.add_argument("--group", action="store_true",
                     help="merge ops by base name (strip trailing .N digits)")
+    ap.add_argument("--self", dest="self_time", action="store_true",
+                    help="subtract nested child events (e.g. ops inside a "
+                         "while's span on the same lane) so containers "
+                         "like the refinement scan don't double-count "
+                         "their bodies")
     args = ap.parse_args()
 
     path = find_trace(args.path)
@@ -95,9 +100,7 @@ def main():
               "summing ALL streams (host dispatch/python included); on a "
               "CPU trace this mixes dispatch with compute", file=sys.stderr)
 
-    durs = collections.Counter()
-    counts = collections.Counter()
-    total = 0.0
+    picked = []
     for e in events:
         if e.get("ph") != "X" or "dur" not in e:
             continue
@@ -105,10 +108,38 @@ def main():
             continue
         if lanes and (e.get("pid"), e.get("tid")) not in lanes:
             continue
+        picked.append(e)
+
+    self_us = {}
+    if args.self_time:
+        # Per lane: sort by (start, -dur); a stack of open spans gives
+        # each event's self time = dur - sum(direct children's dur).
+        by_lane = collections.defaultdict(list)
+        for i, e in enumerate(picked):
+            by_lane[(e.get("pid"), e.get("tid"))].append(i)
+        for idxs in by_lane.values():
+            idxs.sort(key=lambda i: (float(picked[i]["ts"]),
+                                     -float(picked[i]["dur"])))
+            stack = []  # indices of open enclosing spans
+            for i in idxs:
+                ts, dur = float(picked[i]["ts"]), float(picked[i]["dur"])
+                while stack and (float(picked[stack[-1]]["ts"])
+                                 + float(picked[stack[-1]]["dur"])) <= ts:
+                    stack.pop()
+                self_us[i] = dur
+                if stack:
+                    self_us[stack[-1]] -= dur  # direct parent only
+                stack.append(i)
+
+    durs = collections.Counter()
+    counts = collections.Counter()
+    total = 0.0
+    for i, e in enumerate(picked):
         name = e.get("name", "?")
         if args.group:
             name = re.sub(r"[.\d]+$", "", name)
-        us = float(e["dur"])
+        us = self_us.get(i, float(e["dur"])) if args.self_time \
+            else float(e["dur"])
         durs[name] += us
         counts[name] += 1
         total += us
