@@ -81,8 +81,33 @@ class RAFTConfig:
     # also adds an HBM transient.  Kept as an option: the reassociation
     # may still win at configs with much larger volumes per iteration.
     deferred_corr_grad: bool = False
+    # Dense-pyramid WINDOWED-LOOKUP implementation (all-pairs path):
+    # "einsum" — the one-hot gather-as-matmul contractions (corr.py
+    # corr_lookup); "pallas" — the fused kernel
+    # (corr_pallas.pyramid_window_lookup) over a zero-padded pyramid
+    # layout: window weights never touch HBM and target-row blocks
+    # outside every query's window are skipped.  With
+    # deferred_corr_grad=True the pyramid cotangent also runs as one
+    # fused kernel per level (f32 VMEM accumulation over iterations, one
+    # HBM write) instead of the backward scan's select_add chain.
+    # Incompatible with corr_shard (the kernel doesn't partition over a
+    # mesh) — validated below.
+    lookup_impl: str = "einsum"  # "einsum" | "pallas"
 
     def __post_init__(self):
+        if self.lookup_impl not in ("einsum", "pallas"):
+            raise ValueError(f"lookup_impl must be 'einsum' or 'pallas', "
+                             f"got {self.lookup_impl!r}")
+        if self.lookup_impl == "pallas" and self.corr_shard:
+            raise ValueError(
+                "lookup_impl='pallas' runs a single-device fused kernel "
+                "and cannot partition the query axis over the 'spatial' "
+                "mesh axis — use lookup_impl='einsum' with corr_shard")
+        if self.lookup_impl == "pallas" and self.alternate_corr:
+            raise ValueError(
+                "lookup_impl selects the DENSE-pyramid lookup and is "
+                "only consulted when alternate_corr=False — the "
+                "on-demand path has its own corr_impl knob")
         if self.corr_impl not in CORR_IMPLS:
             raise ValueError(f"corr_impl must be one of {CORR_IMPLS}, "
                              f"got {self.corr_impl!r}")

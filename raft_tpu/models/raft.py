@@ -34,6 +34,7 @@ from raft_tpu.models.update import BasicUpdateBlock, MaskHead, SmallUpdateBlock
 from raft_tpu.ops.corr import (
     alternate_corr_lookup,
     build_corr_pyramid_direct,
+    build_corr_pyramid_padded,
     build_fmap_pyramid,
     chunked_corr_lookup,
     corr_lookup,
@@ -56,10 +57,17 @@ def resolve_remat_policy(name: str):
     other name is a jax.checkpoint_policies member.
     """
     if name == "convs_and_dots_saveable":
-        return jax.checkpoint_policies.save_from_both_policies(
+        base = jax.checkpoint_policies.save_from_both_policies(
             jax.checkpoint_policies.dots_saveable,
             jax.checkpoint_policies.save_only_these_names("conv_out"))
-    return getattr(jax.checkpoint_policies, name)
+    else:
+        base = getattr(jax.checkpoint_policies, name)
+    # Always also save the Pallas dense-lookup output (tag "corr_lookup",
+    # see RefinementStep): it is a custom call, not a dot, so dot-based
+    # policies would otherwise recompute the kernel in the backward scan.
+    # Harmless when the tag does not appear in the graph.
+    return jax.checkpoint_policies.save_from_both_policies(
+        base, jax.checkpoint_policies.save_only_these_names("corr_lookup"))
 
 
 class RefinementStep(nn.Module):
@@ -88,6 +96,18 @@ class RefinementStep(nn.Module):
             else:
                 corr = alternate_corr_lookup(fmap1, fmap2_pyr, coords1,
                                              cfg.corr_radius)
+        elif cfg.lookup_impl == "pallas":
+            from jax.ad_checkpoint import checkpoint_name
+
+            from raft_tpu.ops.corr_pallas import pyramid_window_lookup
+
+            corr = pyramid_window_lookup(
+                corr_state, coords1, cfg.corr_radius,
+                (coords1.shape[1], coords1.shape[2]))
+            # pallas_call is not a dot: without this tag a dots_saveable
+            # remat policy would RECOMPUTE the kernel in the backward
+            # scan (resolve_remat_policy saves the name)
+            corr = checkpoint_name(corr, "corr_lookup")
         else:
             corr = corr_lookup(corr_state, coords1, cfg.corr_radius,
                                shard=cfg.corr_shard)
@@ -200,6 +220,13 @@ class RAFT(nn.Module):
             mesh = jax.sharding.get_abstract_mesh()
             pyramid = ring_corr_pyramid(fmap1, fmap2, mesh, cfg.corr_levels)
             corr_state = tuple(p.astype(corr_dt) for p in pyramid)
+        elif cfg.lookup_impl == "pallas":
+            # Padded layout for the fused lookup kernels: query axis to
+            # whole kernel tiles, rows/width to sublane/lane multiples,
+            # all explicit zeros (see build_corr_pyramid_padded).
+            pyramid = build_corr_pyramid_padded(fmap1, fmap2,
+                                                cfg.corr_levels, corr_dt)
+            corr_state = tuple(pyramid)
         else:
             # Each level as a matmul against pooled fmap2 (exactly equal to
             # pooling the full volume — see build_corr_pyramid_direct); the
@@ -283,9 +310,17 @@ class RAFT(nn.Module):
                 vjp_fn, entry = residuals
                 params_t, win_t, carry0_t, inp_t, coords0_t = vjp_fn(
                     cotangents)
-                pyr_t = stacked_pyramid_cotangent(
-                    win_t, entry, cfg.corr_radius, level_shapes,
-                    level_dtypes, shard=cfg.corr_shard)
+                if cfg.lookup_impl == "pallas":
+                    from raft_tpu.ops.corr_pallas import (
+                        stacked_pyramid_cotangent_pallas)
+
+                    pyr_t = stacked_pyramid_cotangent_pallas(
+                        win_t, entry, cfg.corr_radius, level_shapes,
+                        level_dtypes)
+                else:
+                    pyr_t = stacked_pyramid_cotangent(
+                        win_t, entry, cfg.corr_radius, level_shapes,
+                        level_dtypes, shard=cfg.corr_shard)
                 return (params_t, pyr_t, win_t, carry0_t, inp_t, coords0_t)
 
             refine = nn.custom_vjp(f, forward_fn=fwd, backward_fn=bwd)

@@ -112,6 +112,48 @@ def build_corr_pyramid_direct(fmap1: jax.Array, fmap2: jax.Array,
     return pyramid
 
 
+def build_corr_pyramid_padded(fmap1: jax.Array, fmap2: jax.Array,
+                              num_levels: int = 4, dtype=jnp.float32,
+                              q_pad_to: int = 64, row_pad_to: int = 8,
+                              lane: int = 128) -> List[jax.Array]:
+    """``build_corr_pyramid_direct`` in the Pallas lookup's native layout.
+
+    Levels come out (B, Qp, Hp_l, W2p_l): the query axis zero-padded to a
+    whole number of kernel query tiles, each level's target rows padded
+    to ``row_pad_to`` and its width to whole ``lane`` groups — all with
+    EXPLICIT zeros (padded queries have zero features, padded targets
+    enter the matmul as zero rows), so the lookup kernels never touch
+    uninitialized VMEM and out-of-range bilinear taps read exact zeros
+    (the oracle's OOB semantics).  The padding costs extra MXU work on
+    zero columns (~2x at a 62-wide level 0) — cheap against the lookup
+    contractions it unlocks (see corr_pallas.pyramid_window_lookup).
+    """
+    B, H, W, C = fmap1.shape
+    _check_pyramid_depth(H, W, num_levels)
+    Q = H * W
+    Qp = -(-Q // q_pad_to) * q_pad_to
+    in_dt = jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
+    f1 = fmap1.reshape(B, Q, C).astype(in_dt)
+    if Qp != Q:
+        f1 = jnp.pad(f1, ((0, 0), (0, Qp - Q), (0, 0)))
+    scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(C))
+    pyramid = []
+    f2 = fmap2.astype(jnp.float32)
+    for lvl in range(num_levels):
+        if lvl:
+            f2 = avg_pool2x(f2)
+        Hl, Wl = f2.shape[1], f2.shape[2]
+        Hp = -(-Hl // row_pad_to) * row_pad_to
+        W2p = -(-Wl // lane) * lane
+        f2p = jnp.pad(f2, ((0, 0), (0, Hp - Hl), (0, W2p - Wl), (0, 0)))
+        corr = jnp.einsum("bqc,btc->bqt", f1,
+                          f2p.reshape(B, Hp * W2p, C).astype(in_dt),
+                          preferred_element_type=jnp.float32)
+        pyramid.append((corr * scale).reshape(B, Qp, Hp, W2p)
+                       .astype(dtype))
+    return pyramid
+
+
 def _check_pyramid_depth(h: int, w: int, num_levels: int) -> None:
     """Every pyramid level must be >= 1 px (floor-halving num_levels-1 times)."""
     need = 2 ** (num_levels - 1)

@@ -409,6 +409,345 @@ def _lookup_level_rowpad(f1q: jax.Array, f2: jax.Array, cx: jax.Array,
     )(f1q, f2x, cx_col, cy_col)
 
 
+# ---------------------------------------------------------------------------
+# Dense-pyramid fused lookup (the all-pairs training path's hot loop).
+#
+# The XLA formulation (corr.py corr_lookup) costs three things the round-4
+# trace measured at ~70 ms/step at the chairs config: the one-hot weight
+# tensors materialize in HBM (XLA cannot fuse producers into dot
+# operands), the contractions are K=9/K=46-class batched matmuls, and the
+# backward-scan accumulation of the pyramid cotangent is a select_add
+# chain over the whole volume per iteration (35 ms/step at 38% HBM
+# efficiency).  These kernels keep the weights in VMEM, skip target-row
+# blocks outside every query's window, and (backward) accumulate all
+# iterations' cotangent contributions in a VMEM f32 register with ONE
+# HBM write per output block.  Pyramid layout: build_corr_pyramid_padded
+# (explicit zero padding — garbage-free VMEM, exact zero OOB taps).
+# ---------------------------------------------------------------------------
+
+
+def _window_weights(cx, cy, radius: int, w2p: int, r_tile: int, row0,
+                    q_tile: int):
+    """Separable bilinear one-hot weights of one row block.
+
+    cx/cy: (q, 1) level-scaled coords.  Returns (wx (q, k1, w2p),
+    wy (q, k1, r_tile)) f32, evaluated with the same iota arithmetic as
+    the on-demand kernels (shared error budget and Mosaic constraints).
+    """
+    r = radius
+    k1 = 2 * r + 1
+    cxb = cx[:, :, None]
+    cyb = cy[:, :, None]
+    x0 = jnp.floor(cxb)
+    y0 = jnp.floor(cyb)
+    fx = cxb - x0
+    fy = cyb - y0
+
+    kk = jax.lax.broadcasted_iota(
+        jnp.int32, (q_tile, k1, w2p), 1).astype(jnp.float32)
+    xt = jax.lax.broadcasted_iota(
+        jnp.int32, (q_tile, k1, w2p), 2).astype(jnp.float32)
+    bx = x0 - r + kk
+    wx = ((xt == bx).astype(jnp.float32) * (1.0 - fx)
+          + (xt == bx + 1.0).astype(jnp.float32) * fx)
+
+    kk_y = jax.lax.broadcasted_iota(
+        jnp.int32, (q_tile, k1, r_tile), 1).astype(jnp.float32)
+    yr = jax.lax.broadcasted_iota(
+        jnp.int32, (q_tile, k1, r_tile), 2).astype(jnp.float32) + row0
+    by = y0 - r + kk_y
+    wy = ((yr == by).astype(jnp.float32) * (1.0 - fy)
+          + (yr == by + 1.0).astype(jnp.float32) * fy)
+    return wx, wy
+
+
+def _pyr_lookup_kernel(v_ref, cx_ref, cy_ref, out_ref,
+                       *, radius: int, w2p: int, r_tile: int,
+                       q_tile: int):
+    """One (query-block, row-block) step of the dense-pyramid lookup:
+
+        out[q, kx, ky] += sum_{row, x} wx[q,kx,x] V[q,row,x] wy[q,ky,row]
+
+    v_ref: (q_tile, r_tile, w2p) pyramid rows of these queries;
+    out_ref: (q_tile, k1, k1) accumulated over the sequential row-block
+    axis.  Row blocks outside every query's window skip entirely.
+    """
+    r = radius
+    tb = pl.program_id(1)
+
+    @pl.when(tb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    cy = cy_ref[...]
+    row_lo = jnp.floor(jnp.min(cy)) - r
+    row_hi = jnp.floor(jnp.max(cy)) + r + 1.0
+    blk0 = (tb * r_tile).astype(jnp.float32)
+
+    @pl.when(jnp.logical_and(blk0 <= row_hi, blk0 + r_tile > row_lo))
+    def _body():
+        v = v_ref[...]
+        wx, wy = _window_weights(cx_ref[...], cy, radius, w2p, r_tile,
+                                 blk0, q_tile)
+        prec = _precision_for(v.dtype)
+        # a[q, kx, row] = sum_x wx[q,kx,x] * V[q,row,x]
+        a = jax.lax.dot_general(
+            wx.astype(v.dtype), v,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32, precision=prec)
+        out_ref[...] += jax.lax.dot_general(
+            a, wy.astype(a.dtype),
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)     # (q, kx, ky)
+
+
+def _pyr_cotangent_kernel(cx_ref, cy_ref, g_ref, out_ref,
+                          *, radius: int, w2p: int, r_tile: int,
+                          q_tile: int, iters: int, out_dtype):
+    """One (query-block, row-block) step of the DEFERRED pyramid
+    cotangent: all ``iters`` iterations' contributions
+
+        dV[q, row, x] = sum_i sum_{kx,ky} g_i[q,kx,ky] wx_i[q,kx,x]
+                                                       wy_i[q,ky,row]
+
+    accumulate in an f32 VMEM register (better precision than the
+    select_add chain's bf16 carry) and write ONCE.  Replaces both the
+    per-iteration volume-sized select_adds of plain scan AD and the
+    stacked XLA einsums of the deferred path.
+
+    cx/cy_ref: (iters, q_tile, 1) entry coords; g_ref: (iters, q_tile,
+    k1, k1) window cotangents; out_ref: (q_tile, r_tile, w2p).
+    """
+    r = radius
+    tb = pl.program_id(1)
+    blk0 = (tb * r_tile).astype(jnp.float32)
+
+    # Whole-block skip over the UNION of all iterations' windows: the
+    # coords drift only a few pixels across refinement iterations, so a
+    # row block missed by one iteration is usually missed by all 12 —
+    # the common case writes zeros and does no slab/dot work at all.
+    cy_all = cy_ref[...]
+    lo_all = jnp.floor(jnp.min(cy_all)) - r
+    hi_all = jnp.floor(jnp.max(cy_all)) + r + 1.0
+    hit_any = jnp.logical_and(blk0 <= hi_all, blk0 + r_tile > lo_all)
+
+    @pl.when(jnp.logical_not(hit_any))
+    def _zero():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(hit_any)
+    def _work():
+        acc = jnp.zeros((q_tile, r_tile, w2p), jnp.float32)
+        for i in range(iters):
+            # an iteration whose window misses this row block contributes
+            # exact zeros through wy's one-hot (no row matches), so no
+            # per-iteration gating is needed — only the block-level
+            # hit_any skip above saves work
+            wx, wy = _window_weights(cx_ref[i], cy_ref[i], radius, w2p,
+                                     r_tile, blk0, q_tile)
+            g = g_ref[i]
+            # tmp[q, ky, x] = sum_kx g[q,kx,ky] * wx[q,kx,x]
+            tmp = jax.lax.dot_general(
+                g, wx.astype(g.dtype),
+                dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+                precision=_precision_for(g.dtype))
+            # contribution[q, row, x] = sum_ky wy[q,ky,row] * tmp[q,ky,x]
+            acc = acc + jax.lax.dot_general(
+                wy, tmp,
+                dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+        out_ref[...] = acc.astype(out_dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def pyramid_window_lookup(pyramid, coords: jax.Array, radius: int,
+                          out_hw: Tuple[int, int],
+                          q_tile: int = 64) -> jax.Array:
+    """Fused windowed lookup over a PADDED dense corr pyramid.
+
+    Drop-in replacement for ``corr.corr_lookup`` when the pyramid comes
+    from ``build_corr_pyramid_padded`` (levels (B, Qp, Hp_l, W2p_l)).
+    Same output contract: (B, H1, W1, L*(2r+1)^2) float32, levels
+    level-major, windows x-major.
+
+    Differentiable: pallas_call has no automatic AD, so the VJP is the
+    single-iteration case of the fused cotangent kernel (the deferred
+    path batches all iterations into one launch instead — see
+    models/raft.py).  d(coords) = 0 by design (the model stop_gradients
+    coords at every iteration entry, raft.py:123).
+    """
+    return _pyr_lookup_forward(pyramid, coords, radius, out_hw, q_tile)
+
+
+def padded_level_shapes(out_hw: Tuple[int, int], num_levels: int,
+                        row_pad_to: int = 8, lane: int = 128):
+    """The (Hp, W2p) padded target extents build_corr_pyramid_padded
+    produces for a pyramid over ``out_hw``-sized feature maps — shared
+    so the lookup VJP can reconstruct them statically."""
+    H2, W2 = out_hw
+    shapes = []
+    for lvl in range(num_levels):
+        if lvl:
+            H2, W2 = H2 // 2, W2 // 2
+        shapes.append((-(-H2 // row_pad_to) * row_pad_to,
+                       -(-W2 // lane) * lane))
+    return shapes
+
+
+def _pyr_lookup_fwd(pyramid, coords, radius, out_hw, q_tile):
+    out = _pyr_lookup_forward(pyramid, coords, radius, out_hw, q_tile)
+    # dtype proxies only — custom_vjp residual leaves must be arrays,
+    # and the backward needs no pyramid VALUES (shapes reconstruct from
+    # out_hw via padded_level_shapes)
+    dtype_proxies = tuple(jnp.zeros((), p.dtype) for p in pyramid)
+    return out, (dtype_proxies, coords)
+
+
+def _pyr_lookup_bwd(radius, out_hw, q_tile, residuals, g):
+    dtype_proxies, coords = residuals
+    d_pyr = stacked_pyramid_cotangent_pallas(
+        g[None], coords[None], radius,
+        padded_level_shapes(out_hw, len(dtype_proxies)),
+        [p.dtype for p in dtype_proxies],
+        q_tile=q_tile)
+    return tuple(d_pyr), jnp.zeros_like(coords)
+
+
+def _pyr_lookup_forward(pyramid, coords: jax.Array, radius: int,
+                        out_hw: Tuple[int, int],
+                        q_tile: int = 64) -> jax.Array:
+    B, H1, W1 = coords.shape[0], out_hw[0], out_hw[1]
+    Q = H1 * W1
+    Qp = pyramid[0].shape[1]
+    k1 = 2 * radius + 1
+    interpret = not _on_tpu()
+
+    cx = coords[..., 0].reshape(B, Q).astype(jnp.float32)
+    cy = coords[..., 1].reshape(B, Q).astype(jnp.float32)
+    if Qp != Q:
+        cx = jnp.pad(cx, ((0, 0), (0, Qp - Q)), mode="edge")
+        cy = jnp.pad(cy, ((0, 0), (0, Qp - Q)), mode="edge")
+    n = B * Qp
+    if n % q_tile:
+        raise ValueError(
+            f"padded query axis {Qp} (x batch {B}) must be a multiple of "
+            f"q_tile={q_tile} — build the pyramid with "
+            f"build_corr_pyramid_padded(q_pad_to=q_tile); a floored "
+            f"grid would silently leave trailing queries unwritten")
+    nqb = n // q_tile
+
+    out = []
+    for i, lvl in enumerate(pyramid):
+        Hp, W2p = lvl.shape[2], lvl.shape[3]
+        # whole-height row blocks: a (q_tile, Hp, W2p) VMEM tenant is at
+        # most ~4 MB at RAFT shapes, and ntr=1 keeps the grid-step count
+        # (per-step sequencing + DMA issue overhead) minimal — the first
+        # on-chip probe of this kernel ran r_tile=8 and spent more on
+        # ~200k grid steps/train-step than the einsum path's matmuls
+        r_tile = Hp
+        ntr = 1
+        cxl = (cx / (2.0 ** i)).reshape(n, 1)
+        cyl = (cy / (2.0 ** i)).reshape(n, 1)
+        win = pl.pallas_call(
+            functools.partial(_pyr_lookup_kernel, radius=radius, w2p=W2p,
+                              r_tile=r_tile, q_tile=q_tile),
+            grid=(nqb, ntr),
+            in_specs=[
+                pl.BlockSpec((q_tile, r_tile, W2p),
+                             lambda qb, tb: (qb, tb, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((q_tile, 1), lambda qb, tb: (qb, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((q_tile, 1), lambda qb, tb: (qb, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((q_tile, k1, k1),
+                                   lambda qb, tb: (qb, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((n, k1, k1), jnp.float32),
+            interpret=interpret,
+        )(lvl.reshape(n, Hp, W2p), cxl, cyl)
+        win = win.reshape(B, Qp, k1 * k1)[:, :Q]
+        out.append(win.reshape(B, H1, W1, k1 * k1))
+    return jnp.concatenate(out, axis=-1)
+
+
+def stacked_pyramid_cotangent_pallas(d_win: jax.Array,
+                                     entry_coords: jax.Array,
+                                     radius: int, level_shapes,
+                                     level_dtypes,
+                                     q_tile: int = 64):
+    """Pallas twin of ``corr.stacked_pyramid_cotangent`` for PADDED
+    pyramids: d_pyramid levels (B, Qp, Hp_l, W2p_l) from the stacked
+    per-iteration window cotangents, one fused kernel launch per level.
+
+    Args mirror the XLA version; ``level_shapes`` are the padded
+    (Hp, W2p) extents.
+    """
+    it, B, H1, W1, _ = d_win.shape
+    Q = H1 * W1
+    k1 = 2 * radius + 1
+    k_win = k1 * k1
+    interpret = not _on_tpu()
+
+    cx = entry_coords[..., 0].reshape(it, B, Q).astype(jnp.float32)
+    cy = entry_coords[..., 1].reshape(it, B, Q).astype(jnp.float32)
+    gq = d_win.reshape(it, B, Q, -1)
+    Qp = -(-Q // q_tile) * q_tile
+    if Qp != Q:
+        cx = jnp.pad(cx, ((0, 0), (0, 0), (0, Qp - Q)), mode="edge")
+        cy = jnp.pad(cy, ((0, 0), (0, 0), (0, Qp - Q)), mode="edge")
+        gq = jnp.pad(gq, ((0, 0), (0, 0), (0, Qp - Q), (0, 0)))
+    n = B * Qp
+    nqb = n // q_tile
+    cx = cx.reshape(it, n, 1)
+    cy = cy.reshape(it, n, 1)
+
+    out = []
+    for lvl, ((Hp, W2p), dt) in enumerate(zip(level_shapes,
+                                              level_dtypes)):
+        # row blocks of 8 here (NOT whole-height): this kernel holds the
+        # (iters, q, k1, k1) g block plus per-iteration slab temporaries
+        # in VMEM — a whole-height f32 accumulator on top of that failed
+        # the Mosaic compile on v5e
+        r_tile = min(8, Hp)
+        if Hp % r_tile:
+            raise ValueError(
+                f"padded level height {Hp} must be a multiple of "
+                f"{r_tile} (build_corr_pyramid_padded row_pad_to) — a "
+                f"floored grid would leave trailing rows unwritten")
+        ntr = Hp // r_tile
+        # keep d_win's own dtype (bf16 under corr_dtype=bfloat16): the
+        # g block is the kernel's largest VMEM tenant (iters x q x k1^2)
+        gl = gq[..., lvl * k_win:(lvl + 1) * k_win].reshape(it, n, k1, k1)
+        inv = 1.0 / (2.0 ** lvl)
+        d_lvl = pl.pallas_call(
+            functools.partial(_pyr_cotangent_kernel, radius=radius,
+                              w2p=W2p, r_tile=r_tile, q_tile=q_tile,
+                              iters=it, out_dtype=dt),
+            grid=(nqb, ntr),
+            in_specs=[
+                pl.BlockSpec((it, q_tile, 1), lambda qb, tb: (0, qb, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((it, q_tile, 1), lambda qb, tb: (0, qb, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((it, q_tile, k1, k1),
+                             lambda qb, tb: (0, qb, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((q_tile, r_tile, W2p),
+                                   lambda qb, tb: (qb, tb, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((n, Hp, W2p), dt),
+            interpret=interpret,
+        )(cx * inv, cy * inv, gl)
+        out.append(d_lvl.reshape(B, Qp, Hp, W2p))
+    return tuple(out)
+
+
 def _rowloop_kernel(f1_ref, f2_ref, cx_ref, cy_ref, out_ref, rx_ref,
                     *, radius: int, w2: int, q_tile: int):
     """One (batch, query-block, target-row) grid step — the conservative
@@ -963,3 +1302,4 @@ def _bwd_xla(radius, q_tile, residuals, g):
 
 
 ondemand_corr_lookup.defvjp(_fwd, _bwd)
+pyramid_window_lookup.defvjp(_pyr_lookup_fwd, _pyr_lookup_bwd)

@@ -117,6 +117,14 @@ def main():
         # compare against "current" to re-measure the knob on new configs
         "deferred_grad": lambda: RAFTConfig(
             **{**base, "deferred_corr_grad": True}),
+        # round-4 fused dense-pyramid lookup kernels (padded layout);
+        # the _deferred combo additionally replaces the backward scan's
+        # select_add chain with the one-write fused cotangent kernel
+        "pallas_lookup": lambda: RAFTConfig(
+            **{**base, "lookup_impl": "pallas"}),
+        "pallas_lookup_deferred": lambda: RAFTConfig(
+            **{**base, "lookup_impl": "pallas",
+               "deferred_corr_grad": True}),
         "convs_saved": lambda: RAFTConfig(
             **{**base, "remat_policy": "convs_and_dots_saveable"}),
         "corr_f32": lambda: RAFTConfig(**{**base, "corr_dtype": "float32"}),
