@@ -1,0 +1,75 @@
+"""BASELINE config-5 multi-chip leg on the virtual CPU mesh.
+
+RAFT-large at the KITTI shape (375x1242 padded to 376x1248) with the
+correlation volume spatially sharded over a (data=2, spatial=4) mesh —
+the single-chip half of config 5 lives in ``tpu_validation.py config5``.
+Run with:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/config5_dryrun.py
+
+One jitted training step (iters=1 — scan length does not change the
+sharding semantics) must compile and execute with finite loss.  On a
+1-core host this takes several minutes of XLA CPU compile; the point is
+the GSPMD partitioning of the 47x156-fmap volume, not speed.
+"""
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    from raft_tpu.utils.platform import force_cpu
+
+    force_cpu()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.parallel import make_mesh, shard_batch
+    from raft_tpu.parallel.step import (make_parallel_train_step,
+                                        replicate_state)
+    from raft_tpu.training import create_train_state, make_optimizer
+
+    assert jax.device_count() >= 8, jax.device_count()
+    mesh = make_mesh(data=2, spatial=4, devices=jax.devices()[:8])
+
+    B, H, W = 2, 376, 1248  # KITTI 375x1242 padded to /8
+    rng = np.random.default_rng(0)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3))
+                              .astype(np.float32)),
+        "image2": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3))
+                              .astype(np.float32)),
+        "flow": jnp.asarray(rng.standard_normal((B, H, W, 2))
+                            .astype(np.float32)),
+        "valid": jnp.ones((B, H, W), np.float32),
+    }
+
+    model = RAFT(RAFTConfig(small=False, corr_shard=True))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=10, wdecay=1e-4)
+    t0 = time.time()
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=1)
+    state = replicate_state(state, mesh)
+    step = make_parallel_train_step(model, mesh, iters=1, gamma=0.8,
+                                    max_flow=400.0)
+    _, metrics = step(state, shard_batch(batch, mesh))
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss), loss
+    print(f"config5_dryrun: (data=2, spatial=4) mesh, B={B}, {H}x{W} "
+          f"(47x156 fmaps, sharded volume), loss={loss:.4f} OK "
+          f"({time.time() - t0:.0f}s)")
+
+
+if __name__ == "__main__":
+    main()

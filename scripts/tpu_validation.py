@@ -235,6 +235,75 @@ def run_accuracy():
     return ok
 
 
+def run_config5():
+    """BASELINE config 5 feasibility: RAFT-large 32-iter inference at the
+    KITTI shape (375x1242 padded to 376x1248), single chip.  Times the
+    all-pairs and on-demand paths and reports peak HBM — the numbers the
+    PARITY.md config-5 table records.  The multi-chip leg of config 5
+    (spatial-sharded volume) is exercised by dryrun_multichip on the
+    virtual CPU mesh (scripts/config5_dryrun.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.training.profiler import device_memory_stats
+
+    H, W = 376, 1248
+    rng = np.random.default_rng(0)
+    i1 = jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32))
+    i2 = jnp.asarray(rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32))
+
+    ok = True
+    for name, cfg in [
+        ("all_pairs_bf16", RAFTConfig(compute_dtype="bfloat16",
+                                      corr_dtype="bfloat16")),
+        ("chunked_bf16", RAFTConfig(compute_dtype="bfloat16",
+                                    corr_dtype="bfloat16",
+                                    alternate_corr=True,
+                                    corr_impl="chunked")),
+        ("pallas_bf16", RAFTConfig(compute_dtype="bfloat16",
+                                   corr_dtype="bfloat16",
+                                   alternate_corr=True,
+                                   corr_impl="pallas")),
+    ]:
+        try:
+            model = RAFT(cfg)
+            v = model.init(jax.random.PRNGKey(0), i1, i2, iters=1)
+            fn = jax.jit(lambda v, a, b, m=model: m.apply(
+                v, a, b, iters=32, test_mode=True))
+            out = fn(v, i1, i2)
+            float(np.asarray(out[1]).mean())
+            t0 = time.perf_counter()
+            for _ in range(5):
+                out = fn(v, i1, i2)
+            float(np.asarray(out[1]).mean())
+            dt = (time.perf_counter() - t0) / 5
+            peak = max((s.get("peak_bytes_in_use", -1)
+                        for s in device_memory_stats().values()),
+                       default=-1)
+            hbm = (f"{peak / 2 ** 30:.2f} GiB" if peak > 0
+                   else "n/a (axon tunnel reports no memory stats)")
+            # analytic corr-state footprint at this shape (the number the
+            # backend won't report): all-pairs pyramid vs fmap pyramid
+            q = (H // 8) * (W // 8)
+            vol = sum(q * ((H // 8) >> l) * ((W // 8) >> l) * 2
+                      for l in range(4))
+            fmaps = sum(((H // 8) >> l) * ((W // 8) >> l) * 256 * 2
+                        for l in range(4)) + q * 256 * 2
+            corr_bytes = vol if not cfg.alternate_corr else fmaps
+            print(f"[config5] {name:15s}: {dt * 1e3:7.1f} ms / 32-iter "
+                  f"pass @ {H}x{W}  peak HBM {hbm}; corr-state "
+                  f"{corr_bytes / 2 ** 20:.0f} MiB (B=1, bf16, "
+                  f"{'O((HW)^2) volume' if not cfg.alternate_corr else 'O(HW) fmaps'})")
+        except Exception as e:
+            print(f"[config5] {name:15s}: FAILED {type(e).__name__}: "
+                  f"{str(e)[:160]}")
+            ok = False
+    return ok
+
+
 def run_probe():
     r = subprocess.run(
         [sys.executable, "scripts/perf_probe.py", "current",
@@ -246,7 +315,8 @@ def run_probe():
 
 STAGES = {"kernel": run_kernel_tests, "bench": run_bench,
           "highres": run_highres, "train": run_train,
-          "accuracy": run_accuracy, "probe": run_probe}
+          "accuracy": run_accuracy, "probe": run_probe,
+          "config5": run_config5}
 
 
 def main():
