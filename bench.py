@@ -277,7 +277,11 @@ def main():
         fed0 = next(it)  # warm the pipeline (+ any reshape recompile)
         state, metrics = step(state, fed0)
         float(metrics["loss"])
-        n_fed = 2 if tiny else 10
+        # 20 timed fed steps (vs 10 for the device lane): the fed number
+        # is host-bound on this 1-core tunnel host and showed 6.5-10.8
+        # pairs/s run-to-run spread at 10 steps — twice the window halves
+        # the variance for ~8 s of extra bench time
+        n_fed = 2 if tiny else 20
         t0 = time.perf_counter()
         for _ in range(n_fed):
             state, metrics = step(state, next(it))

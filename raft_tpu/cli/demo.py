@@ -2,8 +2,9 @@
 
 Parity target: the reference's ``demo.py`` (demo.py:42-76): pairwise flow
 on consecutive frames, rendered with the Middlebury color wheel.  Output
-goes to ``--output`` as PNG collages (frame | flow) instead of a
-matplotlib window (headless TPU hosts).
+goes to ``--output`` as PNG collages (frame | flow) by default (headless
+TPU hosts); ``--show`` additionally opens the reference's interactive
+matplotlib window per pair (demo.py:33-35) when a display is available.
 """
 
 from __future__ import annotations
@@ -25,7 +26,24 @@ def parse_args(argv=None):
     p.add_argument("--output", default="demo_out")
     add_model_args(p)
     p.add_argument("--iters", type=int, default=20)  # demo.py:62
+    p.add_argument("--show", action="store_true",
+                   help="open each collage in a matplotlib window "
+                        "(the reference's viz(), demo.py:33-35) in "
+                        "addition to writing PNGs; requires a display")
     return p.parse_args(argv)
+
+
+def _show_collage(collage: np.ndarray) -> None:
+    """The reference's interactive viewer (demo.py:33-35): imshow the
+    (frame | flow) stack scaled to [0, 1] and block until closed."""
+    if not os.environ.get("DISPLAY") and os.name != "nt":
+        raise RuntimeError(
+            "--show needs a display (DISPLAY is unset); the PNG "
+            "collages in --output carry the same content")
+    import matplotlib.pyplot as plt
+
+    plt.imshow(collage / 255.0)
+    plt.show()
 
 
 def main(argv=None):
@@ -41,6 +59,8 @@ def main(argv=None):
         viz = flow_viz_image(flow).astype(np.float32)
         out = np.concatenate([image1, viz], axis=0)  # demo.py:26-39 layout
         save_image(os.path.join(args.output, f"flow_{i:04d}.png"), out)
+        if args.show:
+            _show_collage(out)
         print(f"{os.path.basename(p1)} -> {os.path.basename(p2)}: "
               f"|flow| max {np.abs(flow).max():.1f}px")
 

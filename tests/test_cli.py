@@ -58,6 +58,16 @@ def test_demo_flow_viz(small_ckpt, frame_dir, tmp_path):
     assert files == ["flow_0000.png", "flow_0001.png"]
 
 
+def test_demo_show_headless_raises_cleanly(monkeypatch):
+    """--show (the reference demo.py:33-35 interactive window) must fail
+    with a clear message on a headless host, not a backend crash."""
+    from raft_tpu.cli.demo import _show_collage
+
+    monkeypatch.delenv("DISPLAY", raising=False)
+    with pytest.raises(RuntimeError, match="needs a display"):
+        _show_collage(np.zeros((8, 8, 3), np.float32))
+
+
 @pytest.mark.slow
 def test_demo_warp_pair(small_ckpt, frame_dir, tmp_path):
     from raft_tpu.cli import demo_warp
