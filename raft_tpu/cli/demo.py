@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 
 import numpy as np
 
@@ -36,10 +37,13 @@ def parse_args(argv=None):
 def _show_collage(collage: np.ndarray) -> None:
     """The reference's interactive viewer (demo.py:33-35): imshow the
     (frame | flow) stack scaled to [0, 1] and block until closed."""
-    if not os.environ.get("DISPLAY") and os.name != "nt":
+    has_display = (os.environ.get("DISPLAY")
+                   or os.environ.get("WAYLAND_DISPLAY")
+                   or os.name == "nt" or sys.platform == "darwin")
+    if not has_display:
         raise RuntimeError(
-            "--show needs a display (DISPLAY is unset); the PNG "
-            "collages in --output carry the same content")
+            "--show needs a display (DISPLAY/WAYLAND_DISPLAY unset); the "
+            "PNG collages in --output carry the same content")
     import matplotlib.pyplot as plt
 
     plt.imshow(collage / 255.0)

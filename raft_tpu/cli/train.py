@@ -84,6 +84,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "faster at the chairs config; enable only for "
                         "larger-volume configs where the accumulation "
                         "chain's HBM traffic dominates)")
+    p.add_argument("--no_deferred_corr_grad", action="store_true",
+                   help="deprecated no-op: the deferred cotangent has "
+                        "defaulted OFF since the round-3 measurement; "
+                        "kept so pre-flip launch scripts keep running")
     p.add_argument("--datasets_root", default="datasets")
     p.add_argument("--checkpoint_dir", default="checkpoints")
     p.add_argument("--log_dir", default="runs")
@@ -112,6 +116,11 @@ def build_config(args):
 
     key = args.stage + ("_mixed" if args.mixed_precision else "")
     preset = STAGE_PRESETS[key]
+    if args.no_deferred_corr_grad and args.deferred_corr_grad:
+        raise SystemExit(
+            "--deferred_corr_grad and --no_deferred_corr_grad both given; "
+            "drop the deprecated --no_deferred_corr_grad (a no-op: OFF is "
+            "the default)")
     model = dataclasses.replace(
         preset.model,
         small=args.small,

@@ -104,10 +104,18 @@ def write_flow_kitti(path: str, flow: np.ndarray) -> None:
 
 
 def read_disp_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Read KITTI 16-bit PNG disparity packed as a flow field.
+
+    Matches readDispKITTI (frame_utils.py:109-113): disparity becomes the
+    horizontal flow component with sign flipped, `stack([-disp, 0])`, so
+    a stereo pair can feed the same flow pipeline.
+    """
     import cv2
 
     disp = cv2.imread(path, cv2.IMREAD_ANYDEPTH) / 256.0
-    return disp, (disp > 0.0).astype(np.float32)
+    valid = (disp > 0.0).astype(np.float32)
+    flow = np.stack([-disp, np.zeros_like(disp)], axis=-1)
+    return flow.astype(np.float32), valid
 
 
 def read_gen(path: str, pil: bool = False
@@ -119,6 +127,8 @@ def read_gen(path: str, pil: bool = False
     ext = os.path.splitext(path)[-1].lower()
     if ext in (".png", ".jpeg", ".ppm", ".jpg"):
         return Image.open(path)
+    if ext in (".bin", ".raw"):
+        return np.load(path)
     if ext == ".flo":
         return read_flow(path).astype(np.float32)
     if ext == ".pfm":

@@ -599,19 +599,22 @@ def padded_level_shapes(out_hw: Tuple[int, int], num_levels: int,
 
 def _pyr_lookup_fwd(pyramid, coords, radius, out_hw, q_tile):
     out = _pyr_lookup_forward(pyramid, coords, radius, out_hw, q_tile)
-    # dtype proxies only — custom_vjp residual leaves must be arrays,
-    # and the backward needs no pyramid VALUES (shapes reconstruct from
-    # out_hw via padded_level_shapes)
-    dtype_proxies = tuple(jnp.zeros((), p.dtype) for p in pyramid)
-    return out, (dtype_proxies, coords)
+    # shape/dtype proxies only — custom_vjp residual leaves must be
+    # arrays, and the backward needs no pyramid VALUES: a zero-length
+    # leading axis keeps each proxy empty while carrying the level's
+    # actual padded (Hp, W2p) extents and dtype, so the VJP works for
+    # ANY build_corr_pyramid_padded geometry, not just the defaults
+    shape_proxies = tuple(jnp.zeros((0,) + p.shape[2:], p.dtype)
+                          for p in pyramid)
+    return out, (shape_proxies, coords)
 
 
 def _pyr_lookup_bwd(radius, out_hw, q_tile, residuals, g):
-    dtype_proxies, coords = residuals
+    shape_proxies, coords = residuals
     d_pyr = stacked_pyramid_cotangent_pallas(
         g[None], coords[None], radius,
-        padded_level_shapes(out_hw, len(dtype_proxies)),
-        [p.dtype for p in dtype_proxies],
+        [tuple(p.shape[1:]) for p in shape_proxies],
+        [p.dtype for p in shape_proxies],
         q_tile=q_tile)
     return tuple(d_pyr), jnp.zeros_like(coords)
 
@@ -637,6 +640,23 @@ def _pyr_lookup_forward(pyramid, coords: jax.Array, radius: int,
             f"q_tile={q_tile} — build the pyramid with "
             f"build_corr_pyramid_padded(q_pad_to=q_tile); a floored "
             f"grid would silently leave trailing queries unwritten")
+    # The VJP rebuilds d_pyramid at Qp' = ceil(Q/q_tile)*q_tile — a
+    # pyramid whose q_pad_to disagrees with q_tile would only fail at
+    # custom_vjp shape-check time with an opaque error, so validate the
+    # one remaining layout coupling here (row/lane padding is free: the
+    # kernels and the VJP read each level's actual extents).
+    Qp_vjp = -(-Q // q_tile) * q_tile
+    for i, lvl in enumerate(pyramid):
+        if lvl.shape[1] != Qp_vjp:
+            raise ValueError(
+                f"pyramid level {i} has padded query axis {lvl.shape[1]}, "
+                f"but q_tile={q_tile} implies {Qp_vjp} for Q={Q} — build "
+                f"it with build_corr_pyramid_padded(q_pad_to={q_tile})")
+        if lvl.shape[2] % min(8, lvl.shape[2]):
+            raise ValueError(
+                f"pyramid level {i} padded height {lvl.shape[2]} must be "
+                f"a multiple of 8 (build_corr_pyramid_padded row_pad_to) "
+                f"for the cotangent kernel's row blocks")
     nqb = n // q_tile
 
     out = []

@@ -57,6 +57,35 @@ def test_kitti_png_roundtrip(tmp_path):
     assert (valid == 1).all()
 
 
+def test_read_gen_bin_raw(tmp_path):
+    """read_gen dispatches .bin/.raw to np.load (frame_utils.py:124-128)."""
+    arr = RNG.standard_normal((4, 6)).astype(np.float32)
+    for ext in (".bin", ".raw"):
+        p = str(tmp_path / f"x{ext}")
+        with open(p, "wb") as f:
+            np.save(f, arr)
+        np.testing.assert_array_equal(read_gen(p), arr)
+
+
+def test_read_disp_kitti_stacked_flow(tmp_path):
+    """Disparity comes back packed as stack([-disp, 0]) flow with a
+    disp>0 validity mask (frame_utils.py:109-113)."""
+    import cv2
+
+    from raft_tpu.data import read_disp_kitti
+
+    disp = np.zeros((5, 7), np.float32)
+    disp[1, 2] = 3.5
+    disp[4, 6] = 100.0
+    p = str(tmp_path / "d.png")
+    cv2.imwrite(p, (disp * 256.0).astype(np.uint16))
+    flow, valid = read_disp_kitti(p)
+    assert flow.shape == (5, 7, 2)
+    np.testing.assert_allclose(flow[..., 0], -disp)
+    np.testing.assert_array_equal(flow[..., 1], 0.0)
+    np.testing.assert_array_equal(valid, (disp > 0).astype(np.float32))
+
+
 def test_pfm_read(tmp_path):
     """Write a little-endian single-channel PFM by hand and read it."""
     data = RNG.standard_normal((6, 8)).astype("<f4")
