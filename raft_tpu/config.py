@@ -90,20 +90,37 @@ class RAFTConfig:
     # deferred_corr_grad=True the pyramid cotangent also runs as one
     # fused kernel per level (f32 VMEM accumulation over iterations, one
     # HBM write) instead of the backward scan's select_add chain.
-    # Incompatible with corr_shard (the kernel doesn't partition over a
+    # "pallas_stacked" — the ONE-LAUNCH variant: all pyramid levels ride
+    # a single pallas_call over a level-stacked uniform-slot layout
+    # (build_corr_pyramid_stacked), cutting kernel launches 4x (the
+    # round-4 diagnosis of the fused path's loss was 96 launches/step);
+    # the slots cost ~2x the padded pyramid's HBM footprint.
+    # Incompatible with corr_shard (the kernels don't partition over a
     # mesh) — validated below.
-    lookup_impl: str = "einsum"  # "einsum" | "pallas"
+    lookup_impl: str = "einsum"  # "einsum" | "pallas" | "pallas_stacked"
+    # Lane-pad the dense pyramid for the EINSUM lookup path: store levels
+    # in build_corr_pyramid_padded's explicit-zeros layout (rows to
+    # sublane multiples, width to 128 lanes).  TPU arrays are physically
+    # tiled to (sublane, 128) anyway, so the zeros cost no extra HBM —
+    # but they let the backward scan's volume-sized select_add chain and
+    # the lookup contractions run on full lanes instead of (e.g.) the
+    # 62/128-utilized minor dim of the chairs-config level 0 (the
+    # round-4 roofline's ~35 ms cluster).  Ignored on the sharded
+    # (corr_shard) and on-demand (alternate_corr) paths, and redundant
+    # under lookup_impl="pallas" (always padded there).
+    corr_pad_lanes: bool = True
 
     def __post_init__(self):
-        if self.lookup_impl not in ("einsum", "pallas"):
-            raise ValueError(f"lookup_impl must be 'einsum' or 'pallas', "
-                             f"got {self.lookup_impl!r}")
-        if self.lookup_impl == "pallas" and self.corr_shard:
+        if self.lookup_impl not in ("einsum", "pallas", "pallas_stacked"):
+            raise ValueError(f"lookup_impl must be 'einsum', 'pallas' or "
+                             f"'pallas_stacked', got {self.lookup_impl!r}")
+        if self.lookup_impl != "einsum" and self.corr_shard:
             raise ValueError(
-                "lookup_impl='pallas' runs a single-device fused kernel "
-                "and cannot partition the query axis over the 'spatial' "
-                "mesh axis — use lookup_impl='einsum' with corr_shard")
-        if self.lookup_impl == "pallas" and self.alternate_corr:
+                f"lookup_impl={self.lookup_impl!r} runs a single-device "
+                "fused kernel and cannot partition the query axis over "
+                "the 'spatial' mesh axis — use lookup_impl='einsum' with "
+                "corr_shard")
+        if self.lookup_impl != "einsum" and self.alternate_corr:
             raise ValueError(
                 "lookup_impl selects the DENSE-pyramid lookup and is "
                 "only consulted when alternate_corr=False — the "
@@ -301,5 +318,26 @@ STAGE_PRESETS = {
         DataConfig(stage="synthetic", image_size=(368, 496), batch_size=8),
         TrainConfig(name="raft-synthetic", lr=4e-4, num_steps=1000,
                     wdecay=1e-4, val_freq=500),
+    ),
+    # Augmented synthetic: the same dataset-free pairs run through the
+    # full dense augmentor (scale jitter makes flow magnitudes
+    # continuous).  The recipe for demonstrating DEPTH-STABLE refinement
+    # on one chip without datasets: train 4k steps at iters=12, then the
+    # held-out EPE must hold at the eval protocols' 24-32 iterations
+    # (scripts/tpu_validation.py depth).
+    "synthetic_aug": _stage(
+        RAFTConfig(remat=True, remat_policy="dots_saveable"),
+        DataConfig(stage="synthetic_aug", image_size=(368, 496),
+                   batch_size=8),
+        TrainConfig(name="raft-synthetic-aug", lr=4e-4, num_steps=4000,
+                    wdecay=1e-4, val_freq=2000),
+    ),
+    "synthetic_aug_mixed": _stage(
+        RAFTConfig(compute_dtype="bfloat16", remat=True,
+                   remat_policy="dots_saveable"),
+        DataConfig(stage="synthetic_aug", image_size=(368, 496),
+                   batch_size=8),
+        TrainConfig(name="raft-synthetic-aug", lr=4e-4, num_steps=4000,
+                    wdecay=1e-4, val_freq=2000),
     ),
 }

@@ -108,6 +108,16 @@ class RefinementStep(nn.Module):
             # remat policy would RECOMPUTE the kernel in the backward
             # scan (resolve_remat_policy saves the name)
             corr = checkpoint_name(corr, "corr_lookup")
+        elif cfg.lookup_impl == "pallas_stacked":
+            from jax.ad_checkpoint import checkpoint_name
+
+            from raft_tpu.ops.corr_pallas import (
+                pyramid_window_lookup_stacked)
+
+            corr = pyramid_window_lookup_stacked(
+                corr_state, coords1, cfg.corr_radius,
+                (coords1.shape[1], coords1.shape[2]))
+            corr = checkpoint_name(corr, "corr_lookup")
         else:
             corr = corr_lookup(corr_state, coords1, cfg.corr_radius,
                                shard=cfg.corr_shard)
@@ -227,6 +237,26 @@ class RAFT(nn.Module):
             pyramid = build_corr_pyramid_padded(fmap1, fmap2,
                                                 cfg.corr_levels, corr_dt)
             corr_state = tuple(pyramid)
+        elif cfg.lookup_impl == "pallas_stacked":
+            # One-launch variant: all levels in a uniform-slot stack
+            # (build_corr_pyramid_stacked) served by a single pallas_call
+            # with a (query-block, level) grid.
+            from raft_tpu.ops.corr import build_corr_pyramid_stacked
+
+            corr_state = build_corr_pyramid_stacked(fmap1, fmap2,
+                                                    cfg.corr_levels,
+                                                    corr_dt)
+        elif cfg.corr_pad_lanes and not cfg.corr_shard:
+            # Same math in the lane-padded explicit-zeros layout: the
+            # minor dims are physically tiled to (sublane, 128) either
+            # way, so the zeros are free in HBM while the backward
+            # scan's select_add accumulation over the pyramid cotangent
+            # runs full-lane (see RAFTConfig.corr_pad_lanes).
+            # corr_lookup consumes the padded levels directly (padded
+            # taps are exact zeros = the OOB semantics).
+            pyramid = build_corr_pyramid_padded(fmap1, fmap2,
+                                                cfg.corr_levels, corr_dt)
+            corr_state = tuple(pyramid)
         else:
             # Each level as a matmul against pooled fmap2 (exactly equal to
             # pooling the full volume — see build_corr_pyramid_direct); the
@@ -285,8 +315,17 @@ class RAFT(nn.Module):
         if use_deferred:
             corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1) ** 2
             win_zeros = jnp.zeros((iters, B, H8, W8, corr_ch), corr_dt)
-            level_shapes = [p.shape[2:] for p in corr_state]
-            level_dtypes = [p.dtype for p in corr_state]
+            stacked_layout = cfg.lookup_impl == "pallas_stacked"
+            if stacked_layout:
+                slot_shape = corr_state.shape[2:]
+                slot_dtype = corr_state.dtype
+            else:
+                level_shapes = [p.shape[2:] for p in corr_state]
+                level_dtypes = [p.dtype for p in corr_state]
+                # lane-padded pyramids carry a padded query axis too —
+                # the rebuilt cotangent must match the primal's shape
+                q_pad = (corr_state[0].shape[1]
+                         if corr_state[0].shape[1] != H8 * W8 else None)
 
             def f(mdl, pyramid, win_bias, carry0, inp_, coords0_):
                 return mdl(carry0, inp_, pyramid, coords0_, win_bias)
@@ -310,7 +349,14 @@ class RAFT(nn.Module):
                 vjp_fn, entry = residuals
                 params_t, win_t, carry0_t, inp_t, coords0_t = vjp_fn(
                     cotangents)
-                if cfg.lookup_impl == "pallas":
+                if stacked_layout:
+                    from raft_tpu.ops.corr_pallas import (
+                        stacked_pyramid_cotangent_stacked)
+
+                    pyr_t = stacked_pyramid_cotangent_stacked(
+                        win_t, entry, cfg.corr_radius, slot_shape,
+                        slot_dtype)
+                elif cfg.lookup_impl == "pallas":
                     from raft_tpu.ops.corr_pallas import (
                         stacked_pyramid_cotangent_pallas)
 
@@ -320,7 +366,8 @@ class RAFT(nn.Module):
                 else:
                     pyr_t = stacked_pyramid_cotangent(
                         win_t, entry, cfg.corr_radius, level_shapes,
-                        level_dtypes, shard=cfg.corr_shard)
+                        level_dtypes, shard=cfg.corr_shard,
+                        q_padded=q_pad)
                 return (params_t, pyr_t, win_t, carry0_t, inp_t, coords0_t)
 
             refine = nn.custom_vjp(f, forward_fn=fwd, backward_fn=bwd)

@@ -23,7 +23,7 @@ order it is fed, but imports of reference weights require matching it.
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -112,21 +112,15 @@ def build_corr_pyramid_direct(fmap1: jax.Array, fmap2: jax.Array,
     return pyramid
 
 
-def build_corr_pyramid_padded(fmap1: jax.Array, fmap2: jax.Array,
-                              num_levels: int = 4, dtype=jnp.float32,
-                              q_pad_to: int = 64, row_pad_to: int = 8,
-                              lane: int = 128) -> List[jax.Array]:
-    """``build_corr_pyramid_direct`` in the Pallas lookup's native layout.
+def _build_padded_levels(fmap1: jax.Array, fmap2: jax.Array,
+                         num_levels: int, dtype, q_pad_to: int,
+                         extents_fn) -> List[jax.Array]:
+    """Shared body of the explicit-zeros padded pyramid builders.
 
-    Levels come out (B, Qp, Hp_l, W2p_l): the query axis zero-padded to a
-    whole number of kernel query tiles, each level's target rows padded
-    to ``row_pad_to`` and its width to whole ``lane`` groups — all with
-    EXPLICIT zeros (padded queries have zero features, padded targets
-    enter the matmul as zero rows), so the lookup kernels never touch
-    uninitialized VMEM and out-of-range bilinear taps read exact zeros
-    (the oracle's OOB semantics).  The padding costs extra MXU work on
-    zero columns (~2x at a 62-wide level 0) — cheap against the lookup
-    contractions it unlocks (see corr_pallas.pyramid_window_lookup).
+    ``extents_fn(Hl, Wl) -> (rows, width)`` chooses each level's padded
+    target extents; everything else (query padding, dtype policy, f32
+    pooling chain, scaled einsum) is identical between the per-level
+    and uniform-slot layouts and must not diverge.
     """
     B, H, W, C = fmap1.shape
     _check_pyramid_depth(H, W, num_levels)
@@ -143,8 +137,7 @@ def build_corr_pyramid_padded(fmap1: jax.Array, fmap2: jax.Array,
         if lvl:
             f2 = avg_pool2x(f2)
         Hl, Wl = f2.shape[1], f2.shape[2]
-        Hp = -(-Hl // row_pad_to) * row_pad_to
-        W2p = -(-Wl // lane) * lane
+        Hp, W2p = extents_fn(Hl, Wl)
         f2p = jnp.pad(f2, ((0, 0), (0, Hp - Hl), (0, W2p - Wl), (0, 0)))
         corr = jnp.einsum("bqc,btc->bqt", f1,
                           f2p.reshape(B, Hp * W2p, C).astype(in_dt),
@@ -152,6 +145,56 @@ def build_corr_pyramid_padded(fmap1: jax.Array, fmap2: jax.Array,
         pyramid.append((corr * scale).reshape(B, Qp, Hp, W2p)
                        .astype(dtype))
     return pyramid
+
+
+def build_corr_pyramid_padded(fmap1: jax.Array, fmap2: jax.Array,
+                              num_levels: int = 4, dtype=jnp.float32,
+                              q_pad_to: int = 64, row_pad_to: int = 8,
+                              lane: int = 128) -> List[jax.Array]:
+    """``build_corr_pyramid_direct`` in the Pallas lookup's native layout.
+
+    Levels come out (B, Qp, Hp_l, W2p_l): the query axis zero-padded to a
+    whole number of kernel query tiles, each level's target rows padded
+    to ``row_pad_to`` and its width to whole ``lane`` groups — all with
+    EXPLICIT zeros (padded queries have zero features, padded targets
+    enter the matmul as zero rows), so the lookup kernels never touch
+    uninitialized VMEM and out-of-range bilinear taps read exact zeros
+    (the oracle's OOB semantics).  The zeros are free in HBM — TPU
+    arrays tile the minor dims to (sublane, 128) physically anyway —
+    which is also why this layout serves cfg.corr_pad_lanes on the
+    einsum path (full-lane select_add accumulation in the backward
+    scan).
+    """
+    return _build_padded_levels(
+        fmap1, fmap2, num_levels, dtype, q_pad_to,
+        lambda Hl, Wl: (-(-Hl // row_pad_to) * row_pad_to,
+                        -(-Wl // lane) * lane))
+
+
+def build_corr_pyramid_stacked(fmap1: jax.Array, fmap2: jax.Array,
+                               num_levels: int = 4, dtype=jnp.float32,
+                               q_pad_to: int = 64, row_pad_to: int = 8,
+                               lane: int = 128) -> jax.Array:
+    """All pyramid levels in ONE uniform-slot array (B, Qp, L, S, Wp).
+
+    The layout behind the one-launch-per-lookup Pallas variant
+    (corr_pallas.pyramid_window_lookup_stacked): every level sits in an
+    identical (S, Wp) slot — S/Wp are level 0's padded extents, the
+    maximum over levels — so a single pallas_call with a (query-block,
+    level) grid serves all levels, cutting kernel launches 4x vs the
+    per-level padded layout (the round-4 diagnosis of why the fused
+    dense lookup lost to XLA einsums was 96 launches/train-step).  The
+    price is slot waste: coarse levels occupy the same slot as level 0
+    (~2x the padded pyramid's footprint at the chairs config).  Zeros
+    are explicit, like build_corr_pyramid_padded.
+    """
+    B, H, W, _ = fmap1.shape
+    _check_pyramid_depth(H, W, num_levels)
+    S = -(-H // row_pad_to) * row_pad_to
+    Wp = -(-W // lane) * lane
+    levels = _build_padded_levels(fmap1, fmap2, num_levels, dtype,
+                                  q_pad_to, lambda Hl, Wl: (S, Wp))
+    return jnp.stack(levels, axis=2)
 
 
 def _check_pyramid_depth(h: int, w: int, num_levels: int) -> None:
@@ -231,8 +274,20 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
     runs entirely on the MXU as batched matmuls.  Ordering matches the
     reference's x-major window flattening (corr.py:37-44).
 
+    Also accepts a LANE-PADDED pyramid (``build_corr_pyramid_padded``,
+    levels (B, Qp, Hp_l, W2p_l)): because the padding is explicit zeros,
+    one-hot taps landing in it contribute exactly zero — the unpadded
+    path's OOB semantics — so the same contractions are correct
+    unchanged; only the query axis needs pad/slice plumbing.  Why you'd
+    want that: TPU arrays tile the two minor dims to (sublane, 128)
+    physically ANYWAY, so a 62-wide level-0 minor dim occupies full
+    128-lane tiles at 48% utilization — explicit zeros cost no extra
+    HBM while letting every elementwise/accumulate op (notably the
+    backward scan's volume-sized select_add chain) run full-lane.
+
     Args:
-      pyramid: list of (B, Q, H_l, W_l) volumes, Q = H1*W1.
+      pyramid: list of (B, Q, H_l, W_l) volumes, Q = H1*W1 — or their
+        zero-padded (B, Qp, Hp_l, W2p_l) counterparts.
       coords: (B, H1, W1, 2) query coordinates at level 0, (x, y).
       radius: window radius r.
       shard: re-pin the (batch, query)-axis mesh sharding through the
@@ -244,10 +299,18 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
     """
     B, H1, W1, _ = coords.shape
     Q = H1 * W1
-    N = B * Q
+    Qp = pyramid[0].shape[1]
+    N = B * Qp
     k1 = 2 * radius + 1
-    cx = coords[..., 0].reshape(N).astype(jnp.float32)
-    cy = coords[..., 1].reshape(N).astype(jnp.float32)
+    cx = coords[..., 0].reshape(B, Q).astype(jnp.float32)
+    cy = coords[..., 1].reshape(B, Q).astype(jnp.float32)
+    if Qp != Q:
+        # padded queries have all-zero volume rows (zero f1 features), so
+        # any in-range coordinate works; edge mode keeps them finite
+        cx = jnp.pad(cx, ((0, 0), (0, Qp - Q)), mode="edge")
+        cy = jnp.pad(cy, ((0, 0), (0, Qp - Q)), mode="edge")
+    cx = cx.reshape(N)
+    cy = cy.reshape(N)
     out = []
     for i, corr in enumerate(pyramid):
         H2, W2 = corr.shape[2], corr.shape[3]
@@ -276,6 +339,9 @@ def corr_lookup(pyramid: Sequence[jax.Array], coords: jax.Array,
         win = jnp.einsum("nkw,njw->njk", a, rx,
                          preferred_element_type=jnp.float32,
                          precision=prec)  # (N, kx, ky)
+        win = win.reshape(B, Qp, k1 * k1)
+        if Qp != Q:
+            win = win[:, :Q]
         out.append(win.reshape(B, H1, W1, k1 * k1))
     return jnp.concatenate(out, axis=-1).astype(jnp.float32)
 
@@ -284,7 +350,8 @@ def stacked_pyramid_cotangent(d_win: jax.Array, entry_coords: jax.Array,
                               radius: int,
                               level_shapes: Sequence[tuple],
                               level_dtypes: Sequence,
-                              shard: bool = False):
+                              shard: bool = False,
+                              q_padded: Optional[int] = None):
     """d_pyramid from the stacked per-iteration window cotangents.
 
     The lookup is LINEAR in the pyramid (coords are stop_gradient'd per
@@ -302,16 +369,22 @@ def stacked_pyramid_cotangent(d_win: jax.Array, entry_coords: jax.Array,
       d_win: (iters, B, H1, W1, L*(2r+1)^2) f32 stacked window cotangents.
       entry_coords: (iters, B, H1, W1, 2) lookup coordinates at each
         iteration ENTRY (i.e. what corr_lookup saw).
-      level_shapes: [(H_l, W_l), ...] target extents per level.
+      level_shapes: [(H_l, W_l), ...] target extents per level (padded
+        extents for a lane-padded pyramid — taps in the zero padding
+        contribute zero, so the same contraction is exact).
       level_dtypes: pyramid dtypes per level (cotangent dtype must match
         the primal's).
+      q_padded: the primal pyramid's padded query axis Qp when it came
+        from ``build_corr_pyramid_padded`` — the cotangent must match
+        the primal's shape; padded queries get zero cotangent.
 
     Returns:
-      tuple of (B, H1*W1, H_l, W_l) arrays.
+      tuple of (B, Qp or H1*W1, H_l, W_l) arrays.
     """
     it, B, H1, W1, _ = d_win.shape
     Q = H1 * W1
-    N = B * Q
+    Qp = q_padded or Q
+    N = B * Qp
     k1 = 2 * radius + 1
     # Bound the one-hot/intermediate transients: the stacked contraction
     # over all iterations at once would materialize ry/rx/tmp `iters`x
@@ -320,8 +393,17 @@ def stacked_pyramid_cotangent(d_win: jax.Array, entry_coords: jax.Array,
     # structure (ceil(iters/chunk) accumulate-adds instead of `iters`)
     # with per-chunk transients.
     chunk = min(4, it)
-    cx = entry_coords[..., 0].reshape(it, N, 1).astype(jnp.float32)
-    cy = entry_coords[..., 1].reshape(it, N, 1).astype(jnp.float32)
+    cx = entry_coords[..., 0].reshape(it, B, Q).astype(jnp.float32)
+    cy = entry_coords[..., 1].reshape(it, B, Q).astype(jnp.float32)
+    d_q = d_win.reshape(it, B, Q, -1)
+    if Qp != Q:
+        # zero cotangent + zero coords for the padded queries: their
+        # one-hot rows contribute nothing (coord 0 is in-range, finite)
+        cx = jnp.pad(cx, ((0, 0), (0, 0), (0, Qp - Q)))
+        cy = jnp.pad(cy, ((0, 0), (0, 0), (0, Qp - Q)))
+        d_q = jnp.pad(d_q, ((0, 0), (0, 0), (0, Qp - Q), (0, 0)))
+    cx = cx.reshape(it, N, 1)
+    cy = cy.reshape(it, N, 1)
 
     def _constrain(x):
         if not shard:
@@ -340,7 +422,7 @@ def stacked_pyramid_cotangent(d_win: jax.Array, entry_coords: jax.Array,
         cdt = jnp.bfloat16 if dt == jnp.bfloat16 else jnp.float32
         prec = (jax.lax.Precision.DEFAULT if cdt == jnp.bfloat16
                 else jax.lax.Precision.HIGHEST)
-        D_lvl = d_win[..., ofs:ofs + k1 * k1].reshape(it, N, k1, k1) \
+        D_lvl = d_q[..., ofs:ofs + k1 * k1].reshape(it, N, k1, k1) \
             .astype(cdt)
         ofs += k1 * k1
         acc = None
@@ -363,7 +445,7 @@ def stacked_pyramid_cotangent(d_win: jax.Array, entry_coords: jax.Array,
                               preferred_element_type=jnp.float32,
                               precision=prec)
             acc = part if acc is None else acc + part
-        out.append(acc.reshape(B, Q, H2, W2).astype(dt))
+        out.append(acc.reshape(B, Qp, H2, W2).astype(dt))
     return tuple(out)
 
 

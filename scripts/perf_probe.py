@@ -127,6 +127,11 @@ def main():
                "deferred_corr_grad": True}),
         "convs_saved": lambda: RAFTConfig(
             **{**base, "remat_policy": "convs_and_dots_saveable"}),
+        # round-5 lane-padded dense pyramid (corr_pad_lanes, default ON):
+        # A/B against the unpadded layout the round-4 roofline flagged
+        # (62-lane minor dim = 38% HBM efficiency on the select_add chain)
+        "no_pad_lanes": lambda: RAFTConfig(
+            **{**base, "corr_pad_lanes": False}),
         "corr_f32": lambda: RAFTConfig(**{**base, "corr_dtype": "float32"}),
         "fwd_only": lambda: RAFTConfig(**base),
         # things-config accumulation sweep (batch 6 at 400x720,

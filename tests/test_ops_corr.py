@@ -93,6 +93,72 @@ def test_corr_lookup_matches_reference_corrblock():
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
 
 
+def test_corr_lookup_on_padded_pyramid_matches_direct():
+    """corr_lookup consumes a lane-padded pyramid (build_corr_pyramid_
+    padded) unchanged: padded taps are exact zeros = the OOB semantics,
+    and the padded query rows are sliced off.  Forward AND pyramid
+    gradient must match the unpadded path in the real region."""
+    from raft_tpu.ops.corr import (build_corr_pyramid_direct,
+                                   build_corr_pyramid_padded)
+
+    B, H, W, C = 2, 6, 9, 16  # W=9 -> levels 9/4/2 all far from lane=16
+    levels, radius = 3, 2
+    f1 = jnp.asarray(RNG.standard_normal((B, H, W, C)).astype(np.float32))
+    f2 = jnp.asarray(RNG.standard_normal((B, H, W, C)).astype(np.float32))
+    # include OOB coords to exercise the zero-tap boundary
+    coords = jnp.asarray(
+        (RNG.uniform(-3, [W + 2, H + 2], size=(B, H, W, 2)))
+        .astype(np.float32))
+
+    dense = build_corr_pyramid_direct(f1, f2, levels)
+    padded = build_corr_pyramid_padded(f1, f2, levels, q_pad_to=32,
+                                       row_pad_to=4, lane=16)
+    ref = corr_lookup(dense, coords, radius)
+    out = corr_lookup(padded, coords, radius)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # gradient wrt the feature maps through each pyramid construction
+    key = jnp.asarray(RNG.standard_normal(np.asarray(ref).shape)
+                      .astype(np.float32))
+    g_ref = jax.grad(lambda a, b: jnp.sum(corr_lookup(
+        build_corr_pyramid_direct(a, b, levels), coords, radius) * key),
+        argnums=(0, 1))(f1, f2)
+    g_pad = jax.grad(lambda a, b: jnp.sum(corr_lookup(
+        build_corr_pyramid_padded(a, b, levels, q_pad_to=32, row_pad_to=4,
+                                  lane=16), coords, radius) * key),
+        argnums=(0, 1))(f1, f2)
+    for r, p in zip(g_ref, g_pad):
+        np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_stacked_cotangent_q_padded():
+    """The deferred-grad cotangent rebuild emits primal-shaped (padded-Q)
+    levels when the pyramid is lane-padded; padded queries get zeros."""
+    from raft_tpu.ops.corr import stacked_pyramid_cotangent
+
+    it, B, H1, W1 = 2, 1, 4, 6
+    radius = 1
+    k = (2 * radius + 1) ** 2
+    d_win = jnp.asarray(RNG.standard_normal(
+        (it, B, H1, W1, 2 * k)).astype(np.float32))
+    base = np.stack(np.meshgrid(np.arange(W1), np.arange(H1)), -1)
+    entry = jnp.asarray((RNG.standard_normal((it, B, H1, W1, 2))
+                         + base[None, None]).astype(np.float32))
+    shapes = [(4, 6), (2, 3)]
+    ref = stacked_pyramid_cotangent(d_win, entry, radius, shapes,
+                                    [jnp.float32] * 2)
+    out = stacked_pyramid_cotangent(d_win, entry, radius, shapes,
+                                    [jnp.float32] * 2, q_padded=32)
+    Q = H1 * W1
+    for r, p in zip(ref, out):
+        assert p.shape[1] == 32
+        np.testing.assert_allclose(np.asarray(p[:, :Q]), np.asarray(r),
+                                   rtol=1e-5, atol=1e-5)
+        assert float(jnp.abs(p[:, Q:]).max()) == 0.0
+
+
 def test_alternate_equals_all_pairs():
     """Pooling/sampling are linear in fmap2, so the O(HW) on-demand path must
     agree exactly with the materialized volume (SURVEY.md §2 #5)."""
