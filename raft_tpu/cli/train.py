@@ -30,9 +30,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--name", default=None, help="experiment name")
     p.add_argument("--stage", required=True,
                    choices=["chairs", "things", "sintel", "kitti",
-                            "synthetic"],
+                            "synthetic", "synthetic_aug"],
                    help="training stage preset; 'synthetic' needs no "
-                        "on-disk dataset (random-shift pairs, exact GT)")
+                        "on-disk dataset (random-shift pairs, exact GT); "
+                        "'synthetic_aug' adds the full dense augmentor")
     p.add_argument("--restore_ckpt", default=None,
                    help="params-only restore for curriculum transfer "
                         "(strict=False analogue, train.py:141-142)")
@@ -54,6 +55,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     # TPU-native replacements for --gpus
     p.add_argument("--data_parallel", type=int, default=1,
                    help="devices on the mesh data axis (replaces --gpus)")
+    p.add_argument("--multihost", action="store_true",
+                   help="initialize jax.distributed before anything else "
+                        "(TPU pods autodetect; CPU/GPU fleets set "
+                        "COORDINATOR_ADDRESS + NUM_PROCESSES + "
+                        "PROCESS_ID).  Each process then decodes only "
+                        "its slice of every global batch and feeds only "
+                        "its own devices")
     p.add_argument("--spatial_parallel", type=int, default=1,
                    help="devices sharding the corr-volume query axis")
     p.add_argument("--corr_shard_impl", default="gspmd",
@@ -181,6 +189,12 @@ def run_validation(model, variables, names,
 
 
 def train(args) -> str:
+    if getattr(args, "multihost", False):
+        # must precede every other jax call in the process
+        from raft_tpu.parallel import initialize_distributed
+
+        initialize_distributed(force=True)
+
     import jax
 
     from raft_tpu.config import RAFTConfig
@@ -205,9 +219,15 @@ def train(args) -> str:
                             root=data_cfg.root, seed=train_cfg.seed)
     loader = DataLoader(dataset, data_cfg.batch_size,
                         num_workers=data_cfg.num_workers,
-                        seed=train_cfg.seed)
+                        seed=train_cfg.seed,
+                        process_index=jax.process_index(),
+                        process_count=jax.process_count())
     print(f"stage={data_cfg.stage} dataset={len(dataset)} samples, "
-          f"batch={data_cfg.batch_size}, steps={train_cfg.num_steps}")
+          f"batch={data_cfg.batch_size}"
+          + (f" ({loader.local_batch_size}/process x "
+             f"{jax.process_count()} processes)"
+             if jax.process_count() > 1 else "")
+          + f", steps={train_cfg.num_steps}")
 
     tx, schedule = make_optimizer(train_cfg.lr, train_cfg.num_steps,
                                   train_cfg.wdecay, train_cfg.epsilon,
@@ -225,9 +245,25 @@ def train(args) -> str:
                          spatial=args.spatial_parallel)
     mesh_ctx = jax.set_mesh(mesh) if mesh else contextlib.nullcontext()
 
-    # Parameter init from one real batch.
+    # Batch sharding, computed before init so the multi-host guard below
+    # can fail fast when no mesh was requested.
+    sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from raft_tpu.parallel.mesh import batch_spec
+        sharding = NamedSharding(mesh, batch_spec())
+
+    # Parameter init from one real batch.  Under multi-host each process
+    # inits from its LOCAL slice — parameters are batch-size-independent
+    # and the shared seed makes them identical everywhere; replicate_state
+    # then places them on the global mesh.
     first = next(iter(loader))
     init_batch = {k: v for k, v in first.items() if k != "extra_info"}
+    if jax.process_count() > 1 and sharding is None:
+        raise SystemExit(
+            "multi-host training needs a device mesh: set "
+            "--data_parallel/--spatial_parallel to cover all "
+            f"{jax.device_count()} global devices")
     with mesh_ctx:
         state = create_train_state(model, tx,
                                    jax.random.PRNGKey(train_cfg.seed),
@@ -251,7 +287,6 @@ def train(args) -> str:
         print(f"restored params from {train_cfg.restore_ckpt}")
 
     # Sharded step when parallelism is requested.
-    sharding = None
     if mesh is not None:
         state = replicate_state(state, mesh)
         step = make_parallel_train_step(
@@ -259,9 +294,6 @@ def train(args) -> str:
             max_flow=train_cfg.max_flow, freeze_bn=train_cfg.freeze_bn,
             add_noise=train_cfg.add_noise, donate=True,
             accum_steps=args.grad_accum)
-        from jax.sharding import NamedSharding
-        from raft_tpu.parallel.mesh import batch_spec
-        sharding = NamedSharding(mesh, batch_spec())
     else:
         step = make_train_step(
             model, iters=train_cfg.iters, gamma=train_cfg.gamma,

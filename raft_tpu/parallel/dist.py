@@ -15,7 +15,8 @@ from typing import Optional
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> None:
+                           process_id: Optional[int] = None,
+                           force: bool = False) -> None:
     """Initialize jax.distributed when running multi-host.
 
     No-ops on single-host (the common dev path).  On TPU pods the runtime
@@ -34,8 +35,19 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         return
     if coordinator_address is None and "COORDINATOR_ADDRESS" in os.environ:
         coordinator_address = os.environ["COORDINATOR_ADDRESS"]
+    # Plain CPU/GPU fleets have no cluster autodetection: they must also
+    # supply the process count and this process's id (env names mirror
+    # the jax.distributed arguments).
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
     if coordinator_address is None and num_processes is None:
-        # single host — nothing to do
+        if force:
+            # TPU-pod path: the runtime autodetects coordinator/peers
+            # (cli/train.py --multihost)
+            jax.distributed.initialize()
+        # else single host — nothing to do
         return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,

@@ -353,6 +353,32 @@ def test_loader_determinism_and_shapes(synth_root, tmp_path):
     assert not np.array_equal(b1["image1"], b3["image1"])
 
 
+def test_loader_process_sharding():
+    """Multi-host slicing: N loaders with process_index=0..N-1 walk the
+    same epoch permutation and take disjoint contiguous slices of every
+    global batch, reassembling exactly the unsharded loader's batches."""
+    from raft_tpu.data.datasets import SyntheticShift
+
+    ds = SyntheticShift(image_size=(16, 16), length=10, max_shift=2, seed=1)
+    kw = dict(batch_size=4, num_workers=1, seed=5, shuffle=True)
+    full = list(DataLoader(ds, **kw))
+    p0 = list(DataLoader(ds, **kw, process_index=0, process_count=2))
+    p1 = list(DataLoader(ds, **kw, process_index=1, process_count=2))
+    assert len(full) == len(p0) == len(p1) == 2  # 10 // 4
+    for fb, a, b in zip(full, p0, p1):
+        assert a["image1"].shape[0] == b["image1"].shape[0] == 2
+        np.testing.assert_array_equal(
+            fb["image1"], np.concatenate([a["image1"], b["image1"]]))
+        np.testing.assert_array_equal(
+            fb["flow"], np.concatenate([a["flow"], b["flow"]]))
+
+    with pytest.raises(ValueError, match="divide evenly"):
+        DataLoader(ds, batch_size=5, process_index=0, process_count=2)
+    with pytest.raises(ValueError, match="pad_remainder"):
+        DataLoader(ds, batch_size=4, pad_remainder=True, drop_last=False,
+                   process_index=0, process_count=2)
+
+
 def test_synthetic_shift_dataset_exact_correspondence():
     """SyntheticShift: img2(p + flow) == img1(p) exactly wherever valid,
     deterministic per (seed, epoch, index), and reachable via
