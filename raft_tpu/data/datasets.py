@@ -374,6 +374,20 @@ def fetch_dataset(stage: str, image_size, root: str = "datasets",
         # as base textures; otherwise procedural noise.
         frames_dir = root if root and osp.isdir(root) else None
         return SyntheticShift(crop, frames_dir=frames_dir, seed=seed)
+    if stage == "synthetic_aug":
+        # Same dataset-free stage, run through the full dense augmentor
+        # (jitter/scale/stretch/flip/crop — the chairs recipe's host-side
+        # cost).  The scale jitter turns the integer shifts into a
+        # continuous magnitude distribution, which is what makes longer
+        # runs depth-stable: the update operator sees flows it must
+        # REFINE rather than a lattice it can memorize.  Base images
+        # carry a margin so the augmentor always has room to crop.
+        frames_dir = root if root and osp.isdir(root) else None
+        base = (crop[0] + 64, crop[1] + 64)
+        return SyntheticShift(
+            base, frames_dir=frames_dir, seed=seed,
+            aug_params=dict(crop_size=crop, min_scale=-0.2, max_scale=0.4,
+                            do_flip=True))
     if stage == "chairs":
         aug = dict(crop_size=crop, min_scale=-0.1, max_scale=1.0, do_flip=True)
         return FlyingChairs(aug, split="training",
