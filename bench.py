@@ -139,8 +139,14 @@ def _make_fed_loader(B, H, W, seed: int = 1):
         image_size=(H + 32, W + 32), length=512, seed=seed,
         aug_params=dict(crop_size=(H, W), min_scale=0.0, max_scale=0.2,
                         do_flip=True))
-    return DataLoader(ds, batch_size=B, num_workers=4, drop_last=True,
-                      seed=seed, prefetch=3)
+    # Workers capped at the core count: on the 1-core tunnel host, 4
+    # threads time-slicing one core add GIL/scheduler thrash on top of
+    # the ~27 ms/sample augment cost — the source of the round-4 fed
+    # lane's 2x run-to-run spread (6.5-10.8 pairs/s); a worker per core
+    # is the stable configuration, and real TPU-VM hosts have >= 4.
+    workers = max(1, min(4, os.cpu_count() or 4))
+    return DataLoader(ds, batch_size=B, num_workers=workers,
+                      drop_last=True, seed=seed, prefetch=3)
 
 
 def main():
@@ -277,11 +283,11 @@ def main():
         fed0 = next(it)  # warm the pipeline (+ any reshape recompile)
         state, metrics = step(state, fed0)
         float(metrics["loss"])
-        # 20 timed fed steps (vs 10 for the device lane): the fed number
-        # is host-bound on this 1-core tunnel host and showed 6.5-10.8
-        # pairs/s run-to-run spread at 10 steps — twice the window halves
-        # the variance for ~8 s of extra bench time
-        n_fed = 2 if tiny else 20
+        # 30 timed fed steps (vs 10 for the device lane): the fed number
+        # is host-bound on this 1-core tunnel host; a longer window plus
+        # the worker-per-core loader cap above bounds the run-to-run
+        # spread that round 4 measured at 2x
+        n_fed = 2 if tiny else 30
         t0 = time.perf_counter()
         for _ in range(n_fed):
             state, metrics = step(state, next(it))

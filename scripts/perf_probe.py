@@ -125,6 +125,13 @@ def main():
         "pallas_lookup_deferred": lambda: RAFTConfig(
             **{**base, "lookup_impl": "pallas",
                "deferred_corr_grad": True}),
+        # round-5 one-launch variant: all levels in a single pallas_call
+        # (answers the 96-launches diagnosis head-on)
+        "pallas_stacked": lambda: RAFTConfig(
+            **{**base, "lookup_impl": "pallas_stacked"}),
+        "pallas_stacked_deferred": lambda: RAFTConfig(
+            **{**base, "lookup_impl": "pallas_stacked",
+               "deferred_corr_grad": True}),
         "convs_saved": lambda: RAFTConfig(
             **{**base, "remat_policy": "convs_and_dots_saveable"}),
         # round-5 lane-padded dense pyramid (corr_pad_lanes, default ON):
@@ -140,16 +147,28 @@ def main():
         "things_accum1": lambda: RAFTConfig(**base),
         "things_accum2": lambda: RAFTConfig(**base),
         "things_accum3": lambda: RAFTConfig(**base),
+        # batch-scaling study at the chairs config: with ~200 ms of
+        # per-step overhead, larger batches should amortize it into
+        # higher MFU until HBM binds
+        "chairs_b12": lambda: RAFTConfig(**base),
+        "chairs_b16": lambda: RAFTConfig(**base),
+        "chairs_b16_accum2": lambda: RAFTConfig(**base),
     }
     want = sys.argv[1:] or ["current", "alt_pallas", "fwd_only"]
     chairs_batch = make_batch()
     things_batch = (make_batch(B=6, H=400, W=720)
                     if any(w.startswith("things_") for w in want) else None)
+    big_batches = {b: make_batch(B=b)
+                   for b in {int(name.split("_")[1][1:]) for name in want
+                             if name.startswith("chairs_b")}}
     for i, name in enumerate(want):
         cfg = variants[name]()
-        batch = things_batch if name.startswith("things_") else chairs_batch
+        batch = (things_batch if name.startswith("things_")
+                 else big_batches[int(name.split("_")[1][1:])]
+                 if name.startswith("chairs_b") else chairs_batch)
         B = batch["image1"].shape[0]
-        accum = int(name[-1]) if name.startswith("things_accum") else 1
+        accum = int(name[-1]) if name.endswith(
+            ("accum1", "accum2", "accum3")) else 1
         try:
             dt, peak = time_step(cfg, batch, fwd_only=(name == "fwd_only"),
                                  accum_steps=accum)

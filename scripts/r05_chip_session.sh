@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-5 on-chip measurement session.  Run with the chip otherwise idle;
+# each perf_probe invocation is one process so within-invocation ratios
+# are comparable (the tunnel throttles ACROSS sessions — never compare
+# absolute ms between invocations).
+set -x
+cd "$(dirname "$0")/.."
+
+mkdir -p docs/tpu_runs
+
+# 1. The headline A/B: lane-padded default vs the round-4 unpadded layout
+python scripts/perf_probe.py no_pad_lanes current \
+  2>&1 | tee docs/tpu_runs/r05_probe_padlanes.txt
+
+# 2. One-launch stacked variant vs per-level pallas vs einsum default
+python scripts/perf_probe.py current pallas_stacked \
+  pallas_stacked_deferred pallas_lookup \
+  2>&1 | tee docs/tpu_runs/r05_probe_stacked.txt
+
+# 3. Batch-scaling study
+python scripts/perf_probe.py current chairs_b12 chairs_b16 \
+  chairs_b16_accum2 2>&1 | tee docs/tpu_runs/r05_probe_batch.txt
+
+# 4. On-device kernel certification of the new stacked kernels
+RAFT_TESTS_ON_DEVICE=1 python -m pytest tests/test_corr_pallas.py \
+  -q -k "stacked or pyramid_window or padded" \
+  2>&1 | tail -5 | tee docs/tpu_runs/r05_ondevice_stacked_tests.txt
+
+# 5. Scoreboard bench (device + fed lanes), twice for spread
+python bench.py 2>&1 | tail -1 | tee docs/tpu_runs/r05_bench_a.txt
+python bench.py 2>&1 | tail -1 | tee docs/tpu_runs/r05_bench_b.txt
