@@ -100,15 +100,19 @@ class RAFTConfig:
     lookup_impl: str = "einsum"  # "einsum" | "pallas" | "pallas_stacked"
     # Lane-pad the dense pyramid for the EINSUM lookup path: store levels
     # in build_corr_pyramid_padded's explicit-zeros layout (rows to
-    # sublane multiples, width to 128 lanes).  TPU arrays are physically
-    # tiled to (sublane, 128) anyway, so the zeros cost no extra HBM —
-    # but they let the backward scan's volume-sized select_add chain and
-    # the lookup contractions run on full lanes instead of (e.g.) the
-    # 62/128-utilized minor dim of the chairs-config level 0 (the
-    # round-4 roofline's ~35 ms cluster).  Ignored on the sharded
-    # (corr_shard) and on-demand (alternate_corr) paths, and redundant
-    # under lookup_impl="pallas" (always padded there).
-    corr_pad_lanes: bool = True
+    # sublane multiples, width to 128 lanes).  The hypothesis was that
+    # the zeros are free (TPU arrays tile minor dims to (sublane, 128)
+    # physically anyway) while letting the backward scan's select_add
+    # chain run full-lane — round-5 on-chip A/B says NO: 249.8/249.4 ms
+    # per step padded vs 245.5/245.1 unpadded (two same-process
+    # measurements each); the extra matmul columns in the pyramid build
+    # and the wider one-hot contractions eat the accumulation win.
+    # Default OFF by that measurement (the round-3 deferred_corr_grad
+    # story again); kept as a knob because the balance may differ at
+    # other shapes.  Ignored on the sharded (corr_shard) and on-demand
+    # (alternate_corr) paths, and redundant under lookup_impl="pallas"
+    # (always padded there).
+    corr_pad_lanes: bool = False
 
     def __post_init__(self):
         if self.lookup_impl not in ("einsum", "pallas", "pallas_stacked"):

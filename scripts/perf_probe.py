@@ -134,9 +134,11 @@ def main():
                "deferred_corr_grad": True}),
         "convs_saved": lambda: RAFTConfig(
             **{**base, "remat_policy": "convs_and_dots_saveable"}),
-        # round-5 lane-padded dense pyramid (corr_pad_lanes, default ON):
-        # A/B against the unpadded layout the round-4 roofline flagged
-        # (62-lane minor dim = 38% HBM efficiency on the select_add chain)
+        # round-5 lane-padded dense pyramid A/B (corr_pad_lanes).
+        # Measured: padded LOSES 245.5/245.1 -> 249.8/249.4 ms/step
+        # (default stays OFF); both variants kept for re-measurement
+        "pad_lanes": lambda: RAFTConfig(
+            **{**base, "corr_pad_lanes": True}),
         "no_pad_lanes": lambda: RAFTConfig(
             **{**base, "corr_pad_lanes": False}),
         "corr_f32": lambda: RAFTConfig(**{**base, "corr_dtype": "float32"}),

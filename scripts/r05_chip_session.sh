@@ -8,8 +8,10 @@ cd "$(dirname "$0")/.."
 
 mkdir -p docs/tpu_runs
 
-# 1. The headline A/B: lane-padded default vs the round-4 unpadded layout
-python scripts/perf_probe.py no_pad_lanes current \
+# 1. The headline A/B: lane-padded vs unpadded pyramid layout (the
+#    default is unpadded after this session's measurement — use the
+#    explicit variants, not "current")
+python scripts/perf_probe.py no_pad_lanes pad_lanes \
   2>&1 | tee docs/tpu_runs/r05_probe_padlanes.txt
 
 # 2. One-launch stacked variant vs per-level pallas vs einsum default

@@ -1,7 +1,8 @@
 """One-shot hardware validation: run after any change to the TPU-facing
 compute paths (Pallas kernel, corr implementations, model layout).
 
-    python scripts/tpu_validation.py            # everything
+    python scripts/tpu_validation.py            # everything but `depth`
+                                                # (its training leg is ~2 h)
     python scripts/tpu_validation.py kernel bench highres
 
 Stages:
@@ -267,14 +268,18 @@ def run_depth(num_steps: int = 4000):
     # RAFT_DEPTH_SKIP_TRAIN=1 re-evaluates an existing checkpoint (the
     # training leg is ~2 h through the tunnel; the eval leg is minutes);
     # carry the previous artifact's training time through a re-eval
-    train_s = 0.0
+    # a re-eval must not claim the CURRENT commit trained the checkpoint:
+    # carry training provenance (time, steps, commit) from the previous
+    # artifact and mark the re-evaluation
+    prev_art = {}
     prev = os.path.join(ROOT, "docs", "tpu_runs", "depth_curve.json")
     if os.path.exists(prev):
         try:
             with open(prev) as f:
-                train_s = json.load(f).get("train_seconds", 0.0)
+                prev_art = json.load(f)
         except (ValueError, OSError):
             pass  # truncated/corrupt previous artifact — start fresh
+    train_s = prev_art.get("train_seconds", 0.0)
     skip_train = os.environ.get("RAFT_DEPTH_SKIP_TRAIN", "") not in ("", "0")
     if skip_train and not os.path.exists(
             os.path.join(ckpt, "raft-synthetic-aug.msgpack")):
@@ -315,6 +320,13 @@ def run_depth(num_steps: int = 4000):
     commit = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                             cwd=ROOT, capture_output=True,
                             text=True).stdout.strip()
+    if skip_train:
+        # training provenance belongs to the run that trained
+        steps_rec = prev_art.get("steps", num_steps)
+        train_commit = prev_art.get("train_commit",
+                                    prev_art.get("commit", "unknown"))
+    else:
+        steps_rec, train_commit = num_steps, commit
     ratio24 = curve[24] / curve[12]
     drift24 = curve[24] - curve[12]
     # Pass bar: relative (the verdict's 1.2x) OR an absolute 0.05 px
@@ -324,10 +336,12 @@ def run_depth(num_steps: int = 4000):
     # order of magnitude (0.42 -> 1.53 px).
     ok = (ratio24 <= 1.2) or (drift24 <= 0.05)
     artifact = {
-        "run": f"synthetic_aug {num_steps}-step train + held-out depth "
-               f"curve",
+        "run": f"synthetic_aug {steps_rec}-step train + held-out depth "
+               f"curve" + (" (re-eval of existing checkpoint)"
+                           if skip_train else ""),
         "textures": "frames" if root == frames else "procedural",
-        "steps": num_steps,
+        "steps": steps_rec,
+        "train_commit": train_commit,
         "train_seconds": round(train_s, 1),
         "epe_px": {str(k): round(v, 4) for k, v in curve.items()},
         "ratio_24_over_12": round(ratio24, 4),
