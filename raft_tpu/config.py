@@ -103,16 +103,27 @@ class RAFTConfig:
     # sublane multiples, width to 128 lanes).  The hypothesis was that
     # the zeros are free (TPU arrays tile minor dims to (sublane, 128)
     # physically anyway) while letting the backward scan's select_add
-    # chain run full-lane — round-5 on-chip A/B says NO: 249.8/249.4 ms
-    # per step padded vs 245.5/245.1 unpadded (two same-process
-    # measurements each); the extra matmul columns in the pyramid build
-    # and the wider one-hot contractions eat the accumulation win.
+    # chain run full-lane — the round-5 same-process A/B showed no win
+    # (245.5 unpadded vs 249.8 padded ms/step; cross-invocation padded
+    # readings 245.1-249.4 are throttle noise): the extra matmul
+    # columns in the pyramid build and the wider one-hot contractions
+    # eat the accumulation win.
     # Default OFF by that measurement (the round-3 deferred_corr_grad
     # story again); kept as a knob because the balance may differ at
     # other shapes.  Ignored on the sharded (corr_shard) and on-demand
     # (alternate_corr) paths, and redundant under lookup_impl="pallas"
     # (always padded there).
     corr_pad_lanes: bool = False
+    # Run the mask head's final 1x1 conv in f32 even under the bf16
+    # compute policy.  Hypothesis: the round-5 trace showed the bf16
+    # backward fusing the bias-gradient reduction into the
+    # d-preactivation producer at 130 GB/s (15.9 ms/step, the step's
+    # largest single op), and the conv's output feeds the f32 softmax
+    # anyway.  Measured A/B says NO: f32 conv2 is ~16 ms/step SLOWER
+    # (240.8/244.3 bf16 vs 257.5/261.8 f32, two same-process pairs) —
+    # doubling the mask bytes through the whole backward costs more
+    # than the reduce pattern saves.  Default OFF by that measurement.
+    mask_conv2_f32: bool = False
 
     def __post_init__(self):
         if self.lookup_impl not in ("einsum", "pallas", "pallas_stacked"):

@@ -379,7 +379,11 @@ class RAFT(nn.Module):
                 (net, coords1), inp, corr_state, coords0)
 
         mask_head = (None if cfg.small
-                     else MaskHead(dtype=dtype, name="mask_head"))
+                     else MaskHead(dtype=dtype,
+                                   conv2_dtype=(jnp.float32
+                                                if cfg.mask_conv2_f32
+                                                else None),
+                                   name="mask_head"))
 
         def upsample(flow_lr, net_state, packed=False):
             if mask_head is None:

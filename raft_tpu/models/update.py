@@ -7,7 +7,7 @@ MXU as two skinny matmuls.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -155,11 +155,19 @@ class MaskHead(nn.Module):
     """
 
     dtype: Any = jnp.float32
+    # Optional override for mask_conv2's dtype (cfg.mask_conv2_f32);
+    # None follows ``dtype``.  The f32 hypothesis (its output feeds the
+    # f32 softmax anyway, and the bf16 backward fuses the bias-gradient
+    # reduction into a 130 GB/s producer — 15.9 ms/step) LOST the A/B
+    # by ~16 ms/step; measured record in docs/ARCHITECTURE.md.
+    conv2_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, net):
+        c2 = self.conv2_dtype if self.conv2_dtype is not None else self.dtype
         mask = nn.relu(conv(256, 3, dtype=self.dtype, name="mask_conv1")(net))
-        return 0.25 * conv(576, 1, dtype=self.dtype, name="mask_conv2")(mask)
+        return 0.25 * conv(576, 1, dtype=c2,
+                           name="mask_conv2")(mask.astype(c2))
 
 
 class SmallUpdateBlock(nn.Module):

@@ -141,6 +141,12 @@ def main():
             **{**base, "corr_pad_lanes": True}),
         "no_pad_lanes": lambda: RAFTConfig(
             **{**base, "corr_pad_lanes": False}),
+        # round-5 mask_conv2 dtype A/B (the 15.9 ms/step bf16 bias-grad
+        # fusion): f32 LOST by ~16 ms/step (default stays bf16-policy)
+        "mask_f32": lambda: RAFTConfig(
+            **{**base, "mask_conv2_f32": True}),
+        "mask_bf16": lambda: RAFTConfig(
+            **{**base, "mask_conv2_f32": False}),
         "corr_f32": lambda: RAFTConfig(**{**base, "corr_dtype": "float32"}),
         "fwd_only": lambda: RAFTConfig(**base),
         # things-config accumulation sweep (batch 6 at 400x720,

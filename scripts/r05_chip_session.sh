@@ -19,6 +19,11 @@ python scripts/perf_probe.py current pallas_stacked \
   pallas_stacked_deferred pallas_lookup \
   2>&1 | tee docs/tpu_runs/r05_probe_stacked.txt
 
+# 2b. mask_conv2 dtype A/B (the 15.9 ms/step bf16 bias-grad fusion
+#     hypothesis; f32 lost by ~16 ms/step — default stays bf16)
+python scripts/perf_probe.py mask_bf16 mask_f32 mask_bf16 mask_f32 \
+  2>&1 | tee docs/tpu_runs/r05_probe_maskdtype.txt
+
 # 3. Batch-scaling study
 python scripts/perf_probe.py current chairs_b12 chairs_b16 \
   chairs_b16_accum2 2>&1 | tee docs/tpu_runs/r05_probe_batch.txt
