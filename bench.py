@@ -138,7 +138,8 @@ def _make_fed_loader(B, H, W, seed: int = 1):
     ds = SyntheticShift(
         image_size=(H + 32, W + 32), length=512, seed=seed,
         aug_params=dict(crop_size=(H, W), min_scale=0.0, max_scale=0.2,
-                        do_flip=True))
+                        do_flip=True),
+        wire_format="int16")
     # Workers capped at the core count: on the 1-core tunnel host, 4
     # threads time-slicing one core add GIL/scheduler thrash on top of
     # the ~27 ms/sample augment cost — the source of the round-4 fed
@@ -191,15 +192,21 @@ def main():
         B, H, W, iters = 1, 64, 64, 2
 
     rng = np.random.default_rng(0)
-    # Images are uint8 — the dtype the host pipeline now ships (see
-    # FlowDataset._pack), so the ONE compiled executable serves both the
-    # device lane and the fed lane (a dtype mismatch would make the fed
-    # lane silently recompile or fail against the lowered executable).
+    # The batch carries the wire dtypes the host pipeline ships — uint8
+    # images and, since round 5, int16 fixed-point flow + uint8 valid
+    # (raft_tpu/wire.py: ~16.1 MB/batch instead of ~26.3; the tunnel-bound
+    # fed lane is bytes-limited) — so the ONE compiled executable serves
+    # both the device lane and the fed lane (a dtype mismatch would make
+    # the fed lane silently recompile or fail against the lowered
+    # executable).  NOTE: this breaks fed-lane comparability with the
+    # pre-wire r05_bench_{a,b} artifacts (those shipped the f32 wire).
+    from raft_tpu.wire import encode_flow_i16
     batch = {
         "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.uint8)),
         "image2": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)).astype(np.uint8)),
-        "flow": jnp.asarray((rng.standard_normal((B, H, W, 2)) * 5).astype(np.float32)),
-        "valid": jnp.ones((B, H, W), np.float32),
+        "flow": jnp.asarray(encode_flow_i16(
+            (rng.standard_normal((B, H, W, 2)) * 5).astype(np.float32))),
+        "valid": jnp.ones((B, H, W), np.uint8),
     }
 
     # remat=True (from the preset): without it the unrolled 12-iteration

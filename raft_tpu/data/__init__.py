@@ -20,6 +20,7 @@ from raft_tpu.data.datasets import (
     fetch_dataset,
 )
 from raft_tpu.data.loader import DataLoader
+from raft_tpu.wire import encode_flow_i16, decode_flow, decode_valid
 
 __all__ = [
     "read_flow", "write_flow", "read_pfm", "read_flow_kitti",
@@ -27,4 +28,5 @@ __all__ = [
     "FlowAugmentor", "SparseFlowAugmentor", "FlowDataset", "FlyingChairs",
     "FlyingThings3D", "MpiSintel", "KITTI", "HD1K", "SyntheticShift",
     "fetch_dataset", "DataLoader",
+    "encode_flow_i16", "decode_flow", "decode_valid",
 ]

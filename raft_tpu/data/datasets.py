@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from raft_tpu import wire
 from raft_tpu.data import frame_utils
 from raft_tpu.data.augmentor import FlowAugmentor, SparseFlowAugmentor
 
@@ -33,9 +34,11 @@ class FlowDataset:
     """Base dataset: image pair + dense or sparse flow (datasets.py:18-99)."""
 
     def __init__(self, aug_params: Optional[dict] = None,
-                 sparse: bool = False, seed: int = 0):
+                 sparse: bool = False, seed: int = 0,
+                 wire_format: str = "f32"):
         self.sparse = sparse
         self.seed = seed
+        self.wire_format = wire.check_wire_format(wire_format)
         self.epoch = 0
         self.augmentor = None
         if aug_params is not None:
@@ -92,8 +95,7 @@ class FlowDataset:
                 img1, img2, flow = aug(img1, img2, flow)
         return img1, img2, flow, valid
 
-    @staticmethod
-    def _pack(img1, img2, flow, valid=None) -> Dict[str, np.ndarray]:
+    def _pack(self, img1, img2, flow, valid=None) -> Dict[str, np.ndarray]:
         if valid is None:
             # dense GT: valid where |flow| < 1000 (datasets.py:88)
             valid = ((np.abs(flow[..., 0]) < 1000)
@@ -102,7 +104,16 @@ class FlowDataset:
         # model's first op normalizes any dtype (models/raft.py) — so
         # stack/memcpy/host->device traffic is 4x smaller than f32 on
         # exactly the host-bound lane the driver bench scores.  Flow and
-        # valid stay f32 (the loss consumes them directly).
+        # valid default to f32 (the loss consumes them directly);
+        # wire_format="int16" packs flow as 1/64-px fixed point and valid
+        # as uint8 (halving supervision bytes; see raft_tpu/wire.py — the
+        # validity rule above runs BEFORE encoding, and int16 saturation
+        # at +-511.98 px still trips the loss's MAX_FLOW=400 mask).
+        if self.wire_format == "int16":
+            return {"image1": np.ascontiguousarray(img1, np.uint8),
+                    "image2": np.ascontiguousarray(img2, np.uint8),
+                    "flow": wire.encode_flow_i16(flow),
+                    "valid": np.ascontiguousarray(valid, np.uint8)}
         return {"image1": np.ascontiguousarray(img1, np.uint8),
                 "image2": np.ascontiguousarray(img2, np.uint8),
                 "flow": np.ascontiguousarray(flow, np.float32),
@@ -275,13 +286,15 @@ class SyntheticShift(FlowDataset):
 
     def __init__(self, image_size=(368, 496), length: int = 1000,
                  max_shift: int = 16, frames_dir: Optional[str] = None,
-                 seed: int = 0, aug_params: Optional[dict] = None):
+                 seed: int = 0, aug_params: Optional[dict] = None,
+                 wire_format: str = "f32"):
         # aug_params: optional dense FlowAugmentor (jitter/scale/crop) for
         # pipeline/throughput runs (e.g. the fed bench lane).  The
         # wrap-band mask rides through augmentation as a sentinel flow
         # value that the dense |flow|<1000 pack rule maps back to
         # valid=0, so augmented samples keep exact supervision too.
-        super().__init__(aug_params=aug_params, seed=seed)
+        super().__init__(aug_params=aug_params, seed=seed,
+                         wire_format=wire_format)
         self.image_size = tuple(image_size)
         self.length = length
         self.max_shift = max_shift
@@ -355,18 +368,33 @@ class SyntheticShift(FlowDataset):
             img1, img2, flow, _ = self._augment(
                 index, img1.astype(np.uint8), img2.astype(np.uint8), flow)
             return self._pack(img1, img2, flow)  # dense valid rule
-        return {"image1": img1.astype(np.uint8), "image2": img2.astype(np.uint8),
-                "flow": flow, "valid": valid}
+        return self._pack(img1.astype(np.uint8), img2.astype(np.uint8),
+                          flow, valid)
 
 
 def fetch_dataset(stage: str, image_size, root: str = "datasets",
-                  train_ds: str = "C+T+K+S+H", seed: int = 0):
+                  train_ds: str = "C+T+K+S+H", seed: int = 0,
+                  wire_format: str = "f32"):
     """Stage mixture construction (datasets.py:199-228).
 
     chairs -> FlyingChairs;  things -> clean+final passes;
     sintel -> 100*clean + 100*final + 200*kitti + 5*hd1k + things;
     kitti -> sparse KITTI only.
+
+    wire_format="int16" packs supervision compactly for transfer
+    (raft_tpu/wire.py); applied to every dataset in the stage mixture.
     """
+    wire.check_wire_format(wire_format)
+    ds = _fetch_dataset(stage, image_size, root, train_ds, seed)
+    if wire_format != "f32":
+        for part, _ in (ds.parts if isinstance(ds, CombinedDataset)
+                        else [(ds, 1)]):
+            part.wire_format = wire_format
+    return ds
+
+
+def _fetch_dataset(stage: str, image_size, root: str,
+                   train_ds: str, seed: int):
     crop = tuple(image_size)
     if stage == "synthetic":
         # Dataset-free stage: random-shift pairs with exact GT (see

@@ -14,6 +14,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.wire import WIRE_FLOW_MAX, decode_flow, decode_valid
 from raft_tpu.training.loss import sequence_loss
 from raft_tpu.training.state import TrainState
 
@@ -58,6 +59,21 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
         rng, step_rng, noise_rng = jax.random.split(state.rng, 3)
 
         image1, image2 = batch["image1"], batch["image2"]
+        # Supervision may arrive wire-packed (flow int16 at 1/64 px,
+        # valid uint8 — raft_tpu/wire.py); decode is the step's first op so
+        # the compact form crosses the host->device link, not f32.  The
+        # dtype check happens at trace time: an f32 batch compiles to a
+        # no-op.  int16 saturates at WIRE_FLOW_MAX px — safe only while
+        # the loss's magnitude mask cuts everything the wire can clip, so
+        # a larger max_flow must refuse the packed wire rather than
+        # silently supervise toward saturated targets.
+        if batch["flow"].dtype == jnp.int16 and max_flow > WIRE_FLOW_MAX:
+            raise ValueError(
+                f"wire_format='int16' saturates at {WIRE_FLOW_MAX:.2f} px; "
+                f"max_flow={max_flow} would let clipped ground truth "
+                f"through the loss mask — use the f32 wire")
+        gt_flow = decode_flow(batch["flow"])
+        gt_valid = decode_valid(batch["valid"])
         if add_noise:
             k1, k2, ks = jax.random.split(noise_rng, 3)
             stdv = jax.random.uniform(ks) * 5.0
@@ -86,7 +102,7 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
         if accum_steps == 1:
             (loss, (metrics, new_model_state)), grads = grad_fn(
                 state.params, state.batch_stats, step_rng, image1, image2,
-                batch["flow"], batch["valid"])
+                gt_flow, gt_valid)
             metrics = dict(metrics)
             metrics["loss"] = loss
         else:
@@ -105,8 +121,8 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
                 x = x.reshape((mb, accum_steps) + x.shape[1:])
                 return jnp.moveaxis(x, 1, 0)
 
-            micro = (resh(image1), resh(image2), resh(batch["flow"]),
-                     resh(batch["valid"]),
+            micro = (resh(image1), resh(image2), resh(gt_flow),
+                     resh(gt_valid),
                      jax.random.split(step_rng, accum_steps))
 
             def micro_step(carry, mbatch):

@@ -1,0 +1,143 @@
+"""Wire-format tests: int16 fixed-point supervision packing (raft_tpu/wire.py).
+
+The encoding cuts host->device batch bytes by 39%; these tests pin the
+properties that make it safe: sub-1/128-px roundtrip error, MAX_FLOW-mask
+preservation under saturation, and train-step loss equivalence against
+the f32 wire on identical samples.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import wire
+from raft_tpu.data.datasets import SyntheticShift, fetch_dataset
+from raft_tpu.data.loader import DataLoader
+
+RNG = np.random.default_rng(23)
+
+
+def test_roundtrip_precision():
+    flow = (RNG.uniform(-500, 500, (7, 9, 2))).astype(np.float32)
+    enc = wire.encode_flow_i16(flow)
+    assert enc.dtype == np.int16
+    dec = wire.decode_flow(enc)
+    assert dec.dtype == np.float32
+    np.testing.assert_allclose(dec, flow, atol=1.0 / 128 + 1e-6)
+
+
+def test_decode_passthrough():
+    flow = RNG.standard_normal((4, 4, 2)).astype(np.float32)
+    assert wire.decode_flow(flow) is flow
+    valid = np.ones((4, 4), np.float32)
+    assert wire.decode_valid(valid) is valid
+    v8 = np.ones((4, 4), np.uint8)
+    assert wire.decode_valid(v8).dtype == np.float32
+
+
+def test_decode_works_on_jax_arrays():
+    enc = jnp.asarray(wire.encode_flow_i16(
+        RNG.uniform(-100, 100, (3, 3, 2)).astype(np.float32)))
+    dec = wire.decode_flow(enc)
+    assert isinstance(dec, jax.Array) and dec.dtype == jnp.float32
+
+
+def test_saturation_preserves_max_flow_mask():
+    """int16 saturates at +-511.98 px; every saturated value must still
+    exceed the loss's MAX_FLOW=400 magnitude cutoff (train.py:42,54-55),
+    so the mask computed from decoded flow equals the mask from f32 flow
+    for any magnitude outside the quantization knife-edge at 400.0."""
+    mags = np.concatenate([
+        RNG.uniform(0, 399, 300),          # kept by the mask
+        RNG.uniform(401, 3000, 300),       # cut by the mask (some saturate)
+    ]).astype(np.float32)
+    ang = RNG.uniform(0, 2 * np.pi, mags.shape[0]).astype(np.float32)
+    flow = np.stack([mags * np.cos(ang), mags * np.sin(ang)], -1)
+
+    dec = wire.decode_flow(wire.encode_flow_i16(flow))
+    mag_f32 = np.linalg.norm(flow, axis=-1)
+    mag_dec = np.linalg.norm(dec, axis=-1)
+    np.testing.assert_array_equal(mag_f32 < 400.0, mag_dec < 400.0)
+
+
+def test_synthetic_shift_packs_wire_dtypes():
+    for aug in (None, dict(crop_size=(48, 48), min_scale=0.0,
+                           max_scale=0.1, do_flip=True)):
+        ds = SyntheticShift(image_size=(64, 64), length=4, seed=3,
+                            aug_params=aug, wire_format="int16")
+        s = ds[0]
+        assert s["image1"].dtype == np.uint8
+        assert s["flow"].dtype == np.int16
+        assert s["valid"].dtype == np.uint8
+
+
+def test_config_whitelist_matches_wire_module():
+    """DataConfig validates inline (importing the data package from
+    config would drag cv2/jax into `import raft_tpu.config`); this pins
+    the inline copy to the canonical wire.WIRE_FORMATS."""
+    from raft_tpu.config import DataConfig
+
+    assert wire.WIRE_FORMATS == ("f32", "int16")
+    for wf in wire.WIRE_FORMATS:
+        DataConfig(wire_format=wf)
+    with pytest.raises(ValueError):
+        DataConfig(wire_format="fp8")
+
+
+def test_int16_wire_refuses_unsafe_max_flow():
+    """max_flow beyond the int16 saturation point (32767/64 px) must be
+    rejected at trace time — otherwise clipped GT passes the loss mask."""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.training import create_train_state, make_optimizer
+    from raft_tpu.training.step import make_train_step
+
+    ds = SyntheticShift(image_size=(64, 64), length=2, seed=0,
+                        wire_format="int16")
+    batch = {k: jnp.asarray(v)[None] for k, v in ds[0].items()}
+    model = RAFT(RAFTConfig(small=True))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=10, wdecay=1e-5)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=2)
+    step = make_train_step(model, iters=2, gamma=0.8, max_flow=600.0)
+    with pytest.raises(ValueError, match="saturates"):
+        step(state, batch)
+
+
+def test_fetch_dataset_applies_wire_format():
+    ds = fetch_dataset("synthetic", (64, 64), wire_format="int16")
+    assert ds[0]["flow"].dtype == np.int16
+    with pytest.raises(ValueError):
+        fetch_dataset("synthetic", (64, 64), wire_format="fp8")
+
+
+def test_train_step_loss_matches_f32_wire():
+    """The same samples through both wire formats give the same loss up
+    to the 1/128-px target quantization — the packed wire changes bytes
+    on the link, not the training objective."""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.training import create_train_state, make_optimizer
+    from raft_tpu.training.step import make_train_step
+
+    def batch_for(wf):
+        ds = SyntheticShift(image_size=(64, 64), length=8, seed=5,
+                            max_shift=4, wire_format=wf)
+        loader = DataLoader(ds, batch_size=4, shuffle=False, num_workers=2,
+                            seed=0, prefetch=1)
+        return {k: jnp.asarray(v) for k, v in next(iter(loader)).items()}
+
+    model = RAFT(RAFTConfig(small=True))
+    losses = {}
+    for wf in ("f32", "int16"):
+        batch = batch_for(wf)
+        tx, _ = make_optimizer(lr=1e-4, num_steps=10, wdecay=1e-5)
+        state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                                   iters=2)
+        step = make_train_step(model, iters=2, gamma=0.8, max_flow=400.0)
+        _, metrics = step(state, batch)
+        losses[wf] = float(metrics["loss"])
+    # identical params/data; only the GT quantization (<= 1/128 px on an
+    # L1 loss) differs
+    assert abs(losses["f32"] - losses["int16"]) < 2e-2, losses
