@@ -227,9 +227,38 @@ def main():
                                max_flow=400.0, donate=True)
         # Compile once via lower/compile: the same executable serves the
         # timing loop AND exposes XLA's FLOPs estimate for the MFU line.
+        # scoped_vmem 32 MiB: the round-5 compiler-flag scan measured the
+        # chairs step at 228-229 ms vs 241-243 at the 64 MiB default
+        # (~+5.8%; 24-32 MiB is a plateau, 48+ and 16 both lose —
+        # docs/tpu_runs/r05_probe_vmem.txt).  Overridable for other
+        # configs; only applies to this einsum-path executable — Pallas
+        # lookup configs budget their own VMEM and should leave the
+        # default (scripts/perf_probe.py xla_vmem* variants re-measure).
+        vmem_kib = os.environ.get("RAFT_SCOPED_VMEM_KIB", "32768")
+        if vmem_kib and not vmem_kib.isdigit():
+            _fail(f"RAFT_SCOPED_VMEM_KIB={vmem_kib!r} is not an integer "
+                  f"KiB count (e.g. 32768; 0 disables the override)",
+                  backend_down=False)
+        copts = ({"xla_tpu_scoped_vmem_limit_kib": vmem_kib}
+                 if platform == "tpu" and vmem_kib not in ("", "0")
+                 else None)
         flops = 0.0
         try:
-            compiled = step.lower(state, batch).compile()
+            lowered = step.lower(state, batch)
+            try:
+                compiled = lowered.compile(compiler_options=copts)
+            except Exception as ce:
+                if copts is None:
+                    raise
+                # vmem override rejected (older jax / other backend):
+                # keep the MFU line, lose only the tuning — and SAY so,
+                # or the scoreboard number gets attributed to a tuning
+                # that never applied (the _is_oom comment's silent-
+                # downgrade rule)
+                print(f"bench: scoped-vmem override {vmem_kib} KiB "
+                      f"rejected ({type(ce).__name__}: {str(ce)[:120]}); "
+                      f"compiled with backend defaults", file=sys.stderr)
+                compiled = lowered.compile()
             ca = compiled.cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else {}

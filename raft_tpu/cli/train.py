@@ -103,7 +103,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--wire_int16", action="store_true",
                    help="ship supervision wire-packed (flow int16 at "
                         "1/64 px, valid uint8) — 39%% fewer host->device "
-                        "bytes/batch; see raft_tpu/raft_tpu/wire.py")
+                        "bytes/batch; see raft_tpu/wire.py")
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--val_freq", type=int, default=5000)
     p.add_argument("--resume", action="store_true",

@@ -219,13 +219,11 @@ class DataConfig:
     wire_format: str = "f32"
 
     def __post_init__(self):
-        # whitelist kept inline (= wire.WIRE_FORMATS; asserted equal
-        # in tests/test_wire.py): importing the data package from here
-        # would pull cv2/jax into every `import raft_tpu.config`
-        if self.wire_format not in ("f32", "int16"):
-            raise ValueError(
-                f"wire_format must be one of ('f32', 'int16'), "
-                f"got {self.wire_format!r}")
+        # raft_tpu.wire is numpy-only (deliberately outside the data
+        # package, whose __init__ pulls cv2), so config can defer to the
+        # canonical whitelist owner without import weight
+        from raft_tpu.wire import check_wire_format
+        check_wire_format(self.wire_format)
 
 
 @dataclasses.dataclass(frozen=True)

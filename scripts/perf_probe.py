@@ -34,7 +34,8 @@ def make_batch(B=None, H=None, W=None):
     }
 
 
-def time_step(cfg, batch, iters=12, n=10, fwd_only=False, accum_steps=1):
+def time_step(cfg, batch, iters=12, n=10, fwd_only=False, accum_steps=1,
+              compiler_options=None):
     import jax
     from raft_tpu.models import RAFT
     from raft_tpu.training import create_train_state, make_optimizer
@@ -64,6 +65,12 @@ def time_step(cfg, batch, iters=12, n=10, fwd_only=False, accum_steps=1):
 
     step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0,
                            donate=True, accum_steps=accum_steps)
+    if compiler_options:
+        # per-compile XLA option override — same-process A/B of compiler
+        # flags (XLA_FLAGS would force one flag set per process, and the
+        # tunnel throttles across processes)
+        step = step.lower(state, batch).compile(
+            compiler_options=compiler_options)
     state, m = step(state, batch); float(m["loss"])
     t0 = time.perf_counter()
     for _ in range(n):
@@ -161,6 +168,25 @@ def main():
         "chairs_b12": lambda: RAFTConfig(**base),
         "chairs_b16": lambda: RAFTConfig(**base),
         "chairs_b16_accum2": lambda: RAFTConfig(**base),
+        # round-5 compiler-flag A/Bs (default config, per-compile XLA
+        # option overrides — see time_step's compiler_options)
+        "xla_lhs_sched": lambda: RAFTConfig(**base),
+        "xla_vmem128": lambda: RAFTConfig(**base),
+        "xla_vmem64": lambda: RAFTConfig(**base),
+        "xla_vmem48": lambda: RAFTConfig(**base),
+        "xla_vmem32": lambda: RAFTConfig(**base),
+        "xla_vmem24": lambda: RAFTConfig(**base),
+        "xla_vmem16": lambda: RAFTConfig(**base),
+    }
+    compiler_opts = {
+        "xla_lhs_sched": {
+            "xla_tpu_enable_latency_hiding_scheduler": "true"},
+        "xla_vmem128": {"xla_tpu_scoped_vmem_limit_kib": "131072"},
+        "xla_vmem64": {"xla_tpu_scoped_vmem_limit_kib": "65536"},
+        "xla_vmem48": {"xla_tpu_scoped_vmem_limit_kib": "49152"},
+        "xla_vmem32": {"xla_tpu_scoped_vmem_limit_kib": "32768"},
+        "xla_vmem24": {"xla_tpu_scoped_vmem_limit_kib": "24576"},
+        "xla_vmem16": {"xla_tpu_scoped_vmem_limit_kib": "16384"},
     }
     want = sys.argv[1:] or ["current", "alt_pallas", "fwd_only"]
     chairs_batch = make_batch()
@@ -179,7 +205,8 @@ def main():
             ("accum1", "accum2", "accum3")) else 1
         try:
             dt, peak = time_step(cfg, batch, fwd_only=(name == "fwd_only"),
-                                 accum_steps=accum)
+                                 accum_steps=accum,
+                                 compiler_options=compiler_opts.get(name))
             hbm = ""
             if peak > 0:
                 # the allocator peak is monotone per process: clean for
