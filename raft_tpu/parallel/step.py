@@ -31,7 +31,8 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
 def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
                              max_flow: float, freeze_bn: bool = False,
                              add_noise: bool = False, donate: bool = False,
-                             accum_steps: int = 1):
+                             accum_steps: int = 1,
+                             compiler_options=None):
     """Build the mesh-aware train step.
 
     Usage:
@@ -50,7 +51,8 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
     """
     base = make_train_step(model, iters=iters, gamma=gamma, max_flow=max_flow,
                            freeze_bn=freeze_bn, add_noise=add_noise,
-                           donate=donate, accum_steps=accum_steps)
+                           donate=donate, accum_steps=accum_steps,
+                           compiler_options=compiler_options)
     data_size = mesh.shape.get("data", 1)
 
     def step(state: TrainState, batch: Dict):

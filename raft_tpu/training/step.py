@@ -21,7 +21,8 @@ from raft_tpu.training.state import TrainState
 
 def make_train_step(model, iters: int, gamma: float, max_flow: float,
                     freeze_bn: bool = False, add_noise: bool = False,
-                    donate: bool = False, accum_steps: int = 1):
+                    donate: bool = False, accum_steps: int = 1,
+                    compiler_options: Dict[str, str] = None):
     """Build a jit-compiled train step for ``model``.
 
     The optional noise augmentation matches train.py:167-170: N(0, sigma)
@@ -151,7 +152,26 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
         metrics["grad_norm"] = optax_global_norm(grads)
         return new_state, metrics
 
-    return train_step
+    if not compiler_options:
+        return train_step
+
+    # Per-compile XLA option overrides (e.g. the measured scoped-VMEM
+    # tuning, docs/tpu_runs/r05_probe_vmem.txt).  env XLA_FLAGS cannot
+    # carry TPU flags on every deployment (the tunnel backend's local XLA
+    # rejects unknown flags), so route them through PJRT compile options:
+    # lazily AOT-compile on the first call's concrete shapes.  Training
+    # shapes are static; a later shape change fails loudly at the
+    # executable boundary instead of silently recompiling without the
+    # options.
+    compiled = []
+
+    def aot_step(state, batch):
+        if not compiled:
+            compiled.append(train_step.lower(state, batch).compile(
+                compiler_options=dict(compiler_options)))
+        return compiled[0](state, batch)
+
+    return aot_step
 
 
 def optax_global_norm(tree) -> jax.Array:

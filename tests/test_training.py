@@ -340,3 +340,27 @@ def test_grad_accum_rejects_bad_accum_steps():
     with pytest.raises(ValueError, match="accum_steps"):
         make_train_step(model, iters=2, gamma=0.8, max_flow=400.0,
                         accum_steps=0)
+
+
+def test_compiler_options_reach_the_compiler():
+    """make_train_step(compiler_options=...) must route options into the
+    PJRT compile (the scoped-VMEM tuning path) and fail LOUDLY when the
+    backend rejects one — a silent fallback would misattribute measured
+    numbers to a tuning that never applied."""
+    batch = _tiny_batch(B=1, H=64, W=64)
+    model = RAFT(RAFTConfig(small=True))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=10, wdecay=1e-5)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=2)
+    # empty/None options -> the plain jitted step (no AOT wrapper)
+    plain = make_train_step(model, iters=2, gamma=0.8, max_flow=400.0,
+                            compiler_options=None)
+    assert hasattr(plain, "lower")
+    bogus = make_train_step(
+        model, iters=2, gamma=0.8, max_flow=400.0,
+        compiler_options={"definitely_not_an_xla_option": "1"})
+    # the option NAME must appear in the error — proof the string reached
+    # the PJRT compile (CPU: "No such compile option: '...'"), not some
+    # incidental wrapper failure
+    with pytest.raises(Exception, match="definitely_not_an_xla_option"):
+        bogus(state, batch)
