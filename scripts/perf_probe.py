@@ -56,6 +56,9 @@ def time_step(cfg, batch, iters=12, n=10, fwd_only=False, accum_steps=1,
                                 batch["image1"], batch["image2"], iters=iters)
             return jnp.float32(preds[-1].mean())
 
+        if compiler_options:
+            fwd = fwd.lower(state.params, batch).compile(
+                compiler_options=compiler_options)
         out = fwd(state.params, batch); float(out)
         t0 = time.perf_counter()
         for _ in range(n):
@@ -63,14 +66,12 @@ def time_step(cfg, batch, iters=12, n=10, fwd_only=False, accum_steps=1,
         float(out)
         return (time.perf_counter() - t0) / n, -1
 
+    # compiler_options rides through make_train_step's lazy-AOT path —
+    # same-process A/B of compiler flags (XLA_FLAGS would force one flag
+    # set per process, and the tunnel throttles across processes)
     step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0,
-                           donate=True, accum_steps=accum_steps)
-    if compiler_options:
-        # per-compile XLA option override — same-process A/B of compiler
-        # flags (XLA_FLAGS would force one flag set per process, and the
-        # tunnel throttles across processes)
-        step = step.lower(state, batch).compile(
-            compiler_options=compiler_options)
+                           donate=True, accum_steps=accum_steps,
+                           compiler_options=compiler_options)
     state, m = step(state, batch); float(m["loss"])
     t0 = time.perf_counter()
     for _ in range(n):
@@ -188,6 +189,20 @@ def main():
         "xla_vmem24": {"xla_tpu_scoped_vmem_limit_kib": "24576"},
         "xla_vmem16": {"xla_tpu_scoped_vmem_limit_kib": "16384"},
     }
+    # RAFT_PROBE_VMEM_KIB: apply the scoped-VMEM override to EVERY
+    # variant in the invocation — for measuring interactions between the
+    # adopted 32 MiB budget and the other knobs (deferred grad, remat
+    # policy, batch size) in one same-process session.
+    global_vmem = os.environ.get("RAFT_PROBE_VMEM_KIB", "")
+    if global_vmem:
+        base_opts = {"xla_tpu_scoped_vmem_limit_kib": global_vmem}
+        own = [n for n in variants if compiler_opts.get(n)]
+        for name in list(variants):
+            compiler_opts[name] = {**base_opts,
+                                   **compiler_opts.get(name, {})}
+        print(f"# variants compiled with scoped vmem {global_vmem} KiB "
+              f"(except those with their own xla_* options: "
+              f"{', '.join(own)})")
     want = sys.argv[1:] or ["current", "alt_pallas", "fwd_only"]
     chairs_batch = make_batch()
     things_batch = (make_batch(B=6, H=400, W=720)
