@@ -142,3 +142,31 @@ def test_grad_accum_composes_with_data_parallel():
     for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_wire_packed_batch_shards_and_matches_f32():
+    """The int16 supervision wire (raft_tpu/wire.py) composes with the
+    data mesh: a wire-packed batch shards, trains, and reproduces the
+    f32-wire loss up to the 1/128-px target quantization."""
+    from raft_tpu.wire import encode_flow_i16
+
+    mesh = make_mesh(data=8)
+    batch = _batch(B=8)
+    packed = dict(batch)
+    packed["flow"] = jnp.asarray(encode_flow_i16(np.asarray(batch["flow"])))
+    packed["valid"] = batch["valid"].astype(jnp.uint8)
+
+    model = RAFT(RAFTConfig(small=True))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=10, wdecay=1e-5)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=2)
+    step = make_parallel_train_step(model, mesh, iters=2, gamma=0.8,
+                                    max_flow=400.0)
+    losses = {}
+    for name, b in (("f32", batch), ("int16", packed)):
+        sharded = shard_batch(b, mesh)
+        assert len(sharded["flow"].sharding.device_set) == 8
+        _, metrics = step(replicate_state(state, mesh), sharded)
+        losses[name] = float(metrics["loss"])
+    assert abs(losses["f32"] - losses["int16"]) < 2e-2, losses
