@@ -163,6 +163,9 @@ def main():
         "things_accum1": lambda: RAFTConfig(**base),
         "things_accum2": lambda: RAFTConfig(**base),
         "things_accum3": lambda: RAFTConfig(**base),
+        # things config under the adopted 32 MiB scoped-VMEM budget —
+        # does the chairs-config tuning transfer to high-res shapes?
+        "things_vmem32_accum2": lambda: RAFTConfig(**base),
         # batch-scaling study at the chairs config: with ~200 ms of
         # per-step overhead, larger batches should amortize it into
         # higher MFU until HBM binds
@@ -188,6 +191,7 @@ def main():
         "xla_vmem32": {"xla_tpu_scoped_vmem_limit_kib": "32768"},
         "xla_vmem24": {"xla_tpu_scoped_vmem_limit_kib": "24576"},
         "xla_vmem16": {"xla_tpu_scoped_vmem_limit_kib": "16384"},
+        "things_vmem32_accum2": {"xla_tpu_scoped_vmem_limit_kib": "32768"},
     }
     # RAFT_PROBE_VMEM_KIB: apply the scoped-VMEM override to EVERY
     # variant in the invocation — for measuring interactions between the
