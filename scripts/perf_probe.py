@@ -157,6 +157,8 @@ def main():
             **{**base, "mask_conv2_f32": False}),
         "corr_f32": lambda: RAFTConfig(**{**base, "corr_dtype": "float32"}),
         "fwd_only": lambda: RAFTConfig(**base),
+        # inference under the adopted 32 MiB budget (the eval lane)
+        "fwd_vmem32": lambda: RAFTConfig(**base),
         # things-config accumulation sweep (batch 6 at 400x720,
         # train_standard.sh:4): accum N trades step time for activation
         # memory; the HBM column says which N the chip actually needs
@@ -192,6 +194,7 @@ def main():
         "xla_vmem24": {"xla_tpu_scoped_vmem_limit_kib": "24576"},
         "xla_vmem16": {"xla_tpu_scoped_vmem_limit_kib": "16384"},
         "things_vmem32_accum2": {"xla_tpu_scoped_vmem_limit_kib": "32768"},
+        "fwd_vmem32": {"xla_tpu_scoped_vmem_limit_kib": "32768"},
     }
     # RAFT_PROBE_VMEM_KIB: apply the scoped-VMEM override to EVERY
     # variant in the invocation — for measuring interactions between the
@@ -223,7 +226,7 @@ def main():
         accum = int(name[-1]) if name.endswith(
             ("accum1", "accum2", "accum3")) else 1
         try:
-            dt, peak = time_step(cfg, batch, fwd_only=(name == "fwd_only"),
+            dt, peak = time_step(cfg, batch, fwd_only=name.startswith("fwd"),
                                  accum_steps=accum,
                                  compiler_options=compiler_opts.get(name))
             hbm = ""
