@@ -177,6 +177,9 @@ def main():
         # round-5 compiler-flag A/Bs (default config, per-compile XLA
         # option overrides — see time_step's compiler_options)
         "xla_lhs_sched": lambda: RAFTConfig(**base),
+        # the two individually-measured winners together: does the
+        # latency-hiding scheduler stack with the 32 MiB scoped budget?
+        "xla_vmem32_lhs": lambda: RAFTConfig(**base),
         "xla_vmem128": lambda: RAFTConfig(**base),
         "xla_vmem64": lambda: RAFTConfig(**base),
         "xla_vmem48": lambda: RAFTConfig(**base),
@@ -186,6 +189,9 @@ def main():
     }
     compiler_opts = {
         "xla_lhs_sched": {
+            "xla_tpu_enable_latency_hiding_scheduler": "true"},
+        "xla_vmem32_lhs": {
+            "xla_tpu_scoped_vmem_limit_kib": "32768",
             "xla_tpu_enable_latency_hiding_scheduler": "true"},
         "xla_vmem128": {"xla_tpu_scoped_vmem_limit_kib": "131072"},
         "xla_vmem64": {"xla_tpu_scoped_vmem_limit_kib": "65536"},
