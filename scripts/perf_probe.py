@@ -2,10 +2,17 @@
 batch 8, 368x496, 12 iters) to guide optimization.  Not part of the test
 suite; run on the real chip:  python scripts/perf_probe.py [variant ...]
 
-Variants: current, alt_pallas, alt_lax, alt_chunked, no_remat_policy,
-no_deferred_grad, convs_saved, corr_f32, fwd_only, and the
-things-config gradient-accumulation sweep things_accum{1,2,3} (400x720,
-batch 6 — train_standard.sh:4's high-res stage inside one chip's HBM).
+Variant families (see `variants` in main() for the full list):
+  on-demand corr impls   alt_pallas / alt_lax / alt_chunked
+  gradient-path knobs    no_remat_policy, convs_saved, deferred_grad,
+                         no_deferred_grad, corr_f32
+  dense-lookup kernels   pallas_lookup[_deferred], pallas_stacked[_deferred]
+  round-5 layout A/Bs    pad_lanes/no_pad_lanes, mask_f32/mask_bf16
+  compiler options       xla_vmem{16,24,32,48,64,128}, xla_lhs_sched,
+                         xla_vmem32_lhs (per-compile PJRT options;
+                         RAFT_PROBE_VMEM_KIB applies a budget globally)
+  shape sweeps           things_accum{1,2,3} (400x720 b6),
+                         chairs_b{12,16}[_accum2], fwd_only, fwd_vmem32
 """
 
 import os
