@@ -264,8 +264,11 @@ def main():
                 ca = ca[0] if ca else {}
             flops = float((ca or {}).get("flops", 0.0))
             step = compiled
-        except Exception:
-            pass  # plain jitted step; mfu reported as 0
+        except Exception as e:
+            # plain jitted step; mfu reported as 0
+            print(f"bench: AOT cost analysis unavailable "
+                  f"({type(e).__name__}: {str(e)[:120]}); continuing with "
+                  f"the plain jitted step", file=sys.stderr)
         # Warmup / compile.  Synchronization must be a host copy: over the
         # axon tunnel, block_until_ready returns before execution
         # finishes, which silently times dispatch instead of compute.

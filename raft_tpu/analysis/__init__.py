@@ -1,0 +1,34 @@
+"""graftlint: the raft_tpu static-analysis subsystem.
+
+Two engines, one findings model:
+
+- **AST linter** (:mod:`raft_tpu.analysis.lint` +
+  :mod:`raft_tpu.analysis.rules`): lexical JAX/TPU pitfalls — host
+  materialization and Python control flow on traced values, leftover
+  ``jax.debug`` callbacks, silent broad excepts, f64 literals.  Stdlib
+  only; never imports jax.
+- **jaxpr auditor** (:mod:`raft_tpu.analysis.jaxpr_audit`): abstract-
+  evals the real entry points and asserts graph-level invariants as
+  data — no f64 avals (traced under x64), bf16-policy conformance,
+  no host transfers inside scans, donation reflected in the lowering,
+  retrace stability, and a recompile-key report across presets.
+
+Run: ``python -m raft_tpu.analysis`` (or ``scripts/graftlint.py``), which
+exits nonzero on unwaived findings.  Gate semantics, waiver syntax and
+the JSON schema live in :mod:`raft_tpu.analysis.findings`.
+"""
+
+from raft_tpu.analysis.findings import (Finding, gate, render_json,
+                                        render_text)
+from raft_tpu.analysis.lint import lint_file, lint_source, run_lint
+
+__all__ = ["Finding", "gate", "render_json", "render_text", "lint_file",
+           "lint_source", "run_lint", "run_jaxpr_audit"]
+
+
+def run_jaxpr_audit(names=None):
+    """Lazy re-export: importing the analysis package must not import jax
+    (the lint lane runs jax-free)."""
+    from raft_tpu.analysis.jaxpr_audit import run_jaxpr_audit as _run
+
+    return _run(names)

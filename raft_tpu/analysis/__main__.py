@@ -1,0 +1,94 @@
+"""CLI driver: ``python -m raft_tpu.analysis [paths...]``.
+
+Default scope is the whole repo's production Python (the ``raft_tpu``
+package, ``scripts/``, ``bench.py``, ``__graft_entry__.py``) for the AST
+engine, plus every registered jaxpr audit.  Exits 1 when any unwaived
+error-severity finding survives — the contract ``scripts/graftlint.py``
+and the tier-1 lane build on.
+
+The jaxpr engine needs a CPU backend with 8 virtual devices (the sharded
+audit); this driver forces that BEFORE jax is first imported, same as
+tests/conftest.py, so it works under the image's pinned TPU backend too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu_with_virtual_devices() -> None:
+    # Must run before anything imports jax (same dance as
+    # tests/conftest.py: the env var alone does not beat the image's
+    # pinned plugin backend; utils.platform applies the config update).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def default_paths() -> list:
+    import raft_tpu
+
+    pkg = os.path.dirname(os.path.abspath(raft_tpu.__file__))
+    root = os.path.dirname(pkg)
+    cands = [pkg, os.path.join(root, "scripts"),
+             os.path.join(root, "bench.py"),
+             os.path.join(root, "__graft_entry__.py")]
+    return [p for p in cands if os.path.exists(p)]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "python -m raft_tpu.analysis",
+        description="graftlint: AST lint + jaxpr audit for raft_tpu")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories for the AST engine "
+                        "(default: raft_tpu/, scripts/, bench.py, "
+                        "__graft_entry__.py)")
+    p.add_argument("--engine", choices=["lint", "jaxpr", "all"],
+                   default="all")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated lint rule ids to run "
+                        "(default: all)")
+    p.add_argument("--audits", default=None,
+                   help="comma-separated jaxpr audit names "
+                        "(default: all)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (findings + report)")
+    p.add_argument("--verbose", action="store_true",
+                   help="also show waived findings and the full report")
+    args = p.parse_args(argv)
+
+    if args.engine in ("jaxpr", "all"):
+        _force_cpu_with_virtual_devices()
+
+    from raft_tpu.analysis import findings as fmod
+    from raft_tpu.analysis.lint import run_lint
+
+    all_findings = []
+    report = {}
+    if args.engine in ("lint", "all"):
+        rules = args.rules.split(",") if args.rules else None
+        all_findings += run_lint(args.paths or default_paths(), rules=rules)
+    if args.engine in ("jaxpr", "all"):
+        from raft_tpu.utils.platform import ensure_platform
+
+        ensure_platform(strict=True)
+        from raft_tpu.analysis.jaxpr_audit import run_jaxpr_audit
+
+        audits = args.audits.split(",") if args.audits else None
+        jfs, report = run_jaxpr_audit(audits)
+        all_findings += jfs
+
+    out = (fmod.render_json(all_findings, report) if args.json
+           else fmod.render_text(all_findings, report,
+                                 verbose=args.verbose))
+    print(out)
+    return 1 if fmod.gate(all_findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

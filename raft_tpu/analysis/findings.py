@@ -1,0 +1,91 @@
+"""Finding model shared by both graftlint engines.
+
+A finding is one violation of one named check, with enough provenance
+(path, line, engine) to be actionable and enough structure to be
+machine-consumed: ``python -m raft_tpu.analysis --json`` emits the exact
+dataclass fields below, and the tier-1 gate (tests/test_static_analysis.py,
+scripts/graftlint.py) keys off :func:`gate` — waived findings and notes
+never fail a run, everything else does.
+
+Waiver syntax (both engines):
+
+- AST engine: an inline comment on the offending line (or a standalone
+  comment on the line directly above)::
+
+      # graftlint: disable=<rule>[,<rule>...] -- <reason>
+
+  The reason is mandatory; a disable without one does not waive (the
+  linter reports it as a ``waiver-no-reason`` finding instead), so every
+  suppression in the tree is self-documenting.
+
+- jaxpr engine: entries in :data:`raft_tpu.analysis.jaxpr_audit.WAIVERS`
+  — invariants are asserted as data, and so are their exceptions
+  (e.g. optax's scalar bias-correction arithmetic under x64).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence
+
+# Severity ladder: "error" gates; "note" is informational (skipped audits,
+# report-only invariants) and never fails a run.
+SEVERITIES = ("error", "note")
+
+
+@dataclasses.dataclass
+class Finding:
+    engine: str              # "lint" | "jaxpr"
+    rule: str                # rule / invariant identifier
+    path: str                # file (lint) or entry-point name (jaxpr)
+    line: int                # 1-based line; 0 when not line-addressable
+    message: str
+    severity: str = "error"
+    waived: bool = False
+    waiver_reason: Optional[str] = None
+    # structured facts waiver predicates key on (e.g. {"scalar": True}
+    # for f64 avals) — never re-derived from the rendered message
+    data: Optional[Dict] = None
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        tag = self.severity.upper()
+        if self.waived:
+            tag = f"WAIVED({self.waiver_reason})"
+        return f"{loc}: [{self.rule}] {tag}: {self.message}"
+
+
+def gate(findings: Sequence[Finding]) -> List[Finding]:
+    """The findings that fail a run: unwaived errors only."""
+    return [f for f in findings if not f.waived and f.severity == "error"]
+
+
+def render_text(findings: Sequence[Finding], report: Optional[Dict] = None,
+                verbose: bool = False) -> str:
+    """Human-readable summary; waived findings appear only with verbose."""
+    lines = []
+    shown = [f for f in findings if verbose or not f.waived]
+    for f in sorted(shown, key=lambda f: (f.engine, f.path, f.line)):
+        lines.append(f.render())
+    gating = gate(findings)
+    n_waived = sum(1 for f in findings if f.waived)
+    lines.append(f"graftlint: {len(gating)} finding(s), "
+                 f"{n_waived} waived, "
+                 f"{len(findings) - n_waived - len(gating)} note(s)")
+    if report and verbose:
+        lines.append(json.dumps(report, indent=2, default=str))
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding],
+                report: Optional[Dict] = None) -> str:
+    payload = {
+        "findings": [f.to_dict() for f in findings],
+        "gate": len(gate(findings)),
+        "report": report or {},
+    }
+    return json.dumps(payload, indent=2, default=str)

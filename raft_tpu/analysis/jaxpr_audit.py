@@ -1,0 +1,563 @@
+"""graftlint engine 2: the jaxpr auditor.
+
+Abstract-evals the repo's real entry points (train step, sharded train
+step, eval forward, correlation lookups) via ``jax.make_jaxpr`` /
+``jax.eval_shape`` / ``.lower()`` — no FLOPs, no compiles — and asserts
+graph-level invariants the AST linter cannot see:
+
+- ``no-float64``: no f64 aval anywhere in the traced program.  Traced
+  UNDER ``jax.experimental.enable_x64`` with f32-specified inputs: the
+  default float dtype follows the x64 flag, so any dtype-less constructor
+  (``jax.random.uniform(key)``, a bare ``jnp.arange``) surfaces as an f64
+  aval here exactly where it would silently double the step's bandwidth
+  in an x64 environment.
+- ``bf16-policy``: under the bf16 compute policy, every ``dot_general``
+  with a bf16 operand must carry ``preferred_element_type=float32`` (the
+  corr pyramid's declared f32-accumulation boundary), and the step's
+  declared-f32 outputs (loss, metrics, updated params) stay f32.
+- ``scan-transfer``: no host-transfer/callback primitive inside any
+  ``scan``/``while`` body — a callback in the refinement scan means a
+  device->host round trip per iteration per step.
+- ``donation``: lowering the donated train step must reflect the
+  donation as input-output aliases (``tf.aliasing_output`` /
+  ``jax.buffer_donor``) covering at least every param leaf; a broken
+  donation silently doubles peak HBM.
+- ``retrace-stable``: building the same entry point twice must produce
+  byte-identical jaxprs — nondeterministic closures churn the compile
+  cache (a full XLA recompile per train-loop restart).
+
+Invariants are asserted as data; so are their exceptions: :data:`WAIVERS`
+carries provenance-scoped waivers with mandatory reasons (e.g. optax's
+scalar bias-correction arithmetic, which is f64 under x64 inside the
+optimizer library but scalar-only and cast back before touching state).
+
+Everything runs on CPU; the sharded audit wants 8 (virtual) devices —
+``python -m raft_tpu.analysis`` sets that up, tests inherit it from
+conftest.  With fewer devices the sharded audit reports a skip note
+instead of failing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu.analysis.findings import Finding
+
+# Primitives that move data across the device boundary or re-enter
+# Python.  Inside a scan body each costs a host round trip per iteration.
+TRANSFER_PRIMITIVES = {
+    "debug_callback", "pure_callback", "io_callback", "callback",
+    "infeed", "outfeed", "device_put", "copy_to_host_async",
+}
+
+# Control-flow primitives whose body jaxprs execute per iteration.
+_LOOP_PRIMITIVES = {"scan", "while"}
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxprWaiver:
+    """A data-declared exception to a jaxpr invariant."""
+
+    invariant: str           # which check this waives
+    provenance: str          # substring of the finding's provenance
+    reason: str              # mandatory — shows up in the report
+    scalar_only: bool = False  # waive only scalar avals (f64 checks)
+
+
+WAIVERS: Tuple[JaxprWaiver, ...] = (
+    JaxprWaiver(
+        invariant="no-float64",
+        provenance="optax/",
+        scalar_only=True,
+        reason="optax computes AdamW's scalar bias-correction terms in "
+               "the x64 default dtype internally and casts back before "
+               "they touch any state leaf; scalar-only, third-party"),
+)
+
+
+# --------------------------------------------------------------------------
+# jaxpr traversal (pure: unit-tested directly against fixture jaxprs)
+# --------------------------------------------------------------------------
+
+def _subjaxprs(eqn):
+    import jax._src.core as jcore
+
+    for val in eqn.params.values():
+        for item in (val if isinstance(val, (list, tuple)) else [val]):
+            if isinstance(item, jcore.ClosedJaxpr):
+                yield item.jaxpr
+            elif isinstance(item, jcore.Jaxpr):
+                yield item
+
+
+def iter_eqns(closed):
+    """Yield (eqn, inside_loop) over a ClosedJaxpr, recursing into every
+    nested jaxpr (pjit bodies, scan/while bodies, remat, custom_vjp...)."""
+    def walk(jaxpr, inside):
+        for eqn in jaxpr.eqns:
+            yield eqn, inside
+            child_inside = inside or eqn.primitive.name in _LOOP_PRIMITIVES
+            for sub in _subjaxprs(eqn):
+                yield from walk(sub, child_inside)
+    yield from walk(closed.jaxpr, False)
+
+
+def provenance(eqn) -> str:
+    """Best-effort provenance for an equation: the first repo frame, the
+    first library frame, or both ('repo via lib') when the op originates
+    inside a library called from repo code — waivers match on either."""
+    src = getattr(eqn, "source_info", None)
+    tb = getattr(src, "traceback", None)
+    frames = list(tb.frames) if tb is not None else []
+    repo = lib = jaxlib = None
+    for f in frames:
+        name = f.file_name
+        line = getattr(f, "line_num", 0)
+        if "site-packages" in name:
+            short = f"{name.split('site-packages/')[-1]}:{line}"
+            # jax's own machinery frames say nothing about WHOSE op this
+            # is; prefer the calling library (optax, flax, ...)
+            if short.startswith(("jax/", "jaxlib/")):
+                jaxlib = jaxlib or short
+            else:
+                lib = lib or short
+        elif "raft_tpu" in name or "/repo/" in name:
+            short = name.split("/repo/")[-1] if "/repo/" in name else name
+            repo = repo or f"{short}:{line}"
+        if repo and lib:
+            break
+    lib = lib or jaxlib
+    if repo and lib:
+        return f"{repo} via {lib}"
+    return repo or lib or f"<{eqn.primitive.name}>"
+
+
+def find_f64(closed) -> List[Tuple[str, str, bool]]:
+    """(dtype_desc, provenance, is_scalar) for every 64-bit float aval
+    produced anywhere in the jaxpr."""
+    out = []
+    for eqn, _ in iter_eqns(closed):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is not None and str(dt) in ("float64", "complex128"):
+                out.append((f"{dt}{list(getattr(aval, 'shape', ()))}",
+                            provenance(eqn),
+                            getattr(aval, "shape", ()) == ()))
+    return out
+
+
+def find_loop_transfers(closed) -> List[Tuple[str, str]]:
+    """(primitive, provenance) for every transfer/callback primitive that
+    executes inside a scan/while body."""
+    return [(eqn.primitive.name, provenance(eqn))
+            for eqn, inside in iter_eqns(closed)
+            if inside and eqn.primitive.name in TRANSFER_PRIMITIVES]
+
+
+def find_unaccumulated_bf16_dots(closed) -> List[Tuple[str, str]]:
+    """(desc, provenance) for dot_generals with a bf16 operand that do NOT
+    request f32 accumulation — each one silently rounds its contraction
+    at bf16, outside the declared corr-accumulation boundary."""
+    import jax.numpy as jnp
+
+    out = []
+    for eqn, _ in iter_eqns(closed):
+        if eqn.primitive.name != "dot_general":
+            continue
+        in_dts = [str(getattr(getattr(v, "aval", None), "dtype", ""))
+                  for v in eqn.invars]
+        if "bfloat16" not in in_dts:
+            continue
+        pref = eqn.params.get("preferred_element_type")
+        if pref != jnp.float32:
+            out.append((f"dot_general({', '.join(in_dts)}) -> "
+                        f"preferred_element_type={pref}", provenance(eqn)))
+    return out
+
+
+def donation_alias_count(lowered_text: str) -> int:
+    """Donated inputs visible in lowered stablehlo text."""
+    return (lowered_text.count("tf.aliasing_output")
+            + lowered_text.count("jax.buffer_donor"))
+
+
+def _normalize_jaxpr_str(s: str) -> str:
+    """Strip object addresses from jaxpr text before comparing: a
+    ``<function f at 0x...>`` repr in an eqn param differs per build
+    without changing the traced computation (function IDENTITY is
+    expected to differ across builds; structural divergence is not)."""
+    import re
+
+    return re.sub(r" at 0x[0-9a-f]+", "", s)
+
+
+def _apply_waivers(findings: List[Finding]) -> List[Finding]:
+    for f in findings:
+        for w in WAIVERS:
+            if w.invariant != f.rule:
+                continue
+            if w.provenance not in f.message:
+                continue
+            if w.scalar_only and not (f.data or {}).get("scalar"):
+                continue
+            f.waived = True
+            f.waiver_reason = w.reason
+            break
+    return findings
+
+
+def _finding(rule: str, entry: str, message: str,
+             severity: str = "error", data: Optional[Dict] = None) -> Finding:
+    return Finding(engine="jaxpr", rule=rule, path=entry, line=0,
+                   message=message, severity=severity, data=data)
+
+
+def _f64_findings(entry: str, closed) -> List[Finding]:
+    """no-float64 findings for every 64-bit float aval in ``closed``,
+    carrying the scalar flag the waiver predicate keys on."""
+    return [_finding(
+        "no-float64", entry,
+        f"float64 aval {dt} at {prov} — silent 64-bit promotion "
+        f"under x64", data={"scalar": scalar})
+        for dt, prov, scalar in find_f64(closed)]
+
+
+# --------------------------------------------------------------------------
+# tiny abstract harness (shapes chosen so every pyramid level stays >= 1px
+# and traces take seconds: trace cost scales with graph size, not shapes)
+# --------------------------------------------------------------------------
+
+_B, _H, _W, _ITERS = 2, 64, 64, 2
+
+
+def _tiny_batch():
+    import jax.numpy as jnp
+
+    return {
+        "image1": jnp.zeros((_B, _H, _W, 3), jnp.float32),
+        "image2": jnp.zeros((_B, _H, _W, 3), jnp.float32),
+        "flow": jnp.zeros((_B, _H, _W, 2), jnp.float32),
+        "valid": jnp.ones((_B, _H, _W), jnp.float32),
+    }
+
+
+def _abstract_pieces(model_overrides: Optional[Dict] = None):
+    """(model, state_sds, batch_sds): everything abstract, nothing computed."""
+    import jax
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.training import create_train_state, make_optimizer
+
+    cfg = RAFTConfig(**(model_overrides or {}))
+    model = RAFT(cfg)
+    tx, _ = make_optimizer(lr=4e-4, num_steps=100, wdecay=1e-4)
+    batch = _tiny_batch()
+    state_sds = jax.eval_shape(
+        lambda rng, b: create_train_state(model, tx, rng, b, iters=_ITERS),
+        jax.random.PRNGKey(0), batch)
+    batch_sds = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
+    return model, state_sds, batch_sds
+
+
+def _make_step(model, donate: bool = False, add_noise: bool = False):
+    from raft_tpu.training.step import make_train_step
+
+    return make_train_step(model, iters=_ITERS, gamma=0.8, max_flow=400.0,
+                           donate=donate, add_noise=add_noise)
+
+
+# --------------------------------------------------------------------------
+# entry-point audits
+# --------------------------------------------------------------------------
+
+def audit_train_step() -> Tuple[List[Finding], Dict]:
+    """training/step.py: f64 under x64, scan transfers, retrace stability."""
+    import jax
+    from jax.experimental import enable_x64
+
+    model, state_sds, batch_sds = _abstract_pieces()
+    findings: List[Finding] = []
+    with enable_x64():
+        # two INDEPENDENT builds: identical jaxprs == stable compile key.
+        # add_noise=True covers the widest trace (the noise path is where
+        # dtype-less random draws would hide).
+        jx1 = jax.make_jaxpr(_make_step(model, add_noise=True))(
+            state_sds, batch_sds)
+        jx2 = jax.make_jaxpr(_make_step(model, add_noise=True))(
+            state_sds, batch_sds)
+    s1, s2 = _normalize_jaxpr_str(str(jx1)), _normalize_jaxpr_str(str(jx2))
+    if s1 != s2:
+        diff_at = next((i for i, (a, b) in enumerate(zip(s1, s2))
+                        if a != b), min(len(s1), len(s2)))
+        findings.append(_finding(
+            "retrace-stable", "train_step",
+            f"two builds of the same train step trace differently "
+            f"(first divergence at char {diff_at}: "
+            f"...{s1[max(0, diff_at - 40):diff_at + 40]!r}...) — "
+            f"nondeterministic closure state churns the compile cache"))
+    findings.extend(_f64_findings("train_step", jx1))
+    for prim, prov in find_loop_transfers(jx1):
+        findings.append(_finding(
+            "scan-transfer", "train_step",
+            f"{prim} inside a scan body at {prov} — host round trip "
+            f"every refinement iteration"))
+    report = {"eqn_chars": len(s1)}
+    return _apply_waivers(findings), report
+
+
+def audit_donation() -> Tuple[List[Finding], Dict]:
+    """training/step.py donate=True: aliases must cover the state."""
+    import jax
+
+    model, state_sds, batch_sds = _abstract_pieces()
+    step = _make_step(model, donate=True)
+    low = step.lower(state_sds, batch_sds)
+    aliases = donation_alias_count(low.as_text())
+    n_param_leaves = len(jax.tree.leaves(state_sds.params))
+    findings: List[Finding] = []
+    # params + both AdamW moments should alias; require at least the
+    # param leaves (the conservative floor — optimizer layout may pack).
+    if aliases < n_param_leaves:
+        findings.append(_finding(
+            "donation", "train_step",
+            f"donate=True lowered to only {aliases} input-output aliases "
+            f"for {n_param_leaves} param leaves — donation is broken and "
+            f"peak HBM silently doubles (output state no longer reuses "
+            f"the donated buffers)"))
+    return findings, {"aliases": aliases, "param_leaves": n_param_leaves}
+
+
+def audit_bf16_policy() -> Tuple[List[Finding], Dict]:
+    """Mixed-precision boundary conformance on the bf16 train step."""
+    import jax
+    import jax.numpy as jnp
+
+    model, state_sds, batch_sds = _abstract_pieces(
+        {"compute_dtype": "bfloat16", "corr_dtype": "bfloat16"})
+    step = _make_step(model)
+    jx = jax.make_jaxpr(step)(state_sds, batch_sds)
+    findings: List[Finding] = []
+    bad = find_unaccumulated_bf16_dots(jx)
+    for desc, prov in bad:
+        findings.append(_finding(
+            "bf16-policy", "train_step_bf16",
+            f"{desc} at {prov} — bf16 contraction without f32 "
+            f"accumulation breaches the declared corr-accumulation "
+            f"boundary (ARCHITECTURE.md 'Mixed precision')"))
+    # Declared-f32 outputs: loss/metrics and every updated param leaf.
+    new_state, metrics = jax.eval_shape(step, state_sds, batch_sds)
+    for name, leaf in [("loss", metrics["loss"]), ("epe", metrics["epe"])]:
+        if leaf.dtype != jnp.float32:
+            findings.append(_finding(
+                "bf16-policy", "train_step_bf16",
+                f"metric '{name}' leaves the step as {leaf.dtype}; the "
+                f"loss boundary is declared f32"))
+    drift = [str(p.dtype) for p in jax.tree.leaves(new_state.params)
+             if p.dtype != jnp.float32]
+    if drift:
+        findings.append(_finding(
+            "bf16-policy", "train_step_bf16",
+            f"{len(drift)} updated param leaves drifted to {set(drift)} "
+            f"— master weights must stay f32 under the bf16 compute "
+            f"policy"))
+    n_dots = sum(1 for eqn, _ in iter_eqns(jx)
+                 if eqn.primitive.name == "dot_general")
+    return _apply_waivers(findings), {"dot_generals": n_dots,
+                                      "bf16_dots_unaccumulated": len(bad)}
+
+
+def audit_parallel_step() -> Tuple[List[Finding], Dict]:
+    """parallel/step.py under the (data=2, spatial=4) CPU mesh."""
+    import jax
+
+    if jax.device_count() < 8:
+        return [_finding(
+            "sharded-trace", "parallel_step",
+            f"skipped: needs 8 devices, have {jax.device_count()} (run "
+            f"via `python -m raft_tpu.analysis`, which forces 8 virtual "
+            f"CPU devices)", severity="note")], {}
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.parallel.mesh import make_mesh, set_mesh
+    from raft_tpu.parallel.step import make_parallel_train_step
+    from raft_tpu.training import create_train_state, make_optimizer
+
+    mesh = make_mesh(data=2, spatial=4)
+    model = RAFT(RAFTConfig(corr_shard=True))
+    tx, _ = make_optimizer(lr=4e-4, num_steps=100, wdecay=1e-4)
+    batch = _tiny_batch()
+    with set_mesh(mesh):
+        state_sds = jax.eval_shape(
+            lambda rng, b: create_train_state(model, tx, rng, b,
+                                              iters=_ITERS),
+            jax.random.PRNGKey(0), batch)
+        step = make_parallel_train_step(model, mesh, iters=_ITERS,
+                                        gamma=0.8, max_flow=400.0)
+        batch_sds = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
+        jx = jax.make_jaxpr(step)(state_sds, batch_sds)
+    findings = _f64_findings("parallel_step", jx)
+    for prim, prov in find_loop_transfers(jx):
+        findings.append(_finding(
+            "scan-transfer", "parallel_step",
+            f"{prim} inside a scan body at {prov}"))
+    return _apply_waivers(findings), {"mesh": dict(mesh.shape)}
+
+
+def audit_eval_forward() -> Tuple[List[Finding], Dict]:
+    """evaluation/evaluate.py-style jitted test_mode forward."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+
+    model = RAFT(RAFTConfig())
+    batch = _tiny_batch()
+    variables_sds = jax.eval_shape(
+        lambda rng, b: model.init(rng, b["image1"], b["image2"],
+                                  iters=_ITERS, train=True),
+        jax.random.PRNGKey(0), batch)
+    img_sds = jax.ShapeDtypeStruct((1, _H, _W, 3), jnp.float32)
+
+    def fwd(v, a, b):
+        return model.apply(v, a, b, iters=_ITERS, test_mode=True)
+
+    with enable_x64():
+        jx = jax.make_jaxpr(fwd)(variables_sds, img_sds, img_sds)
+    findings = _f64_findings("eval_forward", jx)
+    for prim, prov in find_loop_transfers(jx):
+        findings.append(_finding(
+            "scan-transfer", "eval_forward",
+            f"{prim} inside a scan body at {prov}"))
+    flow_low, flow_up = jax.eval_shape(fwd, variables_sds, img_sds, img_sds)
+    for name, leaf in [("flow_low", flow_low), ("flow_up", flow_up)]:
+        if leaf.dtype != jnp.float32:
+            findings.append(_finding(
+                "bf16-policy", "eval_forward",
+                f"{name} leaves the forward as {leaf.dtype}; flow is a "
+                f"declared-f32 boundary"))
+    return _apply_waivers(findings), {}
+
+
+def audit_corr_lookups() -> Tuple[List[Finding], Dict]:
+    """ops/corr.py + ops/corr_pallas.py lookup kernels, tiny shapes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    from raft_tpu.ops.corr import (build_corr_pyramid_direct,
+                                   build_fmap_pyramid, chunked_corr_lookup,
+                                   corr_lookup)
+
+    B, H8, W8, C = 1, 8, 8, 16
+    f1 = jax.ShapeDtypeStruct((B, H8, W8, C), jnp.float32)
+    f2 = jax.ShapeDtypeStruct((B, H8, W8, C), jnp.float32)
+    coords = jax.ShapeDtypeStruct((B, H8, W8, 2), jnp.float32)
+    findings: List[Finding] = []
+    report: Dict = {"traced": []}
+
+    def dense(fm1, fm2, co):
+        pyr = build_corr_pyramid_direct(fm1, fm2, 4)
+        return corr_lookup(pyr, co, radius=4)
+
+    def chunked(fm1, fm2, co):
+        return chunked_corr_lookup(fm1, build_fmap_pyramid(fm2, 4), co,
+                                   radius=4, chunk=32)
+
+    entries = [("corr_lookup_dense", dense), ("corr_lookup_chunked", chunked)]
+    try:
+        from raft_tpu.ops.corr_pallas import ondemand_corr_lookup
+
+        def pallas(fm1, fm2, co):
+            return ondemand_corr_lookup(fm1, build_fmap_pyramid(fm2, 4),
+                                        co, radius=4)
+
+        entries.append(("corr_lookup_pallas", pallas))
+    except ImportError as e:
+        findings.append(_finding(
+            "no-float64", "corr_lookup_pallas",
+            f"skipped: pallas kernel unavailable here ({e})",
+            severity="note"))
+
+    for name, fn in entries:
+        try:
+            with enable_x64():
+                jx = jax.make_jaxpr(fn)(f1, f2, coords)
+        except (TypeError, ValueError, NotImplementedError,
+                jax.errors.JAXTypeError) as e:
+            findings.append(_finding(
+                "no-float64", name,
+                f"skipped: does not trace on this jax "
+                f"({type(e).__name__}: {e})", severity="note"))
+            continue
+        report["traced"].append(name)
+        findings.extend(_f64_findings(name, jx))
+    return _apply_waivers(findings), report
+
+
+def audit_recompile_keys() -> Tuple[List[Finding], Dict]:
+    """Static-arg signature report across STAGE_PRESETS (data only).
+
+    Two presets with identical signatures share one compiled executable;
+    the report makes the executable count visible so a config change that
+    splits a previously-shared signature (recompile churn) shows up in
+    review diffs of the analysis output.
+    """
+    from raft_tpu.config import STAGE_PRESETS
+
+    sigs: Dict[str, str] = {}
+    for name, cfg in STAGE_PRESETS.items():
+        sig = {
+            "model": dataclasses.asdict(cfg.model),
+            "iters": cfg.train.iters,
+            "gamma": cfg.train.gamma,
+            "max_flow": cfg.train.max_flow,
+            "freeze_bn": cfg.train.freeze_bn,
+            "add_noise": cfg.train.add_noise,
+            "image_size": list(cfg.data.image_size),
+            "batch_size": cfg.data.batch_size,
+        }
+        sigs[name] = json.dumps(sig, sort_keys=True)
+    groups: Dict[str, List[str]] = {}
+    for name, sig in sigs.items():
+        groups.setdefault(sig, []).append(name)
+    report = {
+        "presets": len(sigs),
+        "distinct_step_signatures": len(groups),
+        "signature_groups": sorted(sorted(v) for v in groups.values()),
+    }
+    return [], report
+
+
+ENTRY_AUDITS: Dict[str, Callable[[], Tuple[List[Finding], Dict]]] = {
+    "train_step": audit_train_step,
+    "donation": audit_donation,
+    "bf16_policy": audit_bf16_policy,
+    "parallel_step": audit_parallel_step,
+    "eval_forward": audit_eval_forward,
+    "corr_lookups": audit_corr_lookups,
+    "recompile_keys": audit_recompile_keys,
+}
+
+
+def run_jaxpr_audit(names: Optional[Sequence[str]] = None
+                    ) -> Tuple[List[Finding], Dict]:
+    """Run the named audits (default: all).  Returns (findings, report)."""
+    findings: List[Finding] = []
+    report: Dict = {}
+    for name, audit in ENTRY_AUDITS.items():
+        if names is not None and name not in names:
+            continue
+        fs, rep = audit()
+        findings.extend(fs)
+        if rep:
+            report[name] = rep
+    return findings, report

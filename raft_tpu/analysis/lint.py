@@ -1,0 +1,145 @@
+"""graftlint engine 1: the repo-aware AST linter.
+
+Runs every registered rule (analysis/rules/) over a set of Python files
+and applies inline waivers.  Pure stdlib ``ast``/``tokenize`` — importing
+this module never imports jax, so the lint lane stays sub-second per file
+and runs anywhere.
+
+Waiver syntax (see analysis/findings.py): a comment
+
+    # graftlint: disable=<rule>[,<rule>...] -- <reason>
+
+waives matching findings on its own line (inline comment) or on the next
+line (standalone comment line).  ``disable=all`` waives every rule.  The
+reason is mandatory — a reasonless disable waives nothing and is itself
+reported (rule ``waiver-no-reason``).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from raft_tpu.analysis.findings import Finding
+from raft_tpu.analysis.rules import RULES, LintContext
+
+_WAIVER_RE = re.compile(
+    r"graftlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"\s*(?:--\s*(\S.*?)\s*)?$")
+
+
+def parse_waivers(source: str, path: str
+                  ) -> Tuple[Dict[int, Tuple[set, str]], List[Finding]]:
+    """Extract waivers: {line_it_applies_to: (rule_ids, reason)}.
+
+    Uses the tokenizer (not a regex over raw lines) so '#' inside string
+    literals can never fake a waiver.  A comment that is the only thing
+    on its line applies to the NEXT line; an inline comment applies to
+    its own line.
+    """
+    waivers: Dict[int, Tuple[set, str]] = {}
+    findings: List[Finding] = []
+    lines = source.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return waivers, findings
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _WAIVER_RE.search(tok.string)
+        if not m:
+            continue
+        row = tok.start[0]
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2)
+        if not reason:
+            findings.append(Finding(
+                engine="lint", rule="waiver-no-reason", path=path, line=row,
+                message="graftlint waiver without a reason — append "
+                        "'-- <why this is safe>'; reasonless waivers "
+                        "waive nothing"))
+            continue
+        standalone = lines[row - 1].lstrip().startswith("#") \
+            if row - 1 < len(lines) else False
+        applies = row
+        if standalone:
+            # A standalone waiver governs the next statement line: skip
+            # past the rest of its comment block (and blank lines).
+            applies = row + 1
+            while applies <= len(lines):
+                stripped = lines[applies - 1].strip()
+                if stripped and not stripped.startswith("#"):
+                    break
+                applies += 1
+        if applies in waivers:
+            prev_rules, prev_reason = waivers[applies]
+            rules = rules | prev_rules
+            reason = f"{prev_reason}; {reason}"
+        waivers[applies] = (rules, reason)
+    return waivers, findings
+
+
+def apply_waivers(findings: Sequence[Finding],
+                  waivers: Dict[int, Tuple[set, str]]) -> List[Finding]:
+    out = []
+    for f in findings:
+        w = waivers.get(f.line)
+        if w and (f.rule in w[0] or "all" in w[0]):
+            f.waived = True
+            f.waiver_reason = w[1]
+        out.append(f)
+    return out
+
+
+def lint_source(source: str, path: str,
+                rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one file's source text.  ``rules`` restricts to a subset of
+    rule ids (default: all registered rules)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(engine="lint", rule="syntax-error", path=path,
+                        line=e.lineno or 0,
+                        message=f"file does not parse: {e.msg}")]
+    ctx = LintContext(path=path, source=source, tree=tree)
+    findings: List[Finding] = []
+    for rule_id, rule in RULES.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        findings.extend(rule.check(ctx))
+    waivers, waiver_findings = parse_waivers(source, path)
+    return apply_waivers(findings, waivers) + waiver_findings
+
+
+def lint_file(path: str,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path, rules=rules)
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def run_lint(paths: Sequence[str],
+             rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint every .py file under ``paths`` (files or directories)."""
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, rules=rules))
+    return findings

@@ -1,0 +1,284 @@
+"""graftlint AST rules: repo-aware JAX/TPU pitfall detectors.
+
+Each rule is a :class:`LintRule` registered in :data:`RULES`.  Rules see a
+per-file :class:`LintContext` (parsed tree, parent links, detected
+jit-context functions) and return findings; waivers are applied by the
+engine (raft_tpu.analysis.lint), not by rules.
+
+Division of labor with the jaxpr engine (analysis/jaxpr_audit.py): these
+rules are *lexical* — they catch the pattern where it is written (host
+calls inside a ``@jax.jit`` body, f64 literals, swallow-everything
+handlers) without cross-function dataflow.  Graph-level truth (what
+actually ends up in the compiled computation, through any call chain)
+belongs to the jaxpr auditor.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from raft_tpu.analysis.findings import Finding
+
+# Attribute accesses on a traced value that are static at trace time —
+# reading them is not a host transfer and branching on them is not
+# tracer-dependent control flow.
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "weak_type",
+                "sharding", "device"}
+
+
+@dataclasses.dataclass
+class JitFunction:
+    """A function whose body is traced (lexically jit-rooted or nested)."""
+
+    node: ast.AST                  # FunctionDef / AsyncFunctionDef / Lambda
+    tainted: Set[str]              # traced-value names: own params + params
+    #                                of every enclosing jit-context function
+
+
+class LintContext:
+    """Parsed state for one file, shared by all rules."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.jit_functions: List[JitFunction] = collect_jit_functions(tree)
+        # local name -> dotted module it was imported from ("jax.random",
+        # "numpy", ...), so rules can distinguish `from jax import random`
+        # from stdlib `import random`.
+        self.import_map: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_map[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.import_map[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        while node in self.parents:
+            node = self.parents[node]
+            yield node
+
+
+class LintRule:
+    rule_id: str = ""
+    description: str = ""
+
+    def check(self, ctx: LintContext) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, ctx: LintContext, node: ast.AST, message: str) -> Finding:
+        return Finding(engine="lint", rule=self.rule_id, path=ctx.path,
+                       line=getattr(node, "lineno", 0), message=message)
+
+
+# --------------------------------------------------------------------------
+# jit-context detection
+# --------------------------------------------------------------------------
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# jax transforms whose function argument gets traced.
+_TRACING_CALLS = {"jit", "vmap", "pmap", "grad", "value_and_grad",
+                  "checkpoint", "remat", "make_jaxpr", "eval_shape",
+                  "linearize", "vjp", "jvp", "custom_vjp", "custom_jvp"}
+# jax.lax control-flow HOFs: every callable argument is traced.
+_LAX_HOFS = {"scan", "map", "while_loop", "fori_loop", "cond", "switch",
+             "associative_scan", "custom_root", "custom_linear_solve"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` / ``nn.jit`` as an expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _decorator_is_tracing(dec: ast.AST) -> bool:
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        f = dec.func
+        if _is_jit_expr(f):                      # @jax.jit(static_argnums=..)
+            return True
+        is_partial = ((isinstance(f, ast.Attribute) and f.attr == "partial")
+                      or (isinstance(f, ast.Name) and f.id == "partial"))
+        if is_partial and dec.args and _is_jit_expr(dec.args[0]):
+            return True                          # @functools.partial(jax.jit,)
+        if isinstance(f, ast.Attribute) and f.attr in _TRACING_CALLS:
+            return True                          # @jax.vmap etc.
+    return False
+
+
+def _attr_name(node: ast.AST) -> Optional[str]:
+    return node.attr if isinstance(node, ast.Attribute) else (
+        node.id if isinstance(node, ast.Name) else None)
+
+
+def _collect_call_roots(tree: ast.AST) -> Set[ast.AST]:
+    """Functions made jit roots at a CALL site: ``jax.jit(f)``, lambdas
+    passed to jit, and callables handed to jax.lax HOFs / jax transforms.
+
+    Name arguments resolve against every same-file def with that name —
+    deliberately scope-blind (over-approximate: stricter linting only).
+    """
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    roots: Set[ast.AST] = set()
+
+    def mark(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Lambda):
+            roots.add(arg)
+        elif isinstance(arg, ast.Name):
+            roots.update(defs_by_name.get(arg.id, ()))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _attr_name(node.func)
+        if fname is None:
+            continue
+        chain = attr_chain(node.func)
+        if fname in _TRACING_CALLS:
+            # skip look-alike namespaces: jax.tree.map is host-side,
+            # builtin map is not a trace point
+            if chain[:-1] and chain[-2] == "tree":
+                continue
+            if node.args:
+                mark(node.args[0])
+        elif fname in _LAX_HOFS and "lax" in chain[:-1]:
+            for arg in node.args:
+                mark(arg)
+    return roots
+
+
+def collect_jit_functions(tree: ast.AST) -> List[JitFunction]:
+    """Every function in lexical jit context, with its tainted-name set.
+
+    A function is in jit context when it is a jit root (tracing decorator
+    or call site) or lexically nested inside one — nested defs run during
+    the enclosing trace, so their bodies see tracers too.  Tainted names
+    are the union of the function's own parameters and the parameters of
+    every enclosing jit-context function; closure variables of NON-traced
+    enclosing factories (e.g. ``make_train_step(iters=...)``) stay
+    untainted — they are trace-time constants.
+    """
+    roots = _collect_call_roots(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_decorator_is_tracing(d) for d in node.decorator_list):
+                roots.add(node)
+
+    out: List[JitFunction] = []
+
+    def params_of(node: ast.AST) -> Set[str]:
+        a = node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    def visit(node: ast.AST, enclosing_taint: Optional[Set[str]]) -> None:
+        taint = enclosing_taint
+        if isinstance(node, _FUNC_NODES):
+            in_jit = node in roots or enclosing_taint is not None
+            if in_jit:
+                taint = params_of(node) | (enclosing_taint or set())
+                out.append(JitFunction(node=node, tainted=taint))
+            else:
+                taint = None
+        for child in ast.iter_child_nodes(node):
+            visit(child, taint)
+
+    visit(tree, None)
+    return out
+
+
+def iter_body_shallow(func_node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a jit function's body without descending into nested function
+    definitions (each nested function has its own JitFunction entry)."""
+    stack = (list(func_node.body) if not isinstance(func_node, ast.Lambda)
+             else [func_node.body])
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, _FUNC_NODES):
+                stack.append(child)
+
+
+def unshielded_tainted_names(ctx: LintContext, expr: ast.AST,
+                             tainted: Set[str]) -> List[ast.Name]:
+    """Tainted Name loads inside ``expr`` that are NOT behind a static
+    accessor (``x.shape`` / ``x.dtype`` / ... / ``len(x)`` /
+    ``isinstance(x, ...)`` / ``x is None``) — i.e. references whose VALUE
+    the surrounding code is about to consume on the host."""
+    hits = []
+    for node in ast.walk(expr):
+        if not (isinstance(node, ast.Name) and node.id in tainted
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        shielded = False
+        prev: ast.AST = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Attribute) and anc.value is prev \
+                    and anc.attr in STATIC_ATTRS:
+                shielded = True
+                break
+            if isinstance(anc, ast.Call):
+                cname = _attr_name(anc.func)
+                if cname in ("len", "isinstance", "getattr", "hasattr",
+                             "type"):
+                    shielded = True
+                    break
+            if isinstance(anc, ast.Compare) and any(
+                    isinstance(c, ast.Constant) and c.value is None
+                    for c in anc.comparators):
+                shielded = True        # `x is None` style presence checks
+                break
+            if anc is expr:
+                break
+            prev = anc
+        if not shielded:
+            hits.append(node)
+    return hits
+
+
+def attr_chain(node: ast.AST) -> List[str]:
+    """``jax.debug.print`` -> ["jax", "debug", "print"]; [] if not a pure
+    Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+# Registry — populated by the rule modules at import time (bottom of file).
+RULES: Dict[str, LintRule] = {}
+
+
+def register(rule: LintRule) -> LintRule:
+    assert rule.rule_id not in RULES, rule.rule_id
+    RULES[rule.rule_id] = rule
+    return rule
+
+
+from raft_tpu.analysis.rules import f64, hygiene, jit_rules  # noqa: E402,F401

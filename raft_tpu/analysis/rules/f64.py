@@ -1,0 +1,76 @@
+"""``f64-literal`` — 64-bit float literals/casts outside whitelisted I/O.
+
+The training system is a strict f32/bf16 shop (PAPER.md mixed-precision
+policy; docs/ARCHITECTURE.md "Mixed precision"): on TPU an f64 aval
+either fails to lower or silently doubles bandwidth on the exact
+memory-bound paths this repo spent five rounds tuning.  The rule flags
+the lexical sources — ``np.float64`` / ``jnp.float64`` / ``np.double``
+references, ``dtype="float64"`` keywords, ``.astype("float64")``, and
+``jax.config.update("jax_enable_x64", True)``.  Legitimate host-side I/O
+precision (e.g. the KITTI PNG encode in data/frame_utils.py) carries an
+inline waiver.  The graph-level counterpart (f64 avals appearing in a
+traced entry point through ANY call chain) is the jaxpr auditor's
+``no-float64`` invariant.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from raft_tpu.analysis.findings import Finding
+from raft_tpu.analysis.rules import (LintContext, LintRule, attr_chain,
+                                     register)
+
+_F64_ATTRS = {"float64", "double", "complex128", "longdouble"}
+_F64_STRINGS = {"float64", "double", "complex128", "f8", "<f8", ">f8"}
+_DTYPE_ROOTS = {"np", "numpy", "jnp", "jax", "onp"}
+
+
+class F64LiteralRule(LintRule):
+    rule_id = "f64-literal"
+    description = "64-bit float literal/cast outside whitelisted I/O"
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and node.attr in _F64_ATTRS:
+                chain = attr_chain(node)
+                if chain and chain[0] in _DTYPE_ROOTS:
+                    out.append(self.finding(
+                        ctx, node,
+                        f"{'.'.join(chain)} — f64 never lowers well on "
+                        f"TPU and doubles bandwidth; use float32 (or "
+                        f"waive if this is host-side I/O precision)"))
+            elif isinstance(node, ast.keyword) and node.arg == "dtype" \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value in _F64_STRINGS:
+                out.append(self.finding(
+                    ctx, node.value,
+                    f"dtype={node.value.value!r} — 64-bit dtype literal"))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value in _F64_STRINGS:
+                out.append(self.finding(
+                    ctx, node,
+                    f".astype({node.args[0].value!r}) — 64-bit cast"))
+            elif isinstance(node, ast.Call) \
+                    and attr_chain(node.func)[-1:] == ["update"] \
+                    and len(node.args) >= 2 \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value == "jax_enable_x64" \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and node.args[1].value is True:
+                out.append(self.finding(
+                    ctx, node,
+                    "jax_enable_x64=True — flips the DEFAULT dtype of "
+                    "every dtype-less array constructor to 64-bit; the "
+                    "audited entry points must stay correct without it "
+                    "(see the jaxpr no-float64 invariant, which traces "
+                    "under x64 exactly to catch what this would unleash)"))
+        return out
+
+
+register(F64LiteralRule())

@@ -1,0 +1,107 @@
+"""Whole-file hygiene rules (no jit context required).
+
+``debug-print`` — leftover ``jax.debug.print`` / ``jax.debug.breakpoint``.
+Both insert host callbacks into the compiled program: a per-call device->
+host round trip that serializes the dispatch pipeline (and breaks donation
+of any operand they capture).  Debug-only by design; they must not ship.
+
+``silent-except`` — a broad handler (bare ``except:``, ``Exception``,
+``BaseException``) whose body neither re-raises, nor uses the bound
+exception, nor logs anything.  These erased real failures twice in this
+repo's history (a missing compiler surfacing as "native decoders silently
+absent").  Narrow the type to what the call can actually raise, or log
+the reason; genuinely-intentional swallows carry an inline waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from raft_tpu.analysis.findings import Finding
+from raft_tpu.analysis.rules import (LintContext, LintRule, attr_chain,
+                                     register)
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_CALL_NAMES = {"print", "warn", "warning", "error", "exception", "info",
+                   "debug", "critical", "log", "write"}
+
+
+class DebugPrintRule(LintRule):
+    rule_id = "debug-print"
+    description = "leftover jax.debug.print / jax.debug.breakpoint"
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if len(chain) >= 3 and chain[-3:-1] == ["jax", "debug"] \
+                    and chain[-1] in ("print", "breakpoint"):
+                out.append(self.finding(
+                    ctx, node,
+                    f"leftover jax.debug.{chain[-1]} — compiles to a host "
+                    f"callback (per-call device sync); remove before "
+                    f"shipping"))
+            elif len(chain) == 2 and chain == ["debug", chain[-1]] \
+                    and chain[-1] in ("print", "breakpoint"):
+                # `from jax import debug; debug.print(...)`
+                out.append(self.finding(
+                    ctx, node,
+                    f"leftover debug.{chain[-1]} — host callback in "
+                    f"compiled code; remove before shipping"))
+        return out
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(el) for el in type_node.elts)
+    name = type_node.attr if isinstance(type_node, ast.Attribute) else (
+        type_node.id if isinstance(type_node, ast.Name) else None)
+    return name in _BROAD
+
+
+class SilentExceptRule(LintRule):
+    rule_id = "silent-except"
+    description = ("broad exception handler that swallows the error "
+                   "without using or logging it")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type):
+                continue
+            if self._body_accounts_for_error(node):
+                continue
+            what = ("bare except" if node.type is None
+                    else f"except {ast.unparse(node.type)}")
+            out.append(self.finding(
+                ctx, node,
+                f"{what} swallows the error silently — narrow the type "
+                f"to what the guarded call raises, log the reason, or "
+                f"waive with a comment explaining why losing it is safe"))
+        return out
+
+    @staticmethod
+    def _body_accounts_for_error(handler: ast.ExceptHandler) -> bool:
+        for stmt in handler.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Raise):
+                    return True
+                if handler.name and isinstance(node, ast.Name) \
+                        and node.id == handler.name:
+                    return True            # stores/inspects the exception
+                if isinstance(node, ast.Call):
+                    chain = attr_chain(node.func)
+                    if chain and chain[-1] in _LOG_CALL_NAMES:
+                        return True        # prints/logs something
+        return False
+
+
+register(DebugPrintRule())
+register(SilentExceptRule())

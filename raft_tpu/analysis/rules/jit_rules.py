@@ -1,0 +1,140 @@
+"""Rules that only apply inside lexical jit context (traced code).
+
+``host-transfer`` — host materialization of traced values: any
+``np.*(...)`` call fed a traced name, ``float()``/``int()``/``bool()`` of
+a traced value, ``.item()``/``.tolist()``/``.numpy()`` on one,
+``jax.device_get`` / ``.block_until_ready()``.  Each of these forces a
+device->host sync inside code that is supposed to stage out as one XLA
+program — at best a ConcretizationTypeError at trace time, at worst (via
+``jax.debug`` callbacks or shape-dependent paths) a silent per-step sync.
+
+``tracer-control`` — Python control flow on traced VALUES: ``if``/
+``while``/ternary tests that compare or do arithmetic on a traced name
+(``.shape``/``.dtype``-style static accessors are exempt, as is bare-name
+truthiness — the pytree-container emptiness idiom), plus Python-side
+randomness (``np.random``, stdlib ``random``) inside traced code, which
+bakes one fixed draw into the compiled executable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from raft_tpu.analysis.findings import Finding
+from raft_tpu.analysis.rules import (LintContext, LintRule, attr_chain,
+                                     iter_body_shallow, register,
+                                     unshielded_tainted_names)
+
+_NP_ROOTS = {"np", "numpy", "onp"}
+_HOST_METHODS = {"item", "tolist", "numpy", "to_py", "block_until_ready"}
+_HOST_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+class HostTransferRule(LintRule):
+    rule_id = "host-transfer"
+    description = ("host materialization of a traced value inside "
+                   "jit-context code")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in ctx.jit_functions:
+            for node in iter_body_shallow(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                tainted_args = [
+                    n for arg in list(node.args)
+                    + [k.value for k in node.keywords]
+                    for n in unshielded_tainted_names(ctx, arg, fn.tainted)]
+
+                if chain and chain[0] in _NP_ROOTS and tainted_args:
+                    out.append(self.finding(
+                        ctx, node,
+                        f"numpy call {'.'.join(chain)}() on traced value "
+                        f"'{tainted_args[0].id}' — forces host "
+                        f"materialization inside jitted code"))
+                elif chain and chain[-1] == "device_get" and tainted_args:
+                    out.append(self.finding(
+                        ctx, node,
+                        f"jax.device_get on traced value "
+                        f"'{tainted_args[0].id}' inside jitted code"))
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _HOST_METHODS
+                      and unshielded_tainted_names(ctx, node.func.value,
+                                                   fn.tainted)):
+                    out.append(self.finding(
+                        ctx, node,
+                        f".{node.func.attr}() on a traced value — "
+                        f"device->host transfer inside jitted code"))
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in _HOST_BUILTINS and tainted_args):
+                    out.append(self.finding(
+                        ctx, node,
+                        f"{node.func.id}() of traced value "
+                        f"'{tainted_args[0].id}' — concretizes the tracer "
+                        f"(ConcretizationTypeError or silent host sync)"))
+        return out
+
+
+class TracerControlRule(LintRule):
+    rule_id = "tracer-control"
+    description = ("Python control flow / randomness on traced values "
+                   "inside jit-context code")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in ctx.jit_functions:
+            for node in iter_body_shallow(fn.node):
+                if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    out.extend(self._check_test(ctx, fn, node))
+                elif isinstance(node, ast.Call):
+                    chain = attr_chain(node.func)
+                    if (len(chain) >= 2 and chain[0] in _NP_ROOTS
+                            and chain[1] == "random") or \
+                            (chain and chain[0] == "random"
+                             and not ctx.import_map.get(
+                                 "random", "random").startswith("jax")):
+                        out.append(self.finding(
+                            ctx, node,
+                            f"Python-side randomness "
+                            f"{'.'.join(chain)}() in jitted code — the "
+                            f"draw happens once at trace time and is "
+                            f"baked into the executable; use jax.random "
+                            f"with a threaded key"))
+        return out
+
+    def _check_test(self, ctx, fn, node) -> List[Finding]:
+        names = unshielded_tainted_names(ctx, node.test, fn.tainted)
+        if not names:
+            return []
+        # Bare-name truthiness (`if batch_stats:`) is the pytree-container
+        # emptiness idiom — static under trace.  Comparisons/arithmetic on
+        # the traced value are the real hazard.
+        hazardous = []
+        for n in names:
+            for anc in ctx.ancestors(n):
+                # `not x` is truthiness in the other polarity — same
+                # container-emptiness carve-out as the bare name.
+                if isinstance(anc, ast.UnaryOp) \
+                        and isinstance(anc.op, ast.Not):
+                    continue
+                if isinstance(anc, (ast.Compare, ast.BinOp, ast.UnaryOp)):
+                    hazardous.append(n)
+                    break
+                if anc is node:
+                    break
+        if not hazardous:
+            return []
+        kw = type(node).__name__.lower()
+        return [self.finding(
+            ctx, node,
+            f"`{kw}` on a value computed from traced input "
+            f"'{hazardous[0].id}' — tracer-dependent Python control flow "
+            f"(TracerBoolConversionError, or a static branch frozen at "
+            f"trace time); use lax.cond/jnp.where, or shield with "
+            f".shape/.dtype if the predicate is static")]
+
+
+register(HostTransferRule())
+register(TracerControlRule())

@@ -250,14 +250,14 @@ def train(args) -> str:
     # Mesh first: the model trace (create_train_state) needs the ambient
     # mesh bound when corr_shard is on (the ring construction reads it
     # via get_abstract_mesh; GSPMD constrains no-op without one).
-    import contextlib
+    from raft_tpu.parallel.mesh import set_mesh
 
     n_dev = args.data_parallel * args.spatial_parallel
     mesh = None
     if n_dev > 1:
         mesh = make_mesh(data=args.data_parallel,
                          spatial=args.spatial_parallel)
-    mesh_ctx = jax.set_mesh(mesh) if mesh else contextlib.nullcontext()
+    mesh_ctx = set_mesh(mesh)
 
     # Batch sharding, computed before init so the multi-host guard below
     # can fail fast when no mesh was requested.

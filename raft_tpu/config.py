@@ -17,6 +17,17 @@ from typing import Optional, Sequence, Tuple
 # source for config validation and every CLI's --corr_impl choices.
 CORR_IMPLS = ("chunked", "pallas", "lax")
 
+# remat_policy names validated without importing jax ("" = save nothing,
+# "convs_and_dots_saveable" = ours, the rest are jax.checkpoint_policies
+# members as of the pinned jax); anything else falls back to jax
+# introspection in __post_init__.
+_KNOWN_REMAT_POLICIES = frozenset({
+    "", "convs_and_dots_saveable", "everything_saveable",
+    "nothing_saveable", "dots_saveable", "checkpoint_dots",
+    "dots_with_no_batch_dims_saveable",
+    "checkpoint_dots_with_no_batch_dims",
+})
+
 
 @dataclasses.dataclass(frozen=True)
 class RAFTConfig:
@@ -173,7 +184,11 @@ class RAFTConfig:
         # on-demand path's feature-block dtype (models/raft.py casts the
         # fmap pyramid; the Pallas kernels and chunked lookups contract
         # bf16 blocks at full MXU rate with f32 accumulation).
-        if self.remat_policy and self.remat_policy != "convs_and_dots_saveable":
+        if self.remat_policy not in _KNOWN_REMAT_POLICIES:
+            # unknown names fall through to jax introspection; the
+            # whitelist keeps `import raft_tpu.config` (STAGE_PRESETS
+            # construction) jax-free — the graftlint AST lane and CLI
+            # --help paths must not pay the jax import
             import jax
 
             if not hasattr(jax.checkpoint_policies, self.remat_policy):

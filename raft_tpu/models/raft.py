@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.config import RAFTConfig
-from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
+from raft_tpu.parallel.mesh import (DATA_AXIS, SPATIAL_AXIS, constrain,
+                                    get_abstract_mesh)
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import BasicUpdateBlock, MaskHead, SmallUpdateBlock
 from raft_tpu.ops.corr import (
@@ -227,7 +228,7 @@ class RAFT(nn.Module):
             # (data, spatial) — no device holds all of fmap2.
             from raft_tpu.parallel.ring import ring_corr_pyramid
 
-            mesh = jax.sharding.get_abstract_mesh()
+            mesh = get_abstract_mesh()
             pyramid = ring_corr_pyramid(fmap1, fmap2, mesh, cfg.corr_levels)
             corr_state = tuple(p.astype(corr_dt) for p in pyramid)
         elif cfg.lookup_impl == "pallas":

@@ -31,7 +31,7 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     """
     import jax
 
-    if jax.distributed.is_initialized():
+    if _is_initialized(jax):
         return
     if coordinator_address is None and "COORDINATOR_ADDRESS" in os.environ:
         coordinator_address = os.environ["COORDINATOR_ADDRESS"]
@@ -52,3 +52,14 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+
+
+def _is_initialized(jax) -> bool:
+    """jax.distributed.is_initialized, with a fallback for jax < 0.5
+    (the service handle lives on the legacy global_state there)."""
+    checker = getattr(jax.distributed, "is_initialized", None)
+    if checker is not None:
+        return bool(checker())
+    from jax._src import distributed
+
+    return distributed.global_state.client is not None

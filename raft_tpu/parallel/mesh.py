@@ -15,14 +15,55 @@ Axes:
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, Optional
 
 import jax
 import numpy as np
-from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 SPATIAL_AXIS = "spatial"
+
+# --- version-compat shims -------------------------------------------------
+# The deployment image carries a current JAX; CI/dev containers may run an
+# older release (0.4.x) that predates explicit-sharding APIs.  Everything
+# here resolves the new API when present and falls back to the legacy
+# ambient-mesh machinery otherwise, so the same call sites work on both.
+
+try:
+    from jax.sharding import AxisType
+    _MESH_KWARGS = {"axis_types": (AxisType.Auto, AxisType.Auto)}
+except ImportError:  # jax < 0.5: meshes are implicitly Auto
+    _MESH_KWARGS = {}
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    """Context manager binding ``mesh`` as the ambient mesh.
+
+    New JAX: ``jax.set_mesh``.  Legacy fallback: a ``Mesh`` is its own
+    context manager (the pre-``set_mesh`` idiom).  ``None`` is a no-op
+    context, so callers can write ``with set_mesh(maybe_mesh):``.
+    """
+    if mesh is None:
+        return contextlib.nullcontext()
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
+def get_abstract_mesh():
+    """The ambient mesh (abstract on new JAX, physical on legacy).
+
+    Both returns support ``.empty`` and ``.axis_names``, which is all the
+    callers (``constrain``, the ring corr construction) consult.
+    """
+    getter = getattr(jax.sharding, "get_abstract_mesh", None)
+    if getter is not None:
+        return getter()
+    from jax._src import mesh as mesh_lib  # legacy ambient-mesh registry
+
+    return mesh_lib.thread_resources.env.physical_mesh
 
 
 def make_mesh(data: int = -1, spatial: int = 1,
@@ -40,8 +81,7 @@ def make_mesh(data: int = -1, spatial: int = 1,
         data = n // spatial
     assert data * spatial <= n, (data, spatial, n)
     mesh_devices = np.asarray(devices[: data * spatial]).reshape(data, spatial)
-    return Mesh(mesh_devices, (DATA_AXIS, SPATIAL_AXIS),
-                axis_types=(AxisType.Auto, AxisType.Auto))
+    return Mesh(mesh_devices, (DATA_AXIS, SPATIAL_AXIS), **_MESH_KWARGS)
 
 
 def batch_spec() -> P:
@@ -65,9 +105,9 @@ def constrain(x: jax.Array, spec: P) -> jax.Array:
 
     Lets model-internal sharding hints (e.g. the corr-volume query axis)
     stay in the code path unconditionally; they only bind when the caller
-    runs under ``jax.set_mesh(mesh)``.
+    runs under ``set_mesh(mesh)``.
     """
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x
     if any(ax is not None and ax not in mesh.axis_names

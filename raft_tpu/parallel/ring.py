@@ -33,7 +33,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax < 0.6 keeps shard_map in jax.experimental
+    from jax.experimental.shard_map import shard_map
 
 from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
 

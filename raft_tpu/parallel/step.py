@@ -19,7 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.training.state import TrainState
 from raft_tpu.training.step import make_train_step
-from raft_tpu.parallel.mesh import batch_spec
+from raft_tpu.parallel.mesh import batch_spec, set_mesh
 
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
@@ -66,7 +66,7 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
                     f"axis ({data_size}): the shard-local accumulation "
                     f"guarantee breaks and GSPMD would insert per-step "
                     f"resharding")
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             return base(state, batch)
 
     return step

@@ -38,7 +38,13 @@ class Logger:
             try:
                 from torch.utils.tensorboard import SummaryWriter
                 self.writer = SummaryWriter(log_dir=self._log_dir)
-            except Exception:
+            except Exception as e:
+                # torch/tensorboard are optional; console logging and the
+                # metrics history still work — but say WHY scalars are
+                # missing instead of disappearing silently.
+                import sys
+                print(f"tensorboard logging disabled "
+                      f"({type(e).__name__}: {e})", file=sys.stderr)
                 self._tb = False
 
     def _print_status(self):

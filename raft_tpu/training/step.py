@@ -76,12 +76,18 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
         gt_flow = decode_flow(batch["flow"])
         gt_valid = decode_valid(batch["valid"])
         if add_noise:
+            # dtype-explicit draws: the default float dtype follows
+            # jax_enable_x64, so dtype-less uniform/normal would silently
+            # promote the whole forward to f64 under x64 (graftlint
+            # no-float64 audit invariant).
             k1, k2, ks = jax.random.split(noise_rng, 3)
-            stdv = jax.random.uniform(ks) * 5.0
+            stdv = jax.random.uniform(ks, dtype=jnp.float32) * 5.0
             image1 = jnp.clip(
-                image1 + stdv * jax.random.normal(k1, image1.shape), 0.0, 255.0)
+                image1 + stdv * jax.random.normal(k1, image1.shape,
+                                                  jnp.float32), 0.0, 255.0)
             image2 = jnp.clip(
-                image2 + stdv * jax.random.normal(k2, image2.shape), 0.0, 255.0)
+                image2 + stdv * jax.random.normal(k2, image2.shape,
+                                                  jnp.float32), 0.0, 255.0)
 
         def loss_fn(params, batch_stats, rng_d, im1, im2, flow, valid):
             variables = {"params": params}

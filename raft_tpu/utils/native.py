@@ -41,7 +41,9 @@ def _build() -> bool:
         subprocess.run(["make", "-C", _NATIVE_DIR, "libraftio.so"],
                        check=True, capture_output=True, timeout=120)
         return os.path.exists(_SO_PATH)
-    except Exception:
+    except (subprocess.SubprocessError, OSError):
+        # make missing/failing/timing out: the pure-Python decoders in
+        # data/frame_utils.py are the documented fallback
         return False
 
 
@@ -87,7 +89,9 @@ def get_lib():
             lib = ctypes.CDLL(_SO_PATH)
             _bind(lib)
             _lib = lib
-        except Exception:
+        except (OSError, AttributeError):
+            # CDLL load failure or a missing symbol in a stale .so; the
+            # pure-Python decoders take over
             _lib = None
     return _lib
 

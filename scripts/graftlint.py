@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""graftlint gate: runs both analysis engines, exits nonzero on findings.
+
+Thin wrapper over ``python -m raft_tpu.analysis`` so CI lanes and
+pre-push hooks have a stable entry point:
+
+    python scripts/graftlint.py              # full gate (lint + jaxpr)
+    python scripts/graftlint.py --engine lint    # sub-second, jax-free
+    python scripts/graftlint.py --json           # machine-readable
+
+Exit code 0 = clean (all remaining findings carry waivers with reasons);
+1 = at least one unwaived finding.  See docs/ARCHITECTURE.md "Static
+analysis" for the rule/invariant catalog and waiver syntax.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

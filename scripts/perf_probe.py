@@ -9,10 +9,12 @@ Variant families (see `variants` in main() for the full list):
   dense-lookup kernels   pallas_lookup[_deferred], pallas_stacked[_deferred]
   round-5 layout A/Bs    pad_lanes/no_pad_lanes, mask_f32/mask_bf16
   compiler options       xla_vmem{16,24,32,48,64,128}, xla_lhs_sched,
-                         xla_vmem32_lhs (per-compile PJRT options;
+                         xla_vmem32_lhs (per-compile PJRT options, as is
+                         things_vmem32_accum2's scoped-VMEM override;
                          RAFT_PROBE_VMEM_KIB applies a budget globally)
-  shape sweeps           things_accum{1,2,3} (400x720 b6),
-                         chairs_b{12,16}[_accum2], fwd_only, fwd_vmem32
+  shape sweeps           things_accum{1,2,3}, things_vmem32_accum2
+                         (400x720 b6), chairs_b{12,16}[_accum2],
+                         fwd_only, fwd_vmem32
 """
 
 import os

@@ -12,7 +12,8 @@ from raft_tpu.ops.corr import (all_pairs_correlation, build_corr_pyramid,
                                corr_lookup)
 from raft_tpu.ops.grid import coords_grid
 from raft_tpu.parallel import make_mesh
-from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS, constrain
+from raft_tpu.parallel.mesh import (DATA_AXIS, SPATIAL_AXIS, constrain,
+                                    set_mesh)
 
 pytestmark = pytest.mark.needs_mesh
 
@@ -26,7 +27,7 @@ def test_pyramid_and_lookup_stay_sharded():
     f2 = jnp.asarray(RNG.standard_normal((B, H, W, C)).astype(np.float32))
     coords = coords_grid(B, H, W)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         f1s = jax.device_put(f1, NamedSharding(mesh, P(DATA_AXIS)))
         f2s = jax.device_put(f2, NamedSharding(mesh, P(DATA_AXIS)))
         cs = jax.device_put(coords, NamedSharding(mesh, P(DATA_AXIS)))
@@ -79,7 +80,7 @@ def test_spatial_sharding_at_training_resolution():
 
     ref = corr_lookup(build_corr_pyramid_direct(f1, f2, 4), coords, radius=4)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         f1s = jax.device_put(f1, NamedSharding(mesh, P(DATA_AXIS)))
         f2s = jax.device_put(f2, NamedSharding(mesh, P(DATA_AXIS)))
         cs = jax.device_put(coords, NamedSharding(mesh, P(DATA_AXIS)))

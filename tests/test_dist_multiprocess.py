@@ -78,7 +78,7 @@ TRAIN_WORKER = textwrap.dedent("""
     from raft_tpu.data.datasets import SyntheticShift
     from raft_tpu.data.loader import DataLoader, prefetch_to_device
     from raft_tpu.models import RAFT
-    from raft_tpu.parallel.mesh import batch_spec, make_mesh
+    from raft_tpu.parallel.mesh import batch_spec, make_mesh, set_mesh
     from raft_tpu.parallel.step import (make_parallel_train_step,
                                         replicate_state)
     from raft_tpu.training import create_train_state, make_optimizer
@@ -111,7 +111,7 @@ TRAIN_WORKER = textwrap.dedent("""
     step = make_parallel_train_step(model, mesh, iters=2, gamma=0.8,
                                     max_flow=400.0)
     losses = []
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         stream = prefetch_to_device(iter(loader), size=1,
                                     sharding=sharding)
         for k, batch in enumerate(stream):

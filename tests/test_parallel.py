@@ -10,6 +10,7 @@ import pytest
 from raft_tpu.config import RAFTConfig
 from raft_tpu.models import RAFT
 from raft_tpu.parallel import make_mesh, make_parallel_train_step, shard_batch
+from raft_tpu.parallel.mesh import set_mesh
 from raft_tpu.parallel.step import replicate_state
 from raft_tpu.training import create_train_state, make_optimizer
 from raft_tpu.training.step import make_train_step
@@ -92,7 +93,7 @@ def test_corr_shard_spatial():
     variables = model_plain.init(jax.random.PRNGKey(0), img1, img2, iters=1)
 
     ref = model_plain.apply(variables, img1, img2, iters=2)
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         fwd = jax.jit(lambda v, a, b: model_shard.apply(v, a, b, iters=2))
         out = fwd(variables, img1, img2)
     # sharded reductions reorder float sums; the recurrence amplifies the

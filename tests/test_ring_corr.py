@@ -16,7 +16,7 @@ from raft_tpu.ops.corr import (all_pairs_correlation, build_corr_pyramid,
                                corr_lookup)
 from raft_tpu.ops.grid import coords_grid
 from raft_tpu.parallel import make_mesh
-from raft_tpu.parallel.mesh import SPATIAL_AXIS
+from raft_tpu.parallel.mesh import SPATIAL_AXIS, set_mesh
 from raft_tpu.parallel.ring import (ring_all_pairs_correlation,
                                     ring_corr_pyramid)
 
@@ -36,7 +36,7 @@ def test_ring_volume_matches_dense_oracle():
     f1, f2 = _fmaps()
     ref = all_pairs_correlation(f1, f2)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = jax.jit(
             lambda a, b: ring_all_pairs_correlation(a, b, mesh))(f1, f2)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -46,7 +46,7 @@ def test_ring_volume_matches_dense_oracle():
 def test_ring_volume_stays_query_sharded():
     mesh = make_mesh(data=1, spatial=8)
     f1, f2 = _fmaps()
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = jax.jit(
             lambda a, b: ring_all_pairs_correlation(a, b, mesh))(f1, f2)
     # each device holds 1/8 of the query rows and ALL targets for them
@@ -63,7 +63,7 @@ def test_ring_pyramid_lookup_end_to_end():
     ref = corr_lookup(
         build_corr_pyramid(all_pairs_correlation(f1, f2), 3), coords, 2)
 
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         f1s = jax.device_put(f1, NamedSharding(mesh, P("data")))
         f2s = jax.device_put(f2, NamedSharding(mesh, P("data")))
         cs = jax.device_put(coords, NamedSharding(mesh, P("data")))
@@ -110,7 +110,7 @@ def test_ring_in_model_matches_dense_forward():
     ringm = RAFT(RAFTConfig(small=True, corr_shard=True,
                             corr_shard_impl="ring"))
     mesh = make_mesh(data=2, spatial=4)
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         got_lo, got_up = jax.jit(
             lambda v, a, b: ringm.apply(v, a, b, iters=3, test_mode=True)
         )(variables, img1, img2)
@@ -147,7 +147,7 @@ def test_ring_in_model_train_step():
                             corr_shard_impl="ring"))
     mesh = make_mesh(data=2, spatial=4)
     tx, _ = make_optimizer(lr=1e-4, num_steps=10, wdecay=1e-4)
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
                                    iters=2)
     state = replicate_state(state, mesh)

@@ -1,0 +1,409 @@
+"""graftlint: one minimal failing fixture per lint rule and per jaxpr
+invariant, plus the repo-wide clean-run gates (both engines must pass
+over the tree as committed — this is the tier-1 lint lane).
+
+Everything here is CPU-only and fast-lane (no ``slow`` marker): the AST
+fixtures are string literals, the jaxpr fixtures are tiny abstract
+traces, and the repo gates reuse one audit run via module-scoped
+fixtures.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.analysis import findings as fmod
+from raft_tpu.analysis.lint import lint_source, run_lint
+from raft_tpu.analysis import jaxpr_audit as ja
+
+
+def _rules(src: str, path: str = "fixture.py"):
+    return sorted({f.rule for f in lint_source(textwrap.dedent(src), path)
+                   if not f.waived})
+
+
+# --------------------------------------------------------------------------
+# AST engine: one failing fixture per rule (and a passing twin)
+# --------------------------------------------------------------------------
+
+def test_host_transfer_numpy_call_on_traced_value():
+    assert "host-transfer" in _rules("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+    """)
+
+
+def test_host_transfer_item_and_float():
+    assert "host-transfer" in _rules("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """)
+    assert "host-transfer" in _rules("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+    """)
+
+
+def test_host_transfer_shape_access_is_clean():
+    # static accessors are not transfers; neither is np on non-traced data
+    assert _rules("""
+        import jax
+        import numpy as np
+
+        CONST = np.asarray([1.0])
+
+        @jax.jit
+        def f(x):
+            return x.reshape(x.shape[0]) + float(x.shape[1])
+    """) == []
+
+
+def test_host_transfer_in_lambda_and_lax_hof():
+    # jit roots found at call sites, not just decorators
+    assert "host-transfer" in _rules("""
+        import jax
+        import numpy as np
+
+        g = jax.jit(lambda x: np.array(x))
+    """)
+    assert "host-transfer" in _rules("""
+        import jax
+        import numpy as np
+
+        def outer(xs):
+            def body(c, x):
+                return c, np.asarray(x)
+            return jax.lax.scan(body, 0.0, xs)
+    """)
+
+
+def test_tracer_control_flow():
+    assert "tracer-control" in _rules("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+
+
+def test_tracer_control_static_tests_are_clean():
+    # dtype/shape comparisons and container truthiness are static
+    assert _rules("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, stats):
+            if x.dtype == jnp.int16 and x.shape[0] > 2:
+                x = x * 2
+            if stats:
+                x = x + 1
+            return x
+    """) == []
+
+
+def test_tracer_control_python_randomness():
+    assert "tracer-control" in _rules("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return x + np.random.uniform()
+    """)
+    assert "tracer-control" in _rules("""
+        import jax
+        import random
+
+        @jax.jit
+        def f(x):
+            return x + random.random()
+    """)
+    # `from jax import random` is jax.random, not stdlib randomness
+    assert _rules("""
+        import jax
+        from jax import random
+
+        @jax.jit
+        def f(x, key):
+            return x + random.uniform(key, x.shape, x.dtype)
+    """) == []
+
+
+def test_tracer_control_negated_truthiness_is_clean():
+    # `if not stats:` is the emptiness idiom in the other polarity
+    assert _rules("""
+        import jax
+
+        @jax.jit
+        def f(x, stats):
+            if not stats:
+                x = x + 1
+            return x
+    """) == []
+
+
+def test_debug_print_leftover():
+    assert "debug-print" in _rules("""
+        import jax
+
+        def f(x):
+            jax.debug.print("x={x}", x=x)
+            return x
+    """)
+
+
+def test_silent_except_flagged_and_fixes_pass():
+    assert "silent-except" in _rules("""
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+    """)
+    # each sanctioned fix: narrow type / use the exception / log
+    assert _rules("""
+        def f():
+            try:
+                risky()
+            except (OSError, ValueError):
+                pass
+    """) == []
+    assert _rules("""
+        def f():
+            try:
+                risky()
+            except Exception as e:
+                print(f"risky failed: {e}")
+    """) == []
+
+
+def test_f64_literal_variants():
+    assert "f64-literal" in _rules("""
+        import numpy as np
+        x = np.zeros(3, np.float64)
+    """)
+    assert "f64-literal" in _rules("""
+        import numpy as np
+        def f(x):
+            return np.zeros(3, dtype="float64")
+    """)
+    assert "f64-literal" in _rules("""
+        def f(x):
+            return x.astype("float64")
+    """)
+    assert "f64-literal" in _rules("""
+        import jax
+        jax.config.update("jax_enable_x64", True)
+    """)
+    assert _rules("""
+        import jax
+        jax.config.update("jax_enable_x64", False)
+    """) == []
+
+
+# --------------------------------------------------------------------------
+# waivers
+# --------------------------------------------------------------------------
+
+def test_inline_waiver_with_reason_waives():
+    out = lint_source(textwrap.dedent("""
+        import numpy as np
+        x = np.float64(0)  # graftlint: disable=f64-literal -- fixture
+    """), "fixture.py")
+    assert [f for f in out if not f.waived] == []
+    assert any(f.waived and f.waiver_reason == "fixture" for f in out)
+
+
+def test_standalone_waiver_spans_comment_block():
+    out = lint_source(textwrap.dedent("""
+        import numpy as np
+        # graftlint: disable=f64-literal -- fixture reason
+        # continuation of the explanation
+        x = np.float64(0)
+    """), "fixture.py")
+    assert [f for f in out if not f.waived] == []
+
+
+def test_waiver_without_reason_waives_nothing():
+    out = lint_source(textwrap.dedent("""
+        import numpy as np
+        x = np.float64(0)  # graftlint: disable=f64-literal
+    """), "fixture.py")
+    rules = {f.rule for f in out if not f.waived}
+    assert "f64-literal" in rules          # still gating
+    assert "waiver-no-reason" in rules     # and the bad waiver is reported
+
+
+def test_waiver_only_covers_named_rules():
+    out = lint_source(textwrap.dedent("""
+        import numpy as np
+        x = np.float64(0)  # graftlint: disable=silent-except -- wrong rule
+    """), "fixture.py")
+    assert "f64-literal" in {f.rule for f in out if not f.waived}
+
+
+# --------------------------------------------------------------------------
+# jaxpr engine: one failing fixture per invariant checker
+# --------------------------------------------------------------------------
+
+def _sds(*shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def test_find_f64_flags_and_passes():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        bad = jax.make_jaxpr(lambda x: x.astype(jnp.float64))(_sds(4))
+        good = jax.make_jaxpr(lambda x: x * 2.0)(_sds(4))
+    assert ja.find_f64(bad), "f64 cast must be found"
+    assert ja.find_f64(good) == []
+
+
+def test_find_loop_transfers_flags_callback_in_scan():
+    def bad(xs):
+        def body(c, x):
+            jax.debug.print("x={x}", x=x)
+            return c + x, x
+        return jax.lax.scan(body, 0.0, xs)
+
+    def good(xs):
+        return jax.lax.scan(lambda c, x: (c + x, x), 0.0, xs)
+
+    hits = ja.find_loop_transfers(jax.make_jaxpr(bad)(_sds(4)))
+    assert any(prim == "debug_callback" for prim, _ in hits)
+    assert ja.find_loop_transfers(jax.make_jaxpr(good)(_sds(4))) == []
+
+
+def test_find_unaccumulated_bf16_dots():
+    a = _sds(8, 8, dtype=jnp.bfloat16)
+
+    bad = jax.make_jaxpr(lambda x, y: jnp.einsum("ij,jk->ik", x, y))(a, a)
+    good = jax.make_jaxpr(lambda x, y: jnp.einsum(
+        "ij,jk->ik", x, y, preferred_element_type=jnp.float32))(a, a)
+    assert ja.find_unaccumulated_bf16_dots(bad)
+    assert ja.find_unaccumulated_bf16_dots(good) == []
+
+
+def test_donation_alias_count_reflects_donation():
+    f = lambda x, y: (x + y, y * 2)  # noqa: E731
+    donated = jax.jit(f, donate_argnums=(0,)).lower(_sds(4), _sds(4))
+    plain = jax.jit(f).lower(_sds(4), _sds(4))
+    assert ja.donation_alias_count(donated.as_text()) == 1
+    assert ja.donation_alias_count(plain.as_text()) == 0
+
+
+def test_jaxpr_str_normalization_strips_addresses():
+    s = "pjit[jaxpr=<function f at 0x7f00deadbeef> n=3]"
+    t = "pjit[jaxpr=<function f at 0x7f11cafebabe> n=3]"
+    assert ja._normalize_jaxpr_str(s) == ja._normalize_jaxpr_str(t)
+    assert "0x7f00" not in ja._normalize_jaxpr_str(s)
+
+
+def test_jaxpr_waivers_are_scoped():
+    f = fmod.Finding(engine="jaxpr", rule="no-float64", path="train_step",
+                     line=0, data={"scalar": True},
+                     message="float64 aval float64[] at x via "
+                             "optax/_src/transform.py:230")
+    (waived,) = ja._apply_waivers([f])
+    assert waived.waived and "optax" in waived.waiver_reason
+    # non-scalar f64 from the same provenance must NOT be waived — the
+    # predicate keys on the structured scalar flag, not message text
+    g = fmod.Finding(engine="jaxpr", rule="no-float64", path="train_step",
+                     line=0, data={"scalar": False},
+                     message="float64 aval float64[8, 2] at x via "
+                             "optax/_src/transform.py:230")
+    (kept,) = ja._apply_waivers([g])
+    assert not kept.waived
+
+
+# --------------------------------------------------------------------------
+# repo-wide clean-run gates (the tier-1 lane)
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def repo_paths():
+    from raft_tpu.analysis.__main__ import default_paths
+
+    return default_paths()
+
+
+def test_lint_gate_repo_clean(repo_paths):
+    out = run_lint(repo_paths)
+    gating = fmod.gate(out)
+    assert gating == [], "\n" + "\n".join(f.render() for f in gating)
+    # the two sanctioned waivers stay documented
+    assert all(f.waiver_reason for f in out if f.waived)
+
+
+@pytest.fixture(scope="module")
+def audit_results():
+    if jax.device_count() < 8:
+        pytest.skip("jaxpr audit gate needs the 8-device CPU harness")
+    return ja.run_jaxpr_audit()
+
+
+def test_jaxpr_gate_repo_clean(audit_results):
+    findings, _ = audit_results
+    gating = fmod.gate(findings)
+    assert gating == [], "\n" + "\n".join(f.render() for f in gating)
+    assert all(f.waiver_reason for f in findings if f.waived)
+
+
+def test_jaxpr_report_donation_and_presets(audit_results):
+    _, report = audit_results
+    don = report["donation"]
+    assert don["aliases"] >= don["param_leaves"] > 0
+    rk = report["recompile_keys"]
+    assert rk["presets"] >= rk["distinct_step_signatures"] >= 1
+    # mixed presets must not silently collapse into their f32 twins
+    groups = {tuple(g) for g in map(tuple, rk["signature_groups"])}
+    assert not any("chairs" in g and "chairs_mixed" in g for g in groups)
+
+
+def test_lint_lane_is_jax_free():
+    """The AST engine (and a full default-path lint run) must never
+    import jax — that is what keeps the lint lane sub-second."""
+    import subprocess
+    import sys
+
+    code = ("import sys\n"
+            "from raft_tpu.analysis.lint import run_lint\n"
+            "from raft_tpu.analysis.__main__ import default_paths\n"
+            "run_lint(default_paths())\n"
+            "sys.exit(1 if 'jax' in sys.modules else 0)\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+
+
+def test_cli_gate_contract(tmp_path):
+    """The module CLI exits nonzero on a finding, zero on a waived one."""
+    from raft_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import numpy as np\nx = np.float64(0)\n")
+    assert main(["--engine", "lint", str(bad)]) == 1
+    waived = tmp_path / "waived.py"
+    waived.write_text("import numpy as np\n"
+                      "x = np.float64(0)"
+                      "  # graftlint: disable=f64-literal -- fixture\n")
+    assert main(["--engine", "lint", str(waived)]) == 0
