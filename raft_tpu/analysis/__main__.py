@@ -2,13 +2,25 @@
 
 Default scope is the whole repo's production Python (the ``raft_tpu``
 package, ``scripts/``, ``bench.py``, ``__graft_entry__.py``) for the AST
-engine, plus every registered jaxpr audit.  Exits 1 when any unwaived
-error-severity finding survives — the contract ``scripts/graftlint.py``
-and the tier-1 lane build on.
+engine, plus every registered jaxpr audit and HLO entry audit.  Exits 1
+when any unwaived error-severity finding survives, 2 on usage errors —
+the contract ``scripts/graftlint.py`` and the tier-1 lane build on.
 
-The jaxpr engine needs a CPU backend with 8 virtual devices (the sharded
-audit); this driver forces that BEFORE jax is first imported, same as
-tests/conftest.py, so it works under the image's pinned TPU backend too.
+Engine-specific extras:
+
+- ``--engine hlo`` compiles the real entry points and checks them
+  against the ``budgets.json`` ledger; ``--update-budgets`` re-baselines
+  the ledger (commit the diff), ``--budgets PATH`` points at an
+  alternate ledger (tests use a perturbed copy).
+- ``--list-waivers`` enumerates every active suppression in the tree —
+  inline ``# graftlint: disable`` comments (with staleness: a waiver
+  that no longer matches any finding is marked ``[stale]``) and the
+  data-declared jaxpr/HLO waivers — then exits 0.
+
+The jaxpr/HLO engines need a CPU backend with 8 virtual devices (the
+sharded audits); this driver forces that BEFORE jax is first imported,
+same as tests/conftest.py, so it works under the image's pinned TPU
+backend too.
 """
 
 from __future__ import annotations
@@ -16,6 +28,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 
 def _force_cpu_with_virtual_devices() -> None:
@@ -40,53 +53,204 @@ def default_paths() -> list:
     return [p for p in cands if os.path.exists(p)]
 
 
+def collect_waivers(paths) -> list:
+    """Every declared suppression, as dicts: inline lint waivers (with
+    activity — a waiver whose line no longer produces a finding is
+    rot), plus the data-declared jaxpr/HLO waiver tuples."""
+    import inspect
+
+    from raft_tpu.analysis.budgets import display_path
+    from raft_tpu.analysis.lint import (iter_python_files, parse_waivers,
+                                        run_lint)
+
+    lint_findings = run_lint(paths)
+    active = {(f.path, f.line) for f in lint_findings if f.waived}
+    out = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        waivers, _ = parse_waivers(source, path)
+        for line, (rules, reason) in sorted(waivers.items()):
+            out.append({
+                "engine": "lint", "path": display_path(path),
+                "line": line, "rules": sorted(rules), "reason": reason,
+                "active": (path, line) in active})
+
+    def data_waivers(engine, module):
+        src_path = inspect.getsourcefile(module)
+        src_lines = inspect.getsource(module).splitlines()
+        for w in module.WAIVERS:
+            line = next((i for i, l in enumerate(src_lines, 1)
+                         if f'"{w.provenance}"' in l), 0)
+            out.append({
+                "engine": engine, "path": display_path(src_path),
+                "line": line,
+                "invariant": w.invariant, "provenance": w.provenance,
+                "scalar_only": w.scalar_only, "reason": w.reason})
+
+    from raft_tpu.analysis import hlo_audit, jaxpr_audit
+
+    data_waivers("jaxpr", jaxpr_audit)
+    data_waivers("hlo", hlo_audit)
+    return out
+
+
+def render_waivers(waivers) -> str:
+    lines = []
+    stale = 0
+    for w in waivers:
+        if w["engine"] == "lint":
+            state = "active" if w["active"] else "STALE"
+            stale += not w["active"]
+            lines.append(f"{w['path']}:{w['line']}: lint "
+                         f"disable={','.join(w['rules'])} [{state}] "
+                         f"-- {w['reason']}")
+        else:
+            scope = " (scalar-only)" if w.get("scalar_only") else ""
+            lines.append(f"{w['path']}:{w['line']}: {w['engine']} "
+                         f"{w['invariant']} @ {w['provenance']}{scope} "
+                         f"-- {w['reason']}")
+    n = {"lint": 0, "jaxpr": 0, "hlo": 0}
+    for w in waivers:
+        n[w["engine"]] += 1
+    lines.append(f"graftlint waivers: {n['lint']} lint ({stale} stale), "
+                 f"{n['jaxpr']} jaxpr, {n['hlo']} hlo")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "python -m raft_tpu.analysis",
-        description="graftlint: AST lint + jaxpr audit for raft_tpu")
+        description="graftlint: AST lint + jaxpr audit + HLO "
+                    "collective/cost audit for raft_tpu")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories for the AST engine "
                         "(default: raft_tpu/, scripts/, bench.py, "
                         "__graft_entry__.py)")
-    p.add_argument("--engine", choices=["lint", "jaxpr", "all"],
+    p.add_argument("--engine", choices=["lint", "jaxpr", "hlo", "all"],
                    default="all")
     p.add_argument("--rules", default=None,
                    help="comma-separated lint rule ids to run "
                         "(default: all)")
     p.add_argument("--audits", default=None,
-                   help="comma-separated jaxpr audit names "
-                        "(default: all)")
+                   help="comma-separated jaxpr/HLO audit names "
+                        "(default: all; each engine runs the names it "
+                        "knows)")
+    p.add_argument("--budgets", default=None, metavar="PATH",
+                   help="alternate budgets.json ledger for the HLO "
+                        "engine (default: the checked-in "
+                        "raft_tpu/analysis/budgets.json)")
+    p.add_argument("--update-budgets", action="store_true",
+                   help="re-baseline the HLO ledger from this run's "
+                        "measurements instead of comparing (commit the "
+                        "resulting budgets.json diff)")
+    p.add_argument("--list-waivers", action="store_true",
+                   help="enumerate every active waiver (inline lint "
+                        "disables with staleness, jaxpr/HLO data "
+                        "waivers) and exit")
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (findings + report)")
     p.add_argument("--verbose", action="store_true",
                    help="also show waived findings and the full report")
     args = p.parse_args(argv)
 
-    if args.engine in ("jaxpr", "all"):
+    if args.update_budgets and args.engine not in ("hlo", "all"):
+        p.error("--update-budgets requires --engine hlo (or all)")
+
+    if args.engine in ("jaxpr", "hlo", "all"):
         _force_cpu_with_virtual_devices()
 
     from raft_tpu.analysis import findings as fmod
     from raft_tpu.analysis.lint import run_lint
 
+    if args.list_waivers:
+        waivers = collect_waivers(args.paths or default_paths())
+        if args.json:
+            import json
+
+            print(json.dumps({"waivers": waivers}, indent=2))
+        else:
+            print(render_waivers(waivers))
+        return 0
+
+    audits = args.audits.split(",") if args.audits else None
+    if audits is not None:
+        # validate names up front across every selected engine: a typo'd
+        # audit name must be a usage error (exit 2), never a silently
+        # green zero-audit run
+        from raft_tpu.analysis.hlo_audit import ENTRIES, FIXTURE_ENTRIES
+        from raft_tpu.analysis.jaxpr_audit import ENTRY_AUDITS
+
+        known = set()
+        if args.engine in ("jaxpr", "all"):
+            known |= set(ENTRY_AUDITS)
+        if args.engine in ("hlo", "all"):
+            known |= set(ENTRIES) | set(FIXTURE_ENTRIES)
+        unknown = sorted(set(audits) - known)
+        if unknown:
+            p.error(f"unknown audit(s) {unknown}; known: {sorted(known)}")
+        if args.update_budgets:
+            from raft_tpu.analysis.hlo_audit import ENTRIES as _E, \
+                FIXTURE_ENTRIES as _F
+
+            if not any(a in _E or a in _F for a in audits):
+                p.error("--update-budgets needs --audits to name at "
+                        "least one hlo audit (or drop --audits to "
+                        "re-baseline everything) — nothing would be "
+                        "written")
     all_findings = []
     report = {}
+    timings = {}
+
     if args.engine in ("lint", "all"):
+        t0 = time.monotonic()
         rules = args.rules.split(",") if args.rules else None
         all_findings += run_lint(args.paths or default_paths(), rules=rules)
+        timings["lint"] = round(time.monotonic() - t0, 2)
     if args.engine in ("jaxpr", "all"):
         from raft_tpu.utils.platform import ensure_platform
 
         ensure_platform(strict=True)
-        from raft_tpu.analysis.jaxpr_audit import run_jaxpr_audit
+        t0 = time.monotonic()
+        from raft_tpu.analysis.jaxpr_audit import ENTRY_AUDITS, \
+            run_jaxpr_audit
 
-        audits = args.audits.split(",") if args.audits else None
-        jfs, report = run_jaxpr_audit(audits)
+        jaxpr_names = audits
+        if audits is not None:
+            jaxpr_names = [a for a in audits if a in ENTRY_AUDITS]
+        jfs, jreport = run_jaxpr_audit(jaxpr_names)
         all_findings += jfs
+        report.update(jreport)
+        timings["jaxpr"] = round(time.monotonic() - t0, 2)
+    if args.engine in ("hlo", "all"):
+        from raft_tpu.utils.platform import ensure_platform
 
+        ensure_platform(strict=True)
+        t0 = time.monotonic()
+        from raft_tpu.analysis.hlo_audit import ENTRIES, FIXTURE_ENTRIES, \
+            run_hlo_audit
+
+        hlo_names = audits
+        if audits is not None:
+            hlo_names = [a for a in audits
+                         if a in ENTRIES or a in FIXTURE_ENTRIES]
+        # --audits naming only other engines' audits runs nothing here
+        if hlo_names != []:
+            hfs, hreport = run_hlo_audit(hlo_names,
+                                         budgets_path=args.budgets,
+                                         update=args.update_budgets)
+            all_findings += hfs
+            report["hlo"] = hreport
+        timings["hlo"] = round(time.monotonic() - t0, 2)
+
+    report["engine_timings"] = timings
     out = (fmod.render_json(all_findings, report) if args.json
            else fmod.render_text(all_findings, report,
                                  verbose=args.verbose))
     print(out)
+    if not args.json and timings:
+        print("graftlint timings: " + " | ".join(
+            f"{k}={v:.1f}s" for k, v in timings.items()))
     return 1 if fmod.gate(all_findings) else 0
 
 

@@ -1,0 +1,227 @@
+"""Checked-in HLO budget ledger for graftlint engine 3 (hlo_audit).
+
+``budgets.json`` (next to this file) records, per audited entry point,
+what XLA actually emitted the last time someone deliberately
+re-baselined: ``cost_analysis()`` FLOPs / bytes accessed,
+``memory_analysis()`` argument/output/temp bytes, the exact collective
+op counts, the donation alias count, and convert/copy op-count bounds.
+The HLO auditor recompiles the entry points and compares:
+
+- **cost/memory** drift beyond ``meta.tolerance`` (relative) fails;
+- **collectives** compare exactly — a structural fact, not a noisy
+  measurement: one extra all-gather IS the regression this engine
+  exists to catch;
+- **aliases** may only shrink (fewer donated buffers aliased = broken
+  donation); growing is fine;
+- **convert/copy counts** are upper bounds (hygiene churn), so
+  improvements never fail the gate (a note suggests re-baselining when
+  they improve a lot).
+
+Re-baseline intentionally with ``python -m raft_tpu.analysis --engine
+hlo --update-budgets`` and COMMIT the diff — the ledger diff in review
+is the whole point: a perf PR shows its lowering got better, a refactor
+shows it stayed put.
+
+Comparisons are only strict when the environment matches
+``meta`` (platform + jax version + pinned optimization level): a
+different toolchain legitimately emits different programs, so there the
+findings demote to notes telling you to re-baseline rather than failing
+the gate.
+
+Everything here is pure data plumbing (no jax import): unit-testable
+and usable from the CLI without a backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.analysis.findings import Finding
+
+# Metrics compared with relative tolerance (ledger key, human unit).
+SCALAR_METRICS = ("flops", "bytes_accessed", "argument_bytes",
+                  "output_bytes", "temp_bytes")
+# Metrics compared as upper bounds (actual > ledger fails).
+BOUND_METRICS = ("convert_ops", "convert_f32_bf16", "copy_ops")
+
+DEFAULT_TOLERANCE = 0.25
+
+
+def default_budgets_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "budgets.json")
+
+
+def display_path(path: str) -> str:
+    """Repo-relative rendering for findings; out-of-repo paths (e.g. a
+    test's perturbed tmp ledger) stay absolute so they remain openable."""
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap = os.path.abspath(path)
+    if ap.startswith(root + os.sep):
+        return os.path.relpath(ap, root)
+    return ap
+
+
+def load_budgets(path: Optional[str] = None) -> Optional[Dict]:
+    """The ledger payload, or None when the file does not exist yet."""
+    path = path or default_budgets_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def save_budgets(path: Optional[str], meta: Dict,
+                 entries: Dict[str, Dict]) -> str:
+    """Write the ledger, merging over an existing file: only the entries
+    measured this run are replaced (so ``--update-budgets --audits x``
+    re-baselines one entry without dropping the rest)."""
+    path = path or default_budgets_path()
+    existing = load_budgets(path) or {"entries": {}}
+    merged = dict(existing.get("entries", {}))
+    merged.update(entries)
+    payload = {"meta": meta,
+               "entries": {k: merged[k] for k in sorted(merged)}}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+def budget_line(path: str, entry: str, key: Optional[str] = None) -> int:
+    """1-based line of ``entry`` (or of ``key`` inside the entry block)
+    in the pretty-printed ledger — findings point at the exact ledger
+    line whose number no longer matches reality.  0 when the file or
+    key cannot be located (the finding stays file-addressed)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return 0
+    entry_at = 0
+    entry_indent = None
+    for i, line in enumerate(lines, 1):
+        stripped = line.lstrip()
+        if not entry_at:
+            if stripped.startswith(f'"{entry}"'):
+                entry_at = i
+                entry_indent = len(line) - len(stripped)
+            continue
+        indent = len(line) - len(stripped)
+        if stripped.startswith("}") and indent <= entry_indent:
+            break  # left the entry block without finding the key
+        if key is not None and stripped.startswith(f'"{key}"'):
+            return i
+    if key is None:
+        return entry_at
+    return entry_at  # key absent: point at the entry header
+
+
+def _rel_drift(actual: float, budget: float) -> float:
+    return abs(actual - budget) / max(abs(budget), 1.0)
+
+
+def compare_entry(entry: str, budget: Optional[Dict], measured: Dict,
+                  ledger_path: str, tolerance: float = DEFAULT_TOLERANCE,
+                  strict: bool = True,
+                  anchor: Optional[Tuple[str, int]] = None) -> List[Finding]:
+    """Findings for one entry's measurement vs its ledger record.
+
+    ``measured`` uses the same keys as the ledger (see hlo_audit
+    ``HloMeasurement``).  ``strict=False`` (environment mismatch)
+    demotes every comparison to a note.  ``anchor`` is the (file, line)
+    of the entry-point builder, used for findings that are about the
+    *program*, not the ledger (unexpected collectives).
+    """
+    severity = "error" if strict else "note"
+    out: List[Finding] = []
+
+    def ledger_finding(rule: str, key: Optional[str], message: str,
+                       sev: str = None) -> Finding:
+        return Finding(
+            engine="hlo", rule=rule,
+            path=display_path(ledger_path),
+            line=budget_line(ledger_path, entry, key),
+            message=message, severity=sev or severity,
+            data={"entry": entry, "key": key})
+
+    if budget is None:
+        return [Finding(
+            engine="hlo", rule="budget-missing",
+            path=display_path(ledger_path), line=0,
+            message=f"entry '{entry}' has no ledger record — run "
+                    f"`python -m raft_tpu.analysis --engine hlo "
+                    f"--update-budgets` and commit the budgets.json "
+                    f"diff", severity=severity,
+            data={"entry": entry})]
+
+    for key in SCALAR_METRICS:
+        if key not in budget or key not in measured:
+            continue
+        if _rel_drift(measured[key], budget[key]) > tolerance:
+            signed = ((measured[key] - budget[key])
+                      / max(abs(budget[key]), 1.0))
+            out.append(ledger_finding(
+                "budget-drift", key,
+                f"{entry}: {key} drifted {signed:+.0%} from the ledger "
+                f"({measured[key]:.4g} vs budgeted {budget[key]:.4g}, "
+                f"tolerance {tolerance:.0%}) — if intentional, "
+                f"re-baseline with --update-budgets and commit the "
+                f"diff"))
+
+    want = dict(budget.get("collectives", {}))
+    got = dict(measured.get("collectives", {}))
+    for kind in sorted(set(want) | set(got)):
+        w, g = want.get(kind, 0), got.get(kind, 0)
+        if w == g:
+            continue
+        if g > w:
+            # the program grew a collective the ledger does not sanction
+            # — point at the entry-point builder, the code that owns the
+            # lowering (the ledger line is in `data`)
+            path, line = anchor or (display_path(ledger_path), 0)
+            out.append(Finding(
+                engine="hlo", rule="unexpected-collective", path=path,
+                line=line,
+                message=f"{entry}: lowering now emits {g}x {kind} "
+                        f"(ledger sanctions {w}) — a sharding mismatch "
+                        f"inserted cross-device traffic into the "
+                        f"compiled program", severity=severity,
+                data={"entry": entry, "kind": kind, "got": g,
+                      "want": w}))
+        else:
+            out.append(ledger_finding(
+                "collective-set", "collectives",
+                f"{entry}: {kind} count fell to {g} (ledger says {w}) "
+                f"— the program's collective set changed; re-baseline "
+                f"if intentional"))
+
+    if "aliases" in budget and measured.get("aliases", 0) < budget["aliases"]:
+        out.append(ledger_finding(
+            "donation", "aliases",
+            f"{entry}: input-output aliases fell to "
+            f"{measured['aliases']} (ledger: {budget['aliases']}) — "
+            f"donation stopped covering buffers it used to; peak HBM "
+            f"grows by every lost alias"))
+
+    for key in BOUND_METRICS:
+        if key not in budget or key not in measured:
+            continue
+        if measured[key] > budget[key]:
+            out.append(ledger_finding(
+                "convert-churn" if key.startswith("convert") else
+                "copy-churn", key,
+                f"{entry}: {key} rose to {measured[key]} (bound "
+                f"{budget[key]}) — new dtype/copy churn in the "
+                f"optimized HLO"))
+        elif measured[key] < budget[key] // 2 and budget[key] >= 8:
+            out.append(ledger_finding(
+                "budget-slack", key,
+                f"{entry}: {key} improved to {measured[key]} (bound "
+                f"{budget[key]}) — tighten the bound with "
+                f"--update-budgets so the win is locked in",
+                sev="note"))
+    return out

@@ -1,4 +1,4 @@
-"""Finding model shared by both graftlint engines.
+"""Finding model shared by all graftlint engines.
 
 A finding is one violation of one named check, with enough provenance
 (path, line, engine) to be actionable and enough structure to be
@@ -18,9 +18,14 @@ Waiver syntax (both engines):
   linter reports it as a ``waiver-no-reason`` finding instead), so every
   suppression in the tree is self-documenting.
 
-- jaxpr engine: entries in :data:`raft_tpu.analysis.jaxpr_audit.WAIVERS`
-  — invariants are asserted as data, and so are their exceptions
-  (e.g. optax's scalar bias-correction arithmetic under x64).
+- jaxpr/HLO engines: entries in
+  :data:`raft_tpu.analysis.jaxpr_audit.WAIVERS` /
+  :data:`raft_tpu.analysis.hlo_audit.WAIVERS` — invariants are asserted
+  as data, and so are their exceptions (e.g. optax's scalar
+  bias-correction arithmetic under x64).
+
+``python -m raft_tpu.analysis --list-waivers`` inventories every
+declared waiver with file:line and reason, flagging stale ones.
 """
 
 from __future__ import annotations
@@ -36,9 +41,9 @@ SEVERITIES = ("error", "note")
 
 @dataclasses.dataclass
 class Finding:
-    engine: str              # "lint" | "jaxpr"
+    engine: str              # "lint" | "jaxpr" | "hlo"
     rule: str                # rule / invariant identifier
-    path: str                # file (lint) or entry-point name (jaxpr)
+    path: str                # file (lint/hlo) or entry-point name (jaxpr)
     line: int                # 1-based line; 0 when not line-addressable
     message: str
     severity: str = "error"

@@ -1,0 +1,552 @@
+"""graftlint engine 3: the HLO collective & cost auditor.
+
+Engine 1 audits what we *wrote* (source ASTs), engine 2 what we
+*traced* (jaxprs).  Neither sees what XLA actually *emits* — and that
+is where a lowering regression lives: a stray all-gather from a
+sharding mismatch, f32<->bf16 convert churn, a donation that silently
+stopped aliasing, a 2x FLOP jump from a lost fusion.  This engine
+``jit(...).lower().compile()``s the real entry points (via the
+lowerable builders the production modules expose) and asserts, per
+entry:
+
+- **collective audit** — the optimized HLO's collective op counts: the
+  sharded train step carries exactly the ledger-sanctioned gradient
+  all-reduce set (plus what the ``spatial`` corr sharding legitimately
+  needs) and nothing else; the ring corr path MUST ride
+  ``collective-permute`` (its whole point) and must not all-gather; the
+  unsharded step, eval forward, and single-device corr lookups carry no
+  collectives at all.
+- **cost & memory budgets** — ``cost_analysis()`` FLOPs/bytes and
+  ``memory_analysis()`` argument/output/temp bytes vs the checked-in
+  ``budgets.json`` ledger (see budgets.py for tolerance semantics and
+  the ``--update-budgets`` re-baseline workflow).
+- **lowering hygiene** — the donated step's stablehlo must carry
+  input-output aliases; f32<->bf16 convert counts and copy counts are
+  bounded per entry.
+
+Compiles are pinned to ``xla_backend_optimization_level=1``
+(:data:`COMPILER_OPTIONS`): ~40% faster than the default pipeline on
+this container with identical collective/alias structure, and the
+ledger only has to be self-consistent under one fixed pipeline.  All
+entries use deliberately tiny shapes (and the `small` model for the
+train steps) — every audited property is *structural*, so it survives
+the shrink while keeping the whole engine around a minute on CPU.
+
+Like the jaxpr engine, environment gaps degrade to notes, never
+failures: too few devices skips the sharded entries, a missing pallas
+skips the fallback lookup, and a platform/jax-version mismatch with the
+ledger's ``meta`` demotes budget comparisons (budgets.py).
+
+``FIXTURE_ENTRIES`` holds deliberately-broken entry points (a
+mis-sharded lookup whose forgotten out-sharding forces an all-gather);
+they never run by default — tests select them with ``--audits`` to
+prove the rules actually trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import inspect
+import re
+import time
+from collections import Counter
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu.analysis import budgets as budgets_mod
+from raft_tpu.analysis.findings import Finding
+from raft_tpu.analysis.jaxpr_audit import (JaxprWaiver, apply_data_waivers,
+                                           donation_alias_count)
+
+# Every HLO opcode that moves data across devices.  "-start" variants
+# cover async-split collectives (TPU); the matching "-done" ops carry no
+# second transfer and are not counted.
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "all-to-all", "collective-permute",
+    "reduce-scatter", "collective-broadcast", "all-reduce-start",
+    "all-gather-start", "collective-permute-start", "ragged-all-to-all",
+)
+
+_NO_COLLECTIVES = COLLECTIVE_KINDS  # forbid-list for single-device entries
+
+# Pinned compile options — the ledger is only comparable under one
+# fixed optimization pipeline (see module docstring).
+COMPILER_OPTIONS: Dict[str, str] = {"xla_backend_optimization_level": "1"}
+
+# Data-declared exceptions, same machinery as the jaxpr engine's
+# WAIVERS (provenance-substring match on the message, mandatory
+# reason).  None needed at HEAD; the tuple exists so a future sanctioned
+# exception is one data entry, not new control flow.
+WAIVERS: Tuple[JaxprWaiver, ...] = ()
+
+
+# --------------------------------------------------------------------------
+# optimized-HLO text parsing (pure: unit-tested against fixture text)
+# --------------------------------------------------------------------------
+
+# An HLO instruction line:  [ROOT] %name = <type> opcode(operands...)
+# where <type> is either a plain shape token (f32[2,4]{1,0}) or a tuple
+# type with one nesting level ((f32[2]{0}, (f32[3]{0}, u8[]))) — the
+# tuple case matters because combined collectives (all-reduce over many
+# gradient buffers) are tuple-typed, and missing THOSE would blind the
+# exact check this engine exists for.
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%[\w.\-]+\s*=\s*"
+    r"(?:\((?:[^()]|\([^()]*\))*\)|[^\s(]+)\s+"
+    r"([a-zA-Z][\w\-]*)\(")
+
+_CONVERT_RE = re.compile(
+    r"=\s*([a-z0-9]+)\[[^\]]*\]\S*\s+convert\(\s*([a-z0-9]+)\[")
+
+
+def hlo_op_counts(hlo_text: str) -> Counter:
+    """Opcode -> count over every instruction in an HLO module text
+    (including fused computation bodies)."""
+    counts: Counter = Counter()
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m:
+            counts[m.group(1)] += 1
+    return counts
+
+
+def collective_counts(counts: Counter) -> Dict[str, int]:
+    """The collective subset of an opcode count, zero entries dropped."""
+    return {k: counts[k] for k in COLLECTIVE_KINDS if counts.get(k)}
+
+
+def convert_churn(hlo_text: str) -> Tuple[int, int]:
+    """(total convert ops, f32<->bf16 converts) in an HLO module text.
+    The pair count is the mixed-precision churn metric: every one is a
+    rounding (or widening) pass over a whole buffer."""
+    total = 0
+    f32_bf16 = 0
+    for m in _CONVERT_RE.finditer(hlo_text):
+        total += 1
+        if {m.group(1), m.group(2)} == {"f32", "bf16"}:
+            f32_bf16 += 1
+    return total, f32_bf16
+
+
+# --------------------------------------------------------------------------
+# measurement
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class HloMeasurement:
+    """Everything the budget ledger records about one compiled entry."""
+
+    entry: str
+    flops: float
+    bytes_accessed: float
+    argument_bytes: float
+    output_bytes: float
+    temp_bytes: float
+    collectives: Dict[str, int]
+    aliases: int
+    convert_ops: int
+    convert_f32_bf16: int
+    copy_ops: int
+    seconds: float = 0.0
+
+    def ledger_record(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d.pop("entry")
+        d.pop("seconds")
+        return d
+
+
+def measure_compiled(entry: str, lowered_text: str, compiled,
+                     seconds: float = 0.0) -> HloMeasurement:
+    """Fold one compiled executable into the ledger's metric set."""
+    txt = compiled.as_text()
+    counts = hlo_op_counts(txt)
+    conv, conv_bf16 = convert_churn(txt)
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    cost = cost or {}
+    mem = compiled.memory_analysis()
+    return HloMeasurement(
+        entry=entry,
+        flops=float(cost.get("flops", 0.0)),
+        bytes_accessed=float(cost.get("bytes accessed", 0.0)),
+        argument_bytes=float(getattr(mem, "argument_size_in_bytes", 0)),
+        output_bytes=float(getattr(mem, "output_size_in_bytes", 0)),
+        temp_bytes=float(getattr(mem, "temp_size_in_bytes", 0)),
+        collectives=collective_counts(counts),
+        aliases=donation_alias_count(lowered_text),
+        convert_ops=conv,
+        convert_f32_bf16=conv_bf16,
+        copy_ops=counts.get("copy", 0),
+        seconds=seconds)
+
+
+# --------------------------------------------------------------------------
+# entry-point registry
+# --------------------------------------------------------------------------
+
+class SkipEntry(Exception):
+    """Raised by a builder when its environment prerequisite is absent;
+    the runner reports a note instead of a finding."""
+
+
+@dataclasses.dataclass(frozen=True)
+class HloEntry:
+    name: str
+    builder: Callable[[], Tuple[Callable, tuple]]
+    # (module, attr) of the production builder — findings about the
+    # *program* anchor at its file:line
+    anchor: Tuple[str, str]
+    donated: bool = False
+    forbid: Tuple[str, ...] = _NO_COLLECTIVES
+    require: Tuple[str, ...] = ()
+    budgeted: bool = True
+
+
+def _audit_mesh():
+    import jax
+
+    from raft_tpu.parallel.mesh import virtual_device_mesh
+
+    mesh = virtual_device_mesh()
+    if mesh is None:
+        raise SkipEntry(
+            f"needs 8 devices, have {jax.device_count()} (run via "
+            f"`python -m raft_tpu.analysis`, which forces 8 virtual "
+            f"CPU devices)")
+    return mesh
+
+
+def _build_train_step():
+    from raft_tpu.training.step import abstract_train_step
+
+    # `small` keeps the compile ~20 s; donation/collective/churn facts
+    # are structural and identical on the large model (which engine 2
+    # traces).
+    return abstract_train_step(iters=2, donate=True,
+                               overrides={"small": True})
+
+
+def _build_parallel_step():
+    from raft_tpu.parallel.step import abstract_parallel_step
+
+    mesh = _audit_mesh()
+    return abstract_parallel_step(
+        mesh, iters=2, overrides={"small": True, "corr_shard": True},
+        shard_inputs=True)
+
+
+def _build_eval_forward():
+    from raft_tpu.evaluation.evaluate import abstract_eval_forward
+
+    return abstract_eval_forward(iters=2)
+
+
+def _build_eval_forward_bf16():
+    # the entry with real f32<->bf16 boundary crossings: its
+    # convert_f32_bf16 bound is the churn gate (a policy change that
+    # starts bouncing activations between dtypes shows up here first)
+    from raft_tpu.evaluation.evaluate import abstract_eval_forward
+
+    return abstract_eval_forward(
+        iters=2, overrides={"compute_dtype": "bfloat16",
+                            "corr_dtype": "bfloat16"})
+
+
+def _build_corr_dense():
+    from raft_tpu.ops.corr import abstract_corr_lookup
+
+    return abstract_corr_lookup("dense")
+
+
+def _build_corr_chunked():
+    from raft_tpu.ops.corr import abstract_corr_lookup
+
+    return abstract_corr_lookup("chunked")
+
+
+def _build_corr_pallas():
+    from raft_tpu.ops.corr_pallas import abstract_ondemand_lookup
+
+    return abstract_ondemand_lookup()
+
+
+def _build_corr_ring():
+    from raft_tpu.parallel.ring import abstract_ring_lookup
+
+    return abstract_ring_lookup(_audit_mesh())
+
+
+def _build_seeded_missharded():
+    """Deliberate regression fixture: the dense lookup with its batch
+    sharded over ``data`` but a REPLICATED forced output — the classic
+    forgotten out-sharding.  GSPMD repairs the mismatch by all-gathering
+    the result every step; the collective audit must catch exactly
+    that."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_tpu.ops.corr import abstract_corr_lookup
+    from raft_tpu.parallel.mesh import DATA_AXIS
+
+    mesh = _audit_mesh()
+    fn, (f_sds, _, co_sds) = abstract_corr_lookup("dense", batch=8)
+    sharded = NamedSharding(mesh, P(DATA_AXIS))
+    bad = jax.jit(fn, in_shardings=(sharded, sharded, sharded),
+                  out_shardings=NamedSharding(mesh, P()))
+    return bad, (f_sds, f_sds, co_sds)
+
+
+ENTRIES: Dict[str, HloEntry] = {
+    "train_step": HloEntry(
+        "train_step", _build_train_step,
+        ("raft_tpu.training.step", "abstract_train_step"), donated=True),
+    "parallel_step": HloEntry(
+        "parallel_step", _build_parallel_step,
+        ("raft_tpu.parallel.step", "abstract_parallel_step"),
+        # all-reduce (gradients) and the spatial path's legitimate
+        # resharding traffic are ledger-pinned EXACTLY; all-to-all has
+        # no sanctioned source in this program, so it is forbidden
+        # structurally on top of the ledger.
+        forbid=("all-to-all", "ragged-all-to-all")),
+    "eval_forward": HloEntry(
+        "eval_forward", _build_eval_forward,
+        ("raft_tpu.evaluation.evaluate", "abstract_eval_forward")),
+    "eval_forward_bf16": HloEntry(
+        "eval_forward_bf16", _build_eval_forward_bf16,
+        ("raft_tpu.evaluation.evaluate", "abstract_eval_forward")),
+    "corr_lookup_dense": HloEntry(
+        "corr_lookup_dense", _build_corr_dense,
+        ("raft_tpu.ops.corr", "abstract_corr_lookup")),
+    "corr_lookup_chunked": HloEntry(
+        "corr_lookup_chunked", _build_corr_chunked,
+        ("raft_tpu.ops.corr", "abstract_corr_lookup")),
+    "corr_lookup_pallas": HloEntry(
+        "corr_lookup_pallas", _build_corr_pallas,
+        ("raft_tpu.ops.corr_pallas", "abstract_ondemand_lookup")),
+    "corr_ring": HloEntry(
+        "corr_ring", _build_corr_ring,
+        ("raft_tpu.parallel.ring", "abstract_ring_lookup"),
+        forbid=("all-gather", "all-gather-start", "all-to-all",
+                "ragged-all-to-all"),
+        require=("collective-permute",)),
+}
+
+FIXTURE_ENTRIES: Dict[str, HloEntry] = {
+    "seeded_missharded": HloEntry(
+        "seeded_missharded", _build_seeded_missharded,
+        ("raft_tpu.analysis.hlo_audit", "_build_seeded_missharded"),
+        budgeted=False),
+}
+
+
+def entry_anchor(entry: HloEntry) -> Tuple[str, int]:
+    """(repo-relative file, def line) of the entry's builder — where a
+    program-level finding points."""
+    try:
+        mod = importlib.import_module(entry.anchor[0])
+        fn = getattr(mod, entry.anchor[1])
+        path = inspect.getsourcefile(fn)
+        line = inspect.getsourcelines(fn)[1]
+        return budgets_mod.display_path(path), line
+    except (ImportError, AttributeError, OSError, TypeError):
+        return entry.anchor[0].replace(".", "/") + ".py", 0
+
+
+# --------------------------------------------------------------------------
+# the audit
+# --------------------------------------------------------------------------
+
+def _note(entry: str, message: str) -> Finding:
+    return Finding(engine="hlo", rule="hlo-audit", path=entry, line=0,
+                   message=message, severity="note")
+
+
+def _structural_findings(entry: HloEntry, m: HloMeasurement,
+                         anchor: Tuple[str, int]) -> List[Finding]:
+    path, line = anchor
+    out: List[Finding] = []
+    for kind in entry.forbid:
+        n = m.collectives.get(kind, 0)
+        if n:
+            out.append(Finding(
+                engine="hlo", rule="unexpected-collective", path=path,
+                line=line,
+                message=f"{entry.name}: {n}x {kind} in a program that "
+                        f"must not communicate over this kind — a "
+                        f"sharding/layout mismatch made XLA insert "
+                        f"cross-device traffic",
+                data={"entry": entry.name, "kind": kind, "got": n,
+                      "want": 0}))
+    for kind in entry.require:
+        if not m.collectives.get(kind, 0):
+            out.append(Finding(
+                engine="hlo", rule="missing-collective", path=path,
+                line=line,
+                message=f"{entry.name}: lowering contains no {kind} — "
+                        f"the path's defining communication pattern "
+                        f"degenerated (e.g. the ring rotation was "
+                        f"optimized into replication)",
+                data={"entry": entry.name, "kind": kind}))
+    if entry.donated and m.aliases == 0:
+        out.append(Finding(
+            engine="hlo", rule="donation", path=path, line=line,
+            message=f"{entry.name}: donate=True lowered with ZERO "
+                    f"input-output aliases — donation is entirely "
+                    f"broken and peak HBM doubles",
+            data={"entry": entry.name}))
+    return out
+
+
+def _apply_waivers(findings: List[Finding]) -> List[Finding]:
+    return apply_data_waivers(findings, WAIVERS)
+
+
+def current_meta(tolerance: float = budgets_mod.DEFAULT_TOLERANCE) -> Dict:
+    import jax
+
+    return {
+        "platform": jax.default_backend(),
+        "jax": jax.__version__,
+        "opt_level": COMPILER_OPTIONS["xla_backend_optimization_level"],
+        "tolerance": tolerance,
+    }
+
+
+def _meta_matches(meta: Dict, now: Dict) -> bool:
+    return all(meta.get(k) == now[k]
+               for k in ("platform", "jax", "opt_level"))
+
+
+def measure_entry(entry: HloEntry) -> HloMeasurement:
+    """Trace, lower and compile one entry point; raises SkipEntry /
+    ImportError for environment gaps."""
+    t0 = time.monotonic()
+    fn, args = entry.builder()
+    lowered = fn.lower(*args)
+    lowered_text = lowered.as_text()
+    try:
+        compiled = lowered.compile(compiler_options=dict(COMPILER_OPTIONS))
+    except TypeError:  # jax too old for compiler_options: fixed pipeline
+        compiled = lowered.compile()
+    return measure_compiled(entry.name, lowered_text, compiled,
+                            seconds=round(time.monotonic() - t0, 2))
+
+
+def run_hlo_audit(names: Optional[Sequence[str]] = None,
+                  budgets_path: Optional[str] = None,
+                  update: bool = False
+                  ) -> Tuple[List[Finding], Dict]:
+    """Run the named entry audits (default: every non-fixture entry).
+
+    ``update=True`` re-baselines: writes the measured metrics into the
+    ledger (merge semantics — see budgets.save_budgets) instead of
+    comparing against it.  Structural rules (unexpected/missing
+    collectives, zero-alias donation) are asserted either way: a broken
+    program must not be baselinable.
+
+    Returns ``(findings, report)``; the report carries every entry's
+    measured metrics and per-entry compile seconds.
+    """
+    all_entries = {**ENTRIES, **FIXTURE_ENTRIES}
+    if names is None:
+        selected = list(ENTRIES)
+    else:
+        unknown = [n for n in names if n not in all_entries]
+        if unknown:
+            raise KeyError(
+                f"unknown hlo audit(s) {unknown}; known: "
+                f"{sorted(all_entries)}")
+        selected = list(names)
+
+    ledger_path = budgets_path or budgets_mod.default_budgets_path()
+    ledger = budgets_mod.load_budgets(ledger_path)
+    meta_now = current_meta()
+    tolerance = budgets_mod.DEFAULT_TOLERANCE
+    strict = True
+    if ledger is not None:
+        tolerance = float(
+            ledger.get("meta", {}).get("tolerance", tolerance))
+        strict = _meta_matches(ledger.get("meta", {}), meta_now)
+
+    findings: List[Finding] = []
+    report: Dict = {}
+    measured: Dict[str, HloMeasurement] = {}
+    broken: set = set()
+    for name in selected:
+        entry = all_entries[name]
+        try:
+            m = measure_entry(entry)
+        except SkipEntry as e:
+            findings.append(_note(name, f"skipped: {e}"))
+            continue
+        except ImportError as e:
+            findings.append(_note(
+                name, f"skipped: unavailable here ({e})"))
+            continue
+        measured[name] = m
+        report[name] = dataclasses.asdict(m)
+        structural = _structural_findings(entry, m, entry_anchor(entry))
+        if structural:
+            broken.add(name)
+        findings.extend(structural)
+
+    if update:
+        # a broken program must not be baselinable: entries with
+        # structural findings keep their old ledger record (and the run
+        # still exits 1 on them)
+        records = {n: m.ledger_record() for n, m in measured.items()
+                   if all_entries[n].budgeted and n not in broken}
+        skipped = sorted(n for n in measured
+                         if all_entries[n].budgeted and n in broken)
+        for name in skipped:
+            findings.append(_note(
+                name, "not re-baselined: structural findings above "
+                      "must be fixed first"))
+        # a partial re-baseline under a CHANGED toolchain would stamp
+        # the new meta onto old-environment records: the next full run
+        # would then strictly compare entries measured under the old
+        # jax/platform against programs from the new one.  Refuse —
+        # re-baseline everything at once when the environment moves.
+        stale = sorted(
+            n for n in (ledger or {}).get("entries", {})
+            if n in ENTRIES and ENTRIES[n].budgeted and n not in records)
+        if ledger is not None and stale and not _meta_matches(
+                ledger.get("meta", {}), meta_now):
+            findings.append(Finding(
+                engine="hlo", rule="budget-meta",
+                path=budgets_mod.display_path(ledger_path), line=0,
+                message=f"refusing partial --update-budgets: the "
+                        f"ledger was baselined under "
+                        f"{ledger.get('meta')}, this environment is "
+                        f"{meta_now}, and {stale} would keep "
+                        f"old-environment records under the new meta "
+                        f"— run --update-budgets without --audits to "
+                        f"re-baseline everything"))
+            records = {}
+        if records:
+            budgets_mod.save_budgets(ledger_path,
+                                     current_meta(tolerance), records)
+        report["budgets_written"] = {
+            "path": budgets_mod.display_path(ledger_path),
+            "entries": sorted(records),
+            "skipped_broken": skipped}
+    else:
+        if not strict:
+            findings.append(_note(
+                "budgets", f"ledger meta "
+                f"{(ledger or {}).get('meta')} does not match this "
+                f"environment {meta_now}: budget comparisons demoted "
+                f"to notes — re-baseline with --update-budgets"))
+        entries_ledger = (ledger or {}).get("entries", {})
+        for name, m in measured.items():
+            if not all_entries[name].budgeted:
+                continue
+            findings.extend(budgets_mod.compare_entry(
+                name, entries_ledger.get(name), m.ledger_record(),
+                ledger_path, tolerance=tolerance, strict=strict,
+                anchor=entry_anchor(all_entries[name])))
+
+    report["timings"] = {n: m.seconds for n, m in measured.items()}
+    return _apply_waivers(findings), report

@@ -194,9 +194,13 @@ def _normalize_jaxpr_str(s: str) -> str:
     return re.sub(r" at 0x[0-9a-f]+", "", s)
 
 
-def _apply_waivers(findings: List[Finding]) -> List[Finding]:
+def apply_data_waivers(findings: List[Finding],
+                       waivers: Sequence["JaxprWaiver"]) -> List[Finding]:
+    """Apply a tuple of data-declared waivers (this engine's or the HLO
+    engine's — one matcher, so the predicate semantics can never
+    diverge between them)."""
     for f in findings:
-        for w in WAIVERS:
+        for w in waivers:
             if w.invariant != f.rule:
                 continue
             if w.provenance not in f.message:
@@ -207,6 +211,10 @@ def _apply_waivers(findings: List[Finding]) -> List[Finding]:
             f.waiver_reason = w.reason
             break
     return findings
+
+
+def _apply_waivers(findings: List[Finding]) -> List[Finding]:
+    return apply_data_waivers(findings, WAIVERS)
 
 
 def _finding(rule: str, entry: str, message: str,
@@ -226,70 +234,34 @@ def _f64_findings(entry: str, closed) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
-# tiny abstract harness (shapes chosen so every pyramid level stays >= 1px
-# and traces take seconds: trace cost scales with graph size, not shapes)
+# entry-point audits — traces come from the lowerable entry-point
+# builders the production modules expose (training/step.py
+# abstract_train_step and friends; shapes there are chosen so every
+# pyramid level stays >= 1px and traces take seconds: trace cost scales
+# with graph size, not shapes).  The HLO engine (hlo_audit.py) compiles
+# the same builders; this engine stays compile-free.
 # --------------------------------------------------------------------------
 
-_B, _H, _W, _ITERS = 2, 64, 64, 2
+_ITERS = 2
 
-
-def _tiny_batch():
-    import jax.numpy as jnp
-
-    return {
-        "image1": jnp.zeros((_B, _H, _W, 3), jnp.float32),
-        "image2": jnp.zeros((_B, _H, _W, 3), jnp.float32),
-        "flow": jnp.zeros((_B, _H, _W, 2), jnp.float32),
-        "valid": jnp.ones((_B, _H, _W), jnp.float32),
-    }
-
-
-def _abstract_pieces(model_overrides: Optional[Dict] = None):
-    """(model, state_sds, batch_sds): everything abstract, nothing computed."""
-    import jax
-
-    from raft_tpu.config import RAFTConfig
-    from raft_tpu.models import RAFT
-    from raft_tpu.training import create_train_state, make_optimizer
-
-    cfg = RAFTConfig(**(model_overrides or {}))
-    model = RAFT(cfg)
-    tx, _ = make_optimizer(lr=4e-4, num_steps=100, wdecay=1e-4)
-    batch = _tiny_batch()
-    state_sds = jax.eval_shape(
-        lambda rng, b: create_train_state(model, tx, rng, b, iters=_ITERS),
-        jax.random.PRNGKey(0), batch)
-    batch_sds = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
-    return model, state_sds, batch_sds
-
-
-def _make_step(model, donate: bool = False, add_noise: bool = False):
-    from raft_tpu.training.step import make_train_step
-
-    return make_train_step(model, iters=_ITERS, gamma=0.8, max_flow=400.0,
-                           donate=donate, add_noise=add_noise)
-
-
-# --------------------------------------------------------------------------
-# entry-point audits
-# --------------------------------------------------------------------------
 
 def audit_train_step() -> Tuple[List[Finding], Dict]:
     """training/step.py: f64 under x64, scan transfers, retrace stability."""
     import jax
     from jax.experimental import enable_x64
 
-    model, state_sds, batch_sds = _abstract_pieces()
+    from raft_tpu.training.step import abstract_train_step
+
+    # two INDEPENDENT builds: identical jaxprs == stable compile key.
+    # add_noise=True covers the widest trace (the noise path is where
+    # dtype-less random draws would hide).
+    step1, (state_sds, batch_sds) = abstract_train_step(
+        iters=_ITERS, add_noise=True)
+    step2, _ = abstract_train_step(iters=_ITERS, add_noise=True)
     findings: List[Finding] = []
     with enable_x64():
-        # two INDEPENDENT builds: identical jaxprs == stable compile key.
-        # add_noise=True covers the widest trace (the noise path is where
-        # dtype-less random draws would hide).
-        jx1 = jax.make_jaxpr(_make_step(model, add_noise=True))(
-            state_sds, batch_sds)
-        jx2 = jax.make_jaxpr(_make_step(model, add_noise=True))(
-            state_sds, batch_sds)
+        jx1 = jax.make_jaxpr(step1)(state_sds, batch_sds)
+        jx2 = jax.make_jaxpr(step2)(state_sds, batch_sds)
     s1, s2 = _normalize_jaxpr_str(str(jx1)), _normalize_jaxpr_str(str(jx2))
     if s1 != s2:
         diff_at = next((i for i, (a, b) in enumerate(zip(s1, s2))
@@ -314,8 +286,10 @@ def audit_donation() -> Tuple[List[Finding], Dict]:
     """training/step.py donate=True: aliases must cover the state."""
     import jax
 
-    model, state_sds, batch_sds = _abstract_pieces()
-    step = _make_step(model, donate=True)
+    from raft_tpu.training.step import abstract_train_step
+
+    step, (state_sds, batch_sds) = abstract_train_step(
+        iters=_ITERS, donate=True)
     low = step.lower(state_sds, batch_sds)
     aliases = donation_alias_count(low.as_text())
     n_param_leaves = len(jax.tree.leaves(state_sds.params))
@@ -337,9 +311,11 @@ def audit_bf16_policy() -> Tuple[List[Finding], Dict]:
     import jax
     import jax.numpy as jnp
 
-    model, state_sds, batch_sds = _abstract_pieces(
-        {"compute_dtype": "bfloat16", "corr_dtype": "bfloat16"})
-    step = _make_step(model)
+    from raft_tpu.training.step import abstract_train_step
+
+    step, (state_sds, batch_sds) = abstract_train_step(
+        iters=_ITERS,
+        overrides={"compute_dtype": "bfloat16", "corr_dtype": "bfloat16"})
     jx = jax.make_jaxpr(step)(state_sds, batch_sds)
     findings: List[Finding] = []
     bad = find_unaccumulated_bf16_dots(jx)
@@ -375,32 +351,20 @@ def audit_parallel_step() -> Tuple[List[Finding], Dict]:
     """parallel/step.py under the (data=2, spatial=4) CPU mesh."""
     import jax
 
-    if jax.device_count() < 8:
+    from raft_tpu.parallel.mesh import set_mesh, virtual_device_mesh
+    from raft_tpu.parallel.step import abstract_parallel_step
+
+    mesh = virtual_device_mesh()
+    if mesh is None:
         return [_finding(
             "sharded-trace", "parallel_step",
             f"skipped: needs 8 devices, have {jax.device_count()} (run "
             f"via `python -m raft_tpu.analysis`, which forces 8 virtual "
             f"CPU devices)", severity="note")], {}
 
-    from raft_tpu.config import RAFTConfig
-    from raft_tpu.models import RAFT
-    from raft_tpu.parallel.mesh import make_mesh, set_mesh
-    from raft_tpu.parallel.step import make_parallel_train_step
-    from raft_tpu.training import create_train_state, make_optimizer
-
-    mesh = make_mesh(data=2, spatial=4)
-    model = RAFT(RAFTConfig(corr_shard=True))
-    tx, _ = make_optimizer(lr=4e-4, num_steps=100, wdecay=1e-4)
-    batch = _tiny_batch()
+    step, (state_sds, batch_sds) = abstract_parallel_step(
+        mesh, iters=_ITERS)
     with set_mesh(mesh):
-        state_sds = jax.eval_shape(
-            lambda rng, b: create_train_state(model, tx, rng, b,
-                                              iters=_ITERS),
-            jax.random.PRNGKey(0), batch)
-        step = make_parallel_train_step(model, mesh, iters=_ITERS,
-                                        gamma=0.8, max_flow=400.0)
-        batch_sds = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
         jx = jax.make_jaxpr(step)(state_sds, batch_sds)
     findings = _f64_findings("parallel_step", jx)
     for prim, prov in find_loop_transfers(jx):
@@ -411,24 +375,14 @@ def audit_parallel_step() -> Tuple[List[Finding], Dict]:
 
 
 def audit_eval_forward() -> Tuple[List[Finding], Dict]:
-    """evaluation/evaluate.py-style jitted test_mode forward."""
+    """evaluation/evaluate.py's jitted test_mode forward."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import enable_x64
 
-    from raft_tpu.config import RAFTConfig
-    from raft_tpu.models import RAFT
+    from raft_tpu.evaluation.evaluate import abstract_eval_forward
 
-    model = RAFT(RAFTConfig())
-    batch = _tiny_batch()
-    variables_sds = jax.eval_shape(
-        lambda rng, b: model.init(rng, b["image1"], b["image2"],
-                                  iters=_ITERS, train=True),
-        jax.random.PRNGKey(0), batch)
-    img_sds = jax.ShapeDtypeStruct((1, _H, _W, 3), jnp.float32)
-
-    def fwd(v, a, b):
-        return model.apply(v, a, b, iters=_ITERS, test_mode=True)
+    fwd, (variables_sds, img_sds, _) = abstract_eval_forward(iters=_ITERS)
 
     with enable_x64():
         jx = jax.make_jaxpr(fwd)(variables_sds, img_sds, img_sds)
@@ -450,47 +404,35 @@ def audit_eval_forward() -> Tuple[List[Finding], Dict]:
 def audit_corr_lookups() -> Tuple[List[Finding], Dict]:
     """ops/corr.py + ops/corr_pallas.py lookup kernels, tiny shapes."""
     import jax
-    import jax.numpy as jnp
     from jax.experimental import enable_x64
 
-    from raft_tpu.ops.corr import (build_corr_pyramid_direct,
-                                   build_fmap_pyramid, chunked_corr_lookup,
-                                   corr_lookup)
+    from raft_tpu.ops.corr import abstract_corr_lookup
 
-    B, H8, W8, C = 1, 8, 8, 16
-    f1 = jax.ShapeDtypeStruct((B, H8, W8, C), jnp.float32)
-    f2 = jax.ShapeDtypeStruct((B, H8, W8, C), jnp.float32)
-    coords = jax.ShapeDtypeStruct((B, H8, W8, 2), jnp.float32)
     findings: List[Finding] = []
     report: Dict = {"traced": []}
 
-    def dense(fm1, fm2, co):
-        pyr = build_corr_pyramid_direct(fm1, fm2, 4)
-        return corr_lookup(pyr, co, radius=4)
+    entries = [("corr_lookup_dense", lambda: abstract_corr_lookup("dense")),
+               ("corr_lookup_chunked",
+                lambda: abstract_corr_lookup("chunked"))]
 
-    def chunked(fm1, fm2, co):
-        return chunked_corr_lookup(fm1, build_fmap_pyramid(fm2, 4), co,
-                                   radius=4, chunk=32)
+    def pallas():
+        from raft_tpu.ops.corr_pallas import abstract_ondemand_lookup
 
-    entries = [("corr_lookup_dense", dense), ("corr_lookup_chunked", chunked)]
-    try:
-        from raft_tpu.ops.corr_pallas import ondemand_corr_lookup
+        return abstract_ondemand_lookup()
 
-        def pallas(fm1, fm2, co):
-            return ondemand_corr_lookup(fm1, build_fmap_pyramid(fm2, 4),
-                                        co, radius=4)
+    entries.append(("corr_lookup_pallas", pallas))
 
-        entries.append(("corr_lookup_pallas", pallas))
-    except ImportError as e:
-        findings.append(_finding(
-            "no-float64", "corr_lookup_pallas",
-            f"skipped: pallas kernel unavailable here ({e})",
-            severity="note"))
-
-    for name, fn in entries:
+    for name, build in entries:
         try:
+            fn, args = build()
             with enable_x64():
-                jx = jax.make_jaxpr(fn)(f1, f2, coords)
+                jx = jax.make_jaxpr(fn)(*args)
+        except ImportError as e:
+            findings.append(_finding(
+                "no-float64", name,
+                f"skipped: pallas kernel unavailable here ({e})",
+                severity="note"))
+            continue
         except (TypeError, ValueError, NotImplementedError,
                 jax.errors.JAXTypeError) as e:
             findings.append(_finding(

@@ -71,6 +71,30 @@ class Evaluator:
         return fn(self.variables, image1, image2)
 
 
+def abstract_eval_forward(iters: int = 2, hw=(64, 64),
+                          overrides: Dict = None):
+    """The Evaluator's jitted batch-1 test_mode forward over abstract
+    inputs: the lowerable entry point the static-analysis engines audit
+    (exactly the cold-path ``jax.jit`` the shape-bucket cache compiles,
+    built without an Evaluator or real weights).
+
+    Returns ``(fwd, (variables_sds, img1_sds, img2_sds))`` with ``fwd``
+    supporting ``.lower()``.
+    """
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+
+    model = RAFT(RAFTConfig(**(overrides or {})))
+    H, W = hw
+    img_sds = jax.ShapeDtypeStruct((1, H, W, 3), jnp.float32)
+    variables_sds = jax.eval_shape(
+        lambda rng, a, b: model.init(rng, a, b, iters=iters, train=True),
+        jax.random.PRNGKey(0), img_sds, img_sds)
+    fwd = jax.jit(lambda v, a, b: model.apply(v, a, b, iters=iters,
+                                              test_mode=True))
+    return fwd, (variables_sds, img_sds, img_sds)
+
+
 def validate_synthetic(evaluator: Evaluator, root: str = "datasets",
                        iters: int = 24, n_samples: int = 32,
                        image_size=(368, 496)) -> Dict[str, float]:

@@ -526,6 +526,35 @@ def chunked_corr_lookup(fmap1: jax.Array, fmap2_pyramid: Sequence[jax.Array],
     return out.reshape(B, H1, W1, -1).astype(jnp.float32)
 
 
+def abstract_corr_lookup(kind: str = "dense", batch: int = 1, hw=(8, 8),
+                         channels: int = 16, radius: int = 4,
+                         num_levels: int = 4, chunk: int = 32):
+    """Lowerable corr-lookup entry points for the static-analysis engines.
+
+    ``kind``: ``dense`` (direct matmul pyramid + windowed lookup — the
+    all-pairs training path) or ``chunked`` (the on-demand O(H*W) path).
+    Shapes are the smallest that keep every pyramid level >= 1 px.
+
+    Returns ``(fn, (f1_sds, f2_sds, coords_sds))`` with ``fn`` supporting
+    ``.lower()``.
+    """
+    H, W = hw
+    f_sds = jax.ShapeDtypeStruct((batch, H, W, channels), jnp.float32)
+    coords_sds = jax.ShapeDtypeStruct((batch, H, W, 2), jnp.float32)
+
+    if kind == "dense":
+        def fn(f1, f2, coords):
+            pyr = build_corr_pyramid_direct(f1, f2, num_levels)
+            return corr_lookup(pyr, coords, radius=radius)
+    elif kind == "chunked":
+        def fn(f1, f2, coords):
+            return chunked_corr_lookup(f1, build_fmap_pyramid(f2, num_levels),
+                                       coords, radius=radius, chunk=chunk)
+    else:
+        raise ValueError(f"unknown corr lookup kind {kind!r}")
+    return jax.jit(fn), (f_sds, f_sds, coords_sds)
+
+
 def alternate_corr_lookup(fmap1: jax.Array, fmap2_pyramid: Sequence[jax.Array],
                           coords: jax.Array, radius: int) -> jax.Array:
     """On-demand correlation lookup, lax reference implementation.

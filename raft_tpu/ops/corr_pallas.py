@@ -1512,3 +1512,29 @@ def _bwd_xla(radius, q_tile, residuals, g):
 
 ondemand_corr_lookup.defvjp(_fwd, _bwd)
 pyramid_window_lookup.defvjp(_pyr_lookup_fwd, _pyr_lookup_bwd)
+
+
+def abstract_ondemand_lookup(batch: int = 1, hw=(8, 8), channels: int = 16,
+                             radius: int = 4, num_levels: int = 4):
+    """Lowerable Pallas-lookup entry point for the static-analysis
+    engines.  Off-TPU this lowers through the kernel's interpret-mode
+    fallback (``_on_tpu`` dispatch), which is exactly what CPU callers
+    of ``corr_impl="ondemand"`` execute — so the audit covers the
+    fallback path's lowering, while Mosaic-specific behavior stays a
+    hardware concern (``RAFT_TESTS_ON_DEVICE=1``).
+
+    Returns ``(fn, (f1_sds, f2_sds, coords_sds))`` with ``fn``
+    supporting ``.lower()``.  Raises ImportError where pallas itself is
+    unavailable; callers report a skip note.
+    """
+    from raft_tpu.ops.corr import build_fmap_pyramid
+
+    H, W = hw
+    f_sds = jax.ShapeDtypeStruct((batch, H, W, channels), jnp.float32)
+    coords_sds = jax.ShapeDtypeStruct((batch, H, W, 2), jnp.float32)
+
+    def fn(f1, f2, coords):
+        pyr = tuple(build_fmap_pyramid(f2, num_levels))
+        return ondemand_corr_lookup(f1, pyr, coords, radius=radius)
+
+    return jax.jit(fn), (f_sds, f_sds, coords_sds)

@@ -49,9 +49,35 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
             jax.distributed.initialize()
         # else single host — nothing to do
         return
+    _enable_cpu_collectives(jax)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+
+
+def _enable_cpu_collectives(jax) -> None:
+    """Wire gloo collectives into the CPU backend for multi-process runs.
+
+    The CPU PJRT client executes cross-process computations only when
+    created with a collectives implementation; without one, dispatch
+    raises "Multiprocess computations aren't implemented on the CPU
+    backend".  jax wires the in-tree gloo TCP collectives in when
+    ``jax_cpu_collectives_implementation`` is set — but never by
+    default, so the explicit-args fleet path (and the localhost
+    two-process test) must opt in here, BEFORE the backend initializes
+    (the same ordering rule as jax.distributed.initialize itself).
+    Only the CPU platform wants this; TPU/GPU collectives ride
+    ICI/NCCL and ignore the setting.
+    """
+    if not (os.environ.get("JAX_PLATFORMS") or "").startswith("cpu"):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, KeyError, ValueError):
+        # jaxlib without the gloo bindings/config: leave the backend
+        # as-is — tests/test_dist_multiprocess.py probes for this and
+        # skips instead of failing.
+        pass
 
 
 def _is_initialized(jax) -> bool:

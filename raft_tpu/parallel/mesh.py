@@ -84,6 +84,20 @@ def make_mesh(data: int = -1, spatial: int = 1,
     return Mesh(mesh_devices, (DATA_AXIS, SPATIAL_AXIS), **_MESH_KWARGS)
 
 
+def virtual_device_mesh(data: int = 2, spatial: int = 4) -> Optional[Mesh]:
+    """The audit/test mesh, or None when the backend has too few devices.
+
+    Single source of the (data=2, spatial=4) harness mesh the graftlint
+    jaxpr/HLO engines and the sharding tests lower against; callers that
+    get None report a skip note instead of failing (the 8 virtual CPU
+    devices come from ``xla_force_host_platform_device_count``, which
+    ``python -m raft_tpu.analysis`` and tests/conftest.py both force).
+    """
+    if jax.device_count() < data * spatial:
+        return None
+    return make_mesh(data=data, spatial=spatial)
+
+
 def batch_spec() -> P:
     """Batch-axis sharding spec for NHWC inputs."""
     return P(DATA_AXIS)

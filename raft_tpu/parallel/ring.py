@@ -124,3 +124,34 @@ def ring_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array, mesh: Mesh,
     vol = ring_all_pairs_correlation(fmap1, fmap2, mesh, axis)
     pyr = build_corr_pyramid(vol, num_levels)
     return [constrain(p, P(DATA_AXIS, axis, None, None)) for p in pyr]
+
+
+def abstract_ring_lookup(mesh: Mesh, batch: int = 2, hw=(8, 16),
+                         channels: int = 16, radius: int = 4,
+                         num_levels: int = 4):
+    """Lowerable ring-corr entry point for the static-analysis engines:
+    ring-rotated volume + query-sharded windowed lookup, the exact path
+    ``corr_shard_impl="ring"`` runs inside the model.  The HLO auditor
+    asserts its lowering rides ``collective-permute`` (the ring hops)
+    and nothing else — a ring that degenerates into all-gathers has
+    silently lost its O(H*W) memory guarantee.
+
+    Shapes default to the smallest config whose query count divides the
+    mesh's ``spatial`` axis and whose batch divides ``data``.
+
+    Returns ``(fn, (f1_sds, f2_sds, coords_sds))`` with ``fn``
+    supporting ``.lower()``.
+    """
+    from raft_tpu.ops.corr import corr_lookup
+    from raft_tpu.parallel.mesh import set_mesh
+
+    H, W = hw
+    f_sds = jax.ShapeDtypeStruct((batch, H, W, channels), jnp.float32)
+    coords_sds = jax.ShapeDtypeStruct((batch, H, W, 2), jnp.float32)
+
+    def fn(f1, f2, coords):
+        with set_mesh(mesh):
+            pyr = ring_corr_pyramid(f1, f2, mesh, num_levels)
+            return corr_lookup(pyr, coords, radius=radius, shard=True)
+
+    return jax.jit(fn), (f_sds, f_sds, coords_sds)

@@ -70,3 +70,47 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
             return base(state, batch)
 
     return step
+
+
+def abstract_parallel_step(mesh: Mesh, iters: int = 2,
+                           overrides: Dict = None,
+                           batch_size: int = 2,
+                           hw=(64, 64), gamma: float = 0.8,
+                           max_flow: float = 400.0,
+                           shard_inputs: bool = False):
+    """The sharded train step over abstract inputs on ``mesh``: the
+    lowerable entry point the static-analysis engines audit.
+
+    ``shard_inputs=True`` jits with the production placements (state
+    replicated, batch sharded over ``data`` — exactly what
+    ``replicate_state``/``shard_batch`` produce at runtime), so a
+    ``.lower()``/``.compile()`` of the result sees the real collective
+    profile: the gradient all-reduces over ``data`` plus whatever the
+    ``spatial`` corr sharding legitimately needs, and nothing else.
+    ``False`` leaves placement to GSPMD propagation (the jaxpr engine's
+    ``make_jaxpr`` path, which cannot carry shardings).
+
+    Returns ``(step, (state_sds, batch_sds))`` with ``step`` supporting
+    ``.lower()``.
+    """
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.training.optim import make_optimizer
+    from raft_tpu.training.state import create_train_state
+    from raft_tpu.training.step import tiny_abstract_batch
+
+    model = RAFT(RAFTConfig(**(overrides or {"corr_shard": True})))
+    tx, _ = make_optimizer(lr=4e-4, num_steps=100, wdecay=1e-4)
+    batch_sds = tiny_abstract_batch(batch_size, hw)
+    with set_mesh(mesh):
+        state_sds = jax.eval_shape(
+            lambda rng, b: create_train_state(model, tx, rng, b,
+                                              iters=iters),
+            jax.random.PRNGKey(0), batch_sds)
+        step = make_parallel_train_step(model, mesh, iters=iters,
+                                        gamma=gamma, max_flow=max_flow)
+    if shard_inputs:
+        step = jax.jit(step,
+                       in_shardings=(NamedSharding(mesh, P()),
+                                     NamedSharding(mesh, batch_spec())))
+    return step, (state_sds, batch_sds)

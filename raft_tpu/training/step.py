@@ -180,6 +180,59 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
     return aot_step
 
 
+def tiny_abstract_batch(batch_size: int = 2, hw: Tuple[int, int] = (64, 64)):
+    """ShapeDtypeStruct batch for lowering-based audits (graftlint).
+
+    64x64 is the smallest square whose 1/8-resolution feature maps still
+    admit the full 4-level corr pyramid (>= 8 px per side); trace and
+    compile cost scale with graph size, not shapes, so audits stay fast.
+    """
+    H, W = hw
+    sds = jax.ShapeDtypeStruct
+    return {
+        "image1": sds((batch_size, H, W, 3), jnp.float32),
+        "image2": sds((batch_size, H, W, 3), jnp.float32),
+        "flow": sds((batch_size, H, W, 2), jnp.float32),
+        "valid": sds((batch_size, H, W), jnp.float32),
+    }
+
+
+def abstract_train_step(iters: int = 2, donate: bool = False,
+                        add_noise: bool = False,
+                        overrides: Dict[str, Any] = None,
+                        batch_size: int = 2,
+                        hw: Tuple[int, int] = (64, 64),
+                        gamma: float = 0.8, max_flow: float = 400.0):
+    """The real jitted train step over abstract inputs: the lowerable
+    entry point the static-analysis engines audit (jaxpr invariants,
+    HLO collective/cost budgets) instead of reaching into private
+    helpers.  Everything is abstract — ``jax.eval_shape`` builds the
+    train state, the batch is ShapeDtypeStructs — so calling this never
+    allocates or computes.
+
+    Returns ``(step, (state_sds, batch_sds))`` where ``step`` is the
+    jit-wrapped train step (supports ``.lower()``) and the args are the
+    abstract example inputs to lower it with.  ``overrides`` feeds
+    RAFTConfig (e.g. ``{"small": True}`` for compile-cost-sensitive
+    audits, bf16 policy dtypes for the mixed-precision audit).
+    """
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.training.optim import make_optimizer
+    from raft_tpu.training.state import create_train_state
+
+    model = RAFT(RAFTConfig(**(overrides or {})))
+    tx, _ = make_optimizer(lr=4e-4, num_steps=100, wdecay=1e-4)
+    batch_sds = tiny_abstract_batch(batch_size, hw)
+    state_sds = jax.eval_shape(
+        lambda rng, b: create_train_state(model, tx, rng, b, iters=iters),
+        jax.random.PRNGKey(0), batch_sds)
+    step = make_train_step(model, iters=iters, gamma=gamma,
+                           max_flow=max_flow, donate=donate,
+                           add_noise=add_noise)
+    return step, (state_sds, batch_sds)
+
+
 def optax_global_norm(tree) -> jax.Array:
     leaves = jax.tree.leaves(tree)
     return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))

@@ -16,6 +16,39 @@ import textwrap
 
 import pytest
 
+
+def _cpu_multiprocess_collectives_supported() -> bool:
+    """Capability probe: can this jaxlib run cross-process computations
+    on the CPU backend?
+
+    Needs (a) the in-tree gloo TCP collectives bindings and (b) the jax
+    config knob that wires them into the CPU client at backend creation
+    (``parallel/dist.py`` sets it inside ``initialize_distributed``).
+    Without either, the workers die with "Multiprocess computations
+    aren't implemented on the CPU backend" — a toolchain gap, not a
+    repo regression, so the suite skips instead of failing.
+    """
+    try:
+        from jax._src.lib import xla_extension as xe
+    except ImportError:
+        return False
+    if not hasattr(xe, "make_gloo_tcp_collectives"):
+        return False
+    import jax
+
+    # registered config knobs live in jax.config.values (the attribute
+    # view is incomplete on 0.4.x); newer jax exposes it as an attribute
+    return ("jax_cpu_collectives_implementation" in getattr(
+        jax.config, "values", {})
+        or hasattr(jax.config, "jax_cpu_collectives_implementation"))
+
+
+requires_cpu_multiprocess = pytest.mark.skipif(
+    not _cpu_multiprocess_collectives_supported(),
+    reason="jaxlib lacks multiprocess CPU collectives (no gloo bindings "
+           "or no jax_cpu_collectives_implementation config)")
+
+
 WORKER = textwrap.dedent("""
     import os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -125,6 +158,7 @@ TRAIN_WORKER = textwrap.dedent("""
 
 
 @pytest.mark.slow
+@requires_cpu_multiprocess
 def test_two_process_train_step_matches_single_process(tmp_path):
     """The full multi-host data plane, executed for real: two OS
     processes train over the distributed loader — disjoint sample
@@ -192,6 +226,7 @@ def test_two_process_train_step_matches_single_process(tmp_path):
     assert (seen0 | seen1) == seen_oracle
 
 
+@requires_cpu_multiprocess
 def test_two_process_collective(tmp_path):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))

@@ -1,24 +1,30 @@
-"""graftlint: one minimal failing fixture per lint rule and per jaxpr
-invariant, plus the repo-wide clean-run gates (both engines must pass
-over the tree as committed — this is the tier-1 lint lane).
+"""graftlint: one minimal failing fixture per lint rule, per jaxpr
+invariant and per HLO-audit rule, plus the repo-wide clean-run gates
+(all three engines must pass over the tree as committed — this is the
+tier-1 lint lane).
 
 Everything here is CPU-only and fast-lane (no ``slow`` marker): the AST
 fixtures are string literals, the jaxpr fixtures are tiny abstract
-traces, and the repo gates reuse one audit run via module-scoped
-fixtures.
+traces, the HLO parser/budget fixtures are pure text/dicts, and the
+repo gates reuse one audit run per engine via module-scoped fixtures
+(the HLO gate is the only one that compiles — ~1 min, the engine's
+whole cost).
 """
 
 from __future__ import annotations
 
+import json
 import textwrap
 
 import jax
 import jax.numpy as jnp
 import pytest
 
+from raft_tpu.analysis import budgets as bmod
 from raft_tpu.analysis import findings as fmod
-from raft_tpu.analysis.lint import lint_source, run_lint
+from raft_tpu.analysis import hlo_audit as ha
 from raft_tpu.analysis import jaxpr_audit as ja
+from raft_tpu.analysis.lint import lint_source, run_lint
 
 
 def _rules(src: str, path: str = "fixture.py"):
@@ -377,6 +383,363 @@ def test_jaxpr_report_donation_and_presets(audit_results):
     # mixed presets must not silently collapse into their f32 twins
     groups = {tuple(g) for g in map(tuple, rk["signature_groups"])}
     assert not any("chairs" in g and "chairs_mixed" in g for g in groups)
+
+
+# --------------------------------------------------------------------------
+# hlo engine: pure parser/budget fixtures (no compiles)
+# --------------------------------------------------------------------------
+
+HLO_FIXTURE = textwrap.dedent("""\
+    HloModule jit_step
+
+    %fused_computation (p.0: f32[4]) -> f32[4] {
+      %p.0 = f32[4]{0} parameter(0)
+      %c.1 = bf16[4]{0} convert(f32[4]{0} %p.0)
+      ROOT %c.2 = f32[4]{0} convert(bf16[4]{0} %c.1)
+    }
+
+    ENTRY %main (a.1: f32[16], b.2: f32[8]) -> (f32[16], f32[8]) {
+      %a.1 = f32[16]{0} parameter(0)
+      %b.2 = f32[8]{0} parameter(1)
+      %ar = (f32[16]{0}, f32[8]{0}) all-reduce(f32[16]{0} %a.1, f32[8]{0} %b.2), replica_groups={}
+      %ag.3 = f32[32]{0} all-gather(f32[16]{0} %a.1), dimensions={0}
+      %cp.4 = f32[16]{0} collective-permute(f32[16]{0} %a.1), source_target_pairs={{0,1}}
+      %copy.5 = f32[16]{0} copy(f32[16]{0} %a.1)
+      %f.6 = f32[4]{0} fusion(f32[4]{0} %a.1), kind=kLoop, calls=%fused_computation
+      ROOT %t.7 = (f32[16]{0}, f32[8]{0}) tuple(f32[16]{0} %a.1, f32[8]{0} %b.2)
+    }
+""")
+
+
+def test_hlo_op_counts_including_tuple_typed_collectives():
+    counts = ha.hlo_op_counts(HLO_FIXTURE)
+    # the tuple-typed (combined) all-reduce MUST be counted: combined
+    # gradient all-reduces are exactly what the collective audit pins
+    assert counts["all-reduce"] == 1
+    assert counts["all-gather"] == 1
+    assert counts["collective-permute"] == 1
+    assert counts["copy"] == 1
+    assert counts["convert"] == 2          # fusion bodies included
+    assert counts["parameter"] == 3
+    assert ha.collective_counts(counts) == {
+        "all-reduce": 1, "all-gather": 1, "collective-permute": 1}
+
+
+def test_convert_churn_counts_f32_bf16_pairs():
+    total, pairs = ha.convert_churn(HLO_FIXTURE)
+    assert (total, pairs) == (2, 2)
+    t2, p2 = ha.convert_churn(
+        "%c = s32[4]{0} convert(u32[4]{0} %x)\n")
+    assert (t2, p2) == (1, 0)
+
+
+def _measured(**overrides):
+    base = dict(flops=1e6, bytes_accessed=2e6, argument_bytes=1e4,
+                output_bytes=1e4, temp_bytes=5e4,
+                collectives={"all-reduce": 4}, aliases=10,
+                convert_ops=20, convert_f32_bf16=0, copy_ops=8)
+    base.update(overrides)
+    return base
+
+
+@pytest.fixture()
+def ledger_file(tmp_path):
+    path = tmp_path / "budgets.json"
+    bmod.save_budgets(str(path), {"platform": "cpu", "jax": jax.__version__,
+                                  "opt_level": "1", "tolerance": 0.25},
+                      {"e": _measured()})
+    return str(path)
+
+
+def test_budget_compare_clean_and_drift(ledger_file):
+    budget = bmod.load_budgets(ledger_file)["entries"]["e"]
+    assert bmod.compare_entry("e", budget, _measured(), ledger_file) == []
+    # within tolerance: clean
+    assert bmod.compare_entry("e", budget, _measured(flops=1.2e6),
+                              ledger_file) == []
+    out = bmod.compare_entry("e", budget, _measured(flops=2e6), ledger_file)
+    (f,) = [x for x in out if x.rule == "budget-drift"]
+    assert not f.waived and f.severity == "error"
+    # attributed to the exact ledger line of the drifted metric
+    assert f.path == ledger_file
+    with open(ledger_file) as fh:
+        assert '"flops"' in fh.readlines()[f.line - 1]
+
+
+def test_budget_compare_collectives_exact(ledger_file):
+    budget = bmod.load_budgets(ledger_file)["entries"]["e"]
+    # growth → unexpected-collective, anchored at the builder, not the
+    # ledger
+    out = bmod.compare_entry(
+        "e", budget, _measured(collectives={"all-reduce": 4,
+                                            "all-gather": 2}),
+        ledger_file, anchor=("raft_tpu/parallel/step.py", 42))
+    (f,) = [x for x in out if x.rule == "unexpected-collective"]
+    assert (f.path, f.line) == ("raft_tpu/parallel/step.py", 42)
+    assert f.data == {"entry": "e", "kind": "all-gather", "got": 2,
+                      "want": 0}
+    # shrink → collective-set (ledger went stale the other way)
+    out = bmod.compare_entry("e", budget,
+                             _measured(collectives={"all-reduce": 2}),
+                             ledger_file)
+    assert [x.rule for x in out] == ["collective-set"]
+
+
+def test_budget_compare_aliases_and_bounds(ledger_file):
+    budget = bmod.load_budgets(ledger_file)["entries"]["e"]
+    out = bmod.compare_entry("e", budget, _measured(aliases=3), ledger_file)
+    assert [x.rule for x in out] == ["donation"]
+    # aliases may grow freely
+    assert bmod.compare_entry("e", budget, _measured(aliases=12),
+                              ledger_file) == []
+    out = bmod.compare_entry("e", budget, _measured(convert_ops=30),
+                             ledger_file)
+    assert [x.rule for x in out] == ["convert-churn"]
+    # improvements never gate; big ones suggest tightening via a note
+    out = bmod.compare_entry("e", budget, _measured(convert_ops=4),
+                             ledger_file)
+    assert [(x.rule, x.severity) for x in out] == [("budget-slack", "note")]
+
+
+def test_budget_compare_missing_entry_and_nonstrict(ledger_file):
+    (f,) = bmod.compare_entry("other", None, _measured(), ledger_file)
+    assert f.rule == "budget-missing" and f.severity == "error"
+    # environment mismatch demotes everything to notes
+    budget = bmod.load_budgets(ledger_file)["entries"]["e"]
+    out = bmod.compare_entry("e", budget, _measured(flops=9e6),
+                             ledger_file, strict=False)
+    assert out and all(x.severity == "note" for x in out)
+
+
+def test_budgets_ledger_checked_in():
+    """budgets.json ships with the repo, matches this environment, and
+    covers every budgeted default entry (regenerate ONLY via
+    --update-budgets)."""
+    payload = bmod.load_budgets()
+    assert payload is not None, \
+        "raft_tpu/analysis/budgets.json must be checked in"
+    for name, entry in ha.ENTRIES.items():
+        if entry.budgeted:
+            assert name in payload["entries"], \
+                f"ledger lacks entry '{name}' — run --update-budgets"
+    assert payload["meta"]["opt_level"] == \
+        ha.COMPILER_OPTIONS["xla_backend_optimization_level"]
+    # fixtures must never be baselined
+    assert not set(ha.FIXTURE_ENTRIES) & set(payload["entries"])
+
+
+# --------------------------------------------------------------------------
+# hlo engine: repo-wide compile gate + seeded regression fixtures
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def hlo_results():
+    if jax.device_count() < 8:
+        pytest.skip("hlo audit gate needs the 8-device CPU harness")
+    return ha.run_hlo_audit()
+
+
+def test_hlo_gate_repo_clean(hlo_results):
+    findings, _ = hlo_results
+    gating = fmod.gate(findings)
+    assert gating == [], "\n" + "\n".join(f.render() for f in gating)
+    assert all(f.waiver_reason for f in findings if f.waived)
+
+
+def test_hlo_report_collective_profiles(hlo_results):
+    _, report = hlo_results
+    # the sharded step all-reduces gradients; the ring path permutes;
+    # single-device programs stay silent
+    assert report["parallel_step"]["collectives"].get("all-reduce", 0) > 0
+    assert report["corr_ring"]["collectives"].get(
+        "collective-permute", 0) > 0
+    assert report["eval_forward"]["collectives"] == {}
+    assert report["train_step"]["collectives"] == {}
+    # donation shows as aliases; the bf16 forward actually crosses the
+    # f32<->bf16 boundary (the churn bound is not vacuous)
+    assert report["train_step"]["aliases"] > 0
+    assert report["eval_forward_bf16"]["convert_f32_bf16"] > 0
+
+
+def test_seeded_missharded_step_trips_all_gather(capsys):
+    """Seeded regression 1: a deliberately mis-sharded entry (sharded
+    batch, forgotten out-sharding) must exit 1 with a file:line-
+    attributed unexpected-collective finding."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU harness")
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--engine", "hlo", "--audits", "seeded_missharded",
+               "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    (f,) = [f for f in payload["findings"]
+            if f["rule"] == "unexpected-collective"]
+    assert f["data"]["kind"].startswith("all-gather")
+    assert f["path"].endswith("hlo_audit.py") and f["line"] > 0
+
+
+def test_structurally_broken_entry_is_not_baselinable(tmp_path,
+                                                      monkeypatch):
+    """--update-budgets must refuse to launder a structural regression
+    into the ledger: a BUDGETED entry with structural findings keeps its
+    old record (reported under skipped_broken) and the findings still
+    gate."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU harness")
+    import dataclasses
+
+    budgeted_broken = dataclasses.replace(
+        ha.FIXTURE_ENTRIES["seeded_missharded"], budgeted=True)
+    monkeypatch.setitem(ha.FIXTURE_ENTRIES, "seeded_missharded",
+                        budgeted_broken)
+    ledger = tmp_path / "budgets.json"
+    ledger.write_text(json.dumps(bmod.load_budgets(), indent=2))
+    before = ledger.read_text()
+    findings, report = ha.run_hlo_audit(
+        ["seeded_missharded"], budgets_path=str(ledger), update=True)
+    assert any(f.rule == "unexpected-collective" for f in fmod.gate(findings))
+    assert report["budgets_written"]["skipped_broken"] == \
+        ["seeded_missharded"]
+    assert ledger.read_text() == before
+
+
+def test_partial_rebaseline_refused_across_toolchains(tmp_path):
+    """A partial --update-budgets under a changed toolchain must refuse
+    instead of stamping the new meta onto old-environment records."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU harness")
+    payload = bmod.load_budgets()
+    payload["meta"]["jax"] = "0.0.1"          # baselined "elsewhere"
+    ledger = tmp_path / "budgets.json"
+    ledger.write_text(json.dumps(payload, indent=2))
+    before = ledger.read_text()
+    findings, report = ha.run_hlo_audit(
+        ["corr_lookup_dense"], budgets_path=str(ledger), update=True)
+    assert any(f.rule == "budget-meta" for f in fmod.gate(findings))
+    assert report["budgets_written"]["entries"] == []
+    assert ledger.read_text() == before
+    # once no stale budgeted entries remain (here: a ledger holding only
+    # the measured entry), the same partial update IS sanctioned and
+    # re-stamps the meta
+    payload["entries"] = {
+        "corr_lookup_dense": payload["entries"]["corr_lookup_dense"]}
+    ledger.write_text(json.dumps(payload, indent=2))
+    findings, report = ha.run_hlo_audit(
+        ["corr_lookup_dense"], budgets_path=str(ledger), update=True)
+    assert fmod.gate(findings) == []
+    assert report["budgets_written"]["entries"] == ["corr_lookup_dense"]
+    assert json.loads(ledger.read_text())["meta"]["jax"] == jax.__version__
+
+
+def test_seeded_budget_perturbation_trips_drift(tmp_path, capsys):
+    """Seeded regression 2: an inflated ledger value must exit 1 with a
+    budget-drift finding pointing at the perturbed ledger line."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU harness")
+    from raft_tpu.analysis.__main__ import main
+
+    payload = bmod.load_budgets()
+    payload["entries"]["corr_lookup_dense"]["flops"] *= 3
+    bad = tmp_path / "budgets.json"
+    bad.write_text(json.dumps(payload, indent=2))
+    rc = main(["--engine", "hlo", "--audits", "corr_lookup_dense",
+               "--budgets", str(bad), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    drifts = [f for f in out["findings"] if f["rule"] == "budget-drift"]
+    assert drifts, out["findings"]
+    assert drifts[0]["path"] == str(bad) and drifts[0]["line"] > 0
+    with open(bad) as fh:
+        assert '"flops"' in fh.readlines()[drifts[0]["line"] - 1]
+
+
+def test_update_budgets_rebaseline_workflow(tmp_path, capsys):
+    """--update-budgets heals a drifted ledger by merge (untouched
+    entries survive) and the very next comparison run is clean."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU harness")
+    from raft_tpu.analysis.__main__ import main
+
+    payload = bmod.load_budgets()
+    payload["entries"]["corr_lookup_dense"]["flops"] *= 3
+    bad = tmp_path / "budgets.json"
+    bad.write_text(json.dumps(payload, indent=2))
+    rc = main(["--engine", "hlo", "--audits", "corr_lookup_dense",
+               "--update-budgets", "--budgets", str(bad)])
+    capsys.readouterr()
+    assert rc == 0
+    healed = json.loads(bad.read_text())
+    assert healed["entries"]["corr_lookup_dense"]["flops"] == \
+        bmod.load_budgets()["entries"]["corr_lookup_dense"]["flops"]
+    assert "train_step" in healed["entries"]      # merge, not overwrite
+    rc = main(["--engine", "hlo", "--audits", "corr_lookup_dense",
+               "--budgets", str(bad)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+# --------------------------------------------------------------------------
+# CLI contract: exit codes pinned, --json round-trips, --list-waivers
+# --------------------------------------------------------------------------
+
+def test_cli_usage_errors_exit_2():
+    from raft_tpu.analysis.__main__ import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "bogus"])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "lint", "--update-budgets"])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "hlo", "--audits", "no_such_entry"])
+    assert e.value.code == 2
+    # a typo'd audit name must never be a silently green zero-audit run
+    # on ANY engine
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "jaxpr", "--audits", "no_such_audit"])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "all", "--audits", "no_such_audit"])
+    assert e.value.code == 2
+    # --update-budgets that could not write anything must refuse, not
+    # silently no-op ('donation' is a jaxpr audit; no hlo entry named)
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "all", "--audits", "donation",
+              "--update-budgets"])
+    assert e.value.code == 2
+
+
+def test_cli_json_schema_roundtrips_through_findings(tmp_path, capsys):
+    from raft_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import numpy as np\n"
+                   "x = np.float64(0)\n"
+                   "y = np.zeros(3, np.float64)"
+                   "  # graftlint: disable=f64-literal -- fixture\n")
+    rc = main(["--engine", "lint", "--json", str(bad)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    rebuilt = [fmod.Finding(**f) for f in payload["findings"]]
+    assert len(rebuilt) >= 2
+    assert len(fmod.gate(rebuilt)) == payload["gate"] == 1
+    assert {f.engine for f in rebuilt} == {"lint"}
+    waived = [f for f in rebuilt if f.waived]
+    assert waived and waived[0].waiver_reason == "fixture"
+
+
+def test_cli_list_waivers(capsys):
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--list-waivers"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # the sanctioned tree waivers, each with file:line and reason
+    assert "frame_utils.py" in out and "u16" in out
+    assert "jaxpr_audit.py" in out and "optax/" in out
+    assert "STALE" not in out, out
 
 
 def test_lint_lane_is_jax_free():
