@@ -302,6 +302,24 @@ def main():
         cfg = dataclasses.replace(cfg, deferred_corr_grad=False)
         step, state, flops_per_step = build(cfg)
 
+    # Telemetry: spans + optional run ledger (RAFT_BENCH_LEDGER=path).
+    # The ledger is written OUTSIDE the bulk timing loop, so the headline
+    # number is untouched; render it with python -m raft_tpu.obs report.
+    from raft_tpu.obs import HealthMonitor, RunLedger, SpanRecorder
+    from raft_tpu.obs.spans import NULL as NULL_SPANS
+    from raft_tpu.training.profiler import StepTimer
+
+    ledger = None
+    spans = NULL_SPANS
+    ledger_path = os.environ.get("RAFT_BENCH_LEDGER", "")
+    if ledger_path:
+        ledger = RunLedger(ledger_path, meta={
+            "entry": "bench", "batch_size": B, "image_size": [H, W],
+            "iters": iters, "backend": platform,
+            "devices": jax.device_count(),
+        })
+    health = HealthMonitor(ledger=ledger)
+
     n_steps = 2 if tiny else 10
     t0 = time.perf_counter()
     for _ in range(n_steps):
@@ -312,6 +330,27 @@ def main():
     pairs_per_s = B * n_steps / dt
     peak = _peak_flops(jax.devices()[0])
     mfu = (flops_per_step * n_steps / dt / peak) if peak else 0.0
+
+    # Percentile lane: per-step-synced timing so the tail (recompiles,
+    # host stalls) is visible — mean-only throughput hides it.  Separate
+    # from the bulk loop above because the per-step sync serializes the
+    # dispatch pipeline: `value` stays the pipelined device rate.
+    # The span recorder is created HERE so its first window opens after
+    # the uninstrumented bulk loop — anchoring it earlier would dump the
+    # whole bulk loop into the report's 'other' bucket.
+    if ledger is not None:
+        spans = SpanRecorder(ledger=ledger)
+    timer = StepTimer(warmup=1)
+    timer.tick()
+    for _ in range(4 if tiny else 12):
+        with spans.span("dispatch"):
+            state, metrics = step(state, batch)
+        with spans.span("block"):
+            timer.tick(metrics)
+        spans.step_boundary()
+    step_pct = timer.summary()
+    health.sample_memory(n_steps)
+    spans.flush(n_steps)
 
     # Fed variant: identical step, batches produced by the host pipeline.
     fed_pairs_per_s = 0.0
@@ -329,14 +368,24 @@ def main():
         n_fed = 2 if tiny else 30
         t0 = time.perf_counter()
         for _ in range(n_fed):
-            state, metrics = step(state, next(it))
+            with spans.span("data"):
+                fed_batch = next(it)
+            with spans.span("dispatch"):
+                state, metrics = step(state, fed_batch)
+            spans.step_boundary()
         float(metrics["loss"])
         fed_pairs_per_s = B * n_fed / (time.perf_counter() - t0)
+        spans.flush(n_fed)
         it.close()  # join the loader's worker pool cleanly (an abandoned
         # generator otherwise tears down its executor at interpreter
         # exit, after threading internals are gone)
     except Exception as e:  # the fed lane must never sink the scoreboard
         print(f"fed bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+
+    if ledger is not None:
+        ledger.close(summary=health.summary()
+                     | {"pairs_per_s": round(pairs_per_s, 3),
+                        "fed_pairs_per_s": round(fed_pairs_per_s, 3)})
 
     print(json.dumps({
         "metric": "image-pairs/sec/chip",
@@ -344,6 +393,10 @@ def main():
         "unit": "pairs/s",
         "vs_baseline": round(pairs_per_s / A100_BASELINE_PAIRS_PER_S, 3),
         "mfu": round(mfu, 4),
+        # per-step-synced step-time tail (ms): the percentile lane above,
+        # NOT the pipelined loop `value` is computed from
+        "step_ms": {k: round(1000 * step_pct[k], 2)
+                    for k in ("p50", "p95", "max")},
         "fed_pairs_per_s": round(fed_pairs_per_s, 3),
         "host_cores": os.cpu_count(),
         "deferred_corr_grad": deferred,

@@ -11,6 +11,16 @@ exception, nor logs anything.  These erased real failures twice in this
 repo's history (a missing compiler surfacing as "native decoders silently
 absent").  Narrow the type to what the call can actually raise, or log
 the reason; genuinely-intentional swallows carry an inline waiver.
+
+``bare-print`` — a ``print(`` call in ``raft_tpu`` *library* code.
+Telemetry must flow through the obs bus (raft_tpu/obs: the metrics bus,
+run ledger, span recorder), where it is windowed, machine-readable and
+attributable — a stray print is telemetry that evaporates at the
+console.  CLI surfaces are exempt by construction: anything under
+``raft_tpu/cli/`` or ``raft_tpu/analysis/`` (its findings renderer IS a
+console product), and any ``__main__.py`` (a ``python -m`` entry point
+by definition).  Sanctioned console-parity lines (the Logger status
+line, the reference's validation EPE prints) carry inline waivers.
 """
 
 from __future__ import annotations
@@ -103,5 +113,63 @@ class SilentExceptRule(LintRule):
         return False
 
 
+_PRINT_EXEMPT_DIRS = {"cli", "analysis"}
+
+
+def _library_relpath(path: str):
+    """The path inside the raft_tpu package, or None when ``path`` is not
+    library code (repo-root scripts, bench.py, tests, fixtures).
+
+    Real files are anchored on the imported package's own directory — a
+    checkout whose ROOT directory happens to be named ``raft_tpu`` must
+    not drag scripts/ and bench.py into library scope.  Paths that do
+    not exist on disk (lint fixtures) fall back to the lexical rule:
+    everything after the last ``raft_tpu`` path component.
+    """
+    import os
+
+    import raft_tpu
+
+    pkg_dir = os.path.dirname(os.path.abspath(raft_tpu.__file__))
+    abspath = os.path.abspath(path)
+    if abspath.startswith(pkg_dir + os.sep):
+        sub = os.path.relpath(abspath, pkg_dir).replace("\\", "/")
+        return sub.split("/")
+    if os.path.exists(abspath):
+        return None                 # a real file outside the package
+    parts = path.replace("\\", "/").split("/")
+    if "raft_tpu" not in parts:
+        return None
+    sub = parts[len(parts) - 1 - parts[::-1].index("raft_tpu") + 1:]
+    return sub or None
+
+
+class BarePrintRule(LintRule):
+    rule_id = "bare-print"
+    description = ("print() in raft_tpu library code — telemetry must "
+                   "flow through the obs bus (cli/, analysis/ and "
+                   "__main__.py entry points exempt)")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        sub = _library_relpath(ctx.path)
+        if sub is None or sub[0] in _PRINT_EXEMPT_DIRS \
+                or sub[-1] == "__main__.py":
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "print":
+                out.append(self.finding(
+                    ctx, node,
+                    "bare print() in library code — route metrics/spans/"
+                    "incidents through raft_tpu.obs (bus, ledger) so they "
+                    "are windowed and machine-readable; a sanctioned "
+                    "console-parity or degradation-diagnostic line needs "
+                    "an inline waiver saying so"))
+        return out
+
+
 register(DebugPrintRule())
 register(SilentExceptRule())
+register(BarePrintRule())

@@ -175,7 +175,7 @@ def host_local_to_global(batch: Dict, sharding) -> Dict:
     return out
 
 
-def prefetch_to_device(iterator, size: int = 2, sharding=None):
+def prefetch_to_device(iterator, size: int = 2, sharding=None, spans=None):
     """Move batches to device ahead of compute.
 
     With ``sharding`` (a jax.sharding.Sharding), batches land already laid
@@ -184,9 +184,19 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
     process's LOCAL batch slices (DataLoader(process_index=...,
     process_count=...)), which are assembled into global arrays — every
     process feeds only the devices it owns.
+
+    ``spans`` (an obs.SpanRecorder) attributes each device_put to the
+    ``h2d`` phase.  device_put is asynchronous, so the span measures
+    transfer *dispatch*; a bytes-limited link shows up here only when
+    the transfer queue backs up — the steady-state symptom of a starved
+    link is ``data`` time (this generator blocking on the host
+    pipeline), which the caller's span sees.
     """
     import jax
 
+    from raft_tpu.obs.spans import NULL
+
+    spans = spans if spans is not None else NULL
     queue = collections.deque()
     multihost = jax.process_count() > 1
     if multihost and sharding is None:
@@ -208,7 +218,8 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
         return placed
 
     for batch in iterator:
-        queue.append(_put(batch))
+        with spans.span("h2d"):
+            queue.append(_put(batch))
         if len(queue) >= size:
             yield queue.popleft()
     while queue:

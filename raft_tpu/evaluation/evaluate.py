@@ -34,12 +34,22 @@ class Evaluator:
     would otherwise hold every compiled executable forever.  Evictions are
     reported on stderr so a shape-thrashing workload is visible instead of
     silently slow.
+
+    ``spans`` (an obs.SpanRecorder) attributes each forward to the
+    ``dispatch`` phase, so an eval pass driven with a recorder shows up
+    in the same stall-attribution report as training — a cache-missing
+    shape's compile lands inside its first dispatch span, which is
+    exactly how shape thrash becomes visible in a ledger.
     """
 
-    def __init__(self, model, variables, max_cached_shapes: int = 16):
+    def __init__(self, model, variables, max_cached_shapes: int = 16,
+                 spans=None):
+        from raft_tpu.obs.spans import NULL
+
         self.model = model
         self.variables = variables
         self.max_cached_shapes = max_cached_shapes
+        self.spans = spans if spans is not None else NULL
         import collections
         self._cache = collections.OrderedDict()
 
@@ -59,6 +69,8 @@ class Evaluator:
             if len(self._cache) >= self.max_cached_shapes:
                 import sys
                 old_key, _ = self._cache.popitem(last=False)
+                # graftlint: disable=bare-print -- shape-thrash
+                # diagnostic to stderr; the Evaluator takes no ledger
                 print(f"Evaluator: evicting compiled shape {old_key} "
                       f"(cache limit {self.max_cached_shapes}; heterogeneous "
                       f"frame sizes recompile per shape — consider padding "
@@ -66,9 +78,10 @@ class Evaluator:
             self._cache[key] = fn
         else:
             self._cache.move_to_end(key)
-        if warm:
-            return fn(self.variables, image1, image2, flow_init)
-        return fn(self.variables, image1, image2)
+        with self.spans.span("dispatch"):
+            if warm:
+                return fn(self.variables, image1, image2, flow_init)
+            return fn(self.variables, image1, image2)
 
 
 def abstract_eval_forward(iters: int = 2, hw=(64, 64),
@@ -111,6 +124,8 @@ def validate_synthetic(evaluator: Evaluator, root: str = "datasets",
         epe = np.sqrt(((np.asarray(flow_up)[0] - s["flow"]) ** 2).sum(-1))
         epes.append(epe[s["valid"] > 0.5].reshape(-1))
     epe = float(np.concatenate(epes).mean())
+    # graftlint: disable=bare-print -- reference console parity
+    # (evaluate.py:92); results also reach Logger.write_dict/the ledger
     print(f"Validation Synthetic EPE: {epe:.3f}")
     return {"synthetic": epe}
 
@@ -130,6 +145,8 @@ def validate_chairs(evaluator: Evaluator, root: str = "datasets",
         epe = np.sqrt(((np.asarray(flow_up)[0] - s["flow"]) ** 2).sum(-1))
         epes.append(epe.reshape(-1))
     epe = float(np.concatenate(epes).mean())
+    # graftlint: disable=bare-print -- reference console parity
+    # (evaluate.py:92); results also reach Logger.write_dict/the ledger
     print(f"Validation Chairs EPE: {epe:.3f}")
     return {"chairs": epe}
 
@@ -153,6 +170,8 @@ def validate_sintel(evaluator: Evaluator, root: str = "datasets",
             epes.append(epe.reshape(-1))
         epe_all = np.concatenate(epes)
         results[dstype] = float(epe_all.mean())
+        # graftlint: disable=bare-print -- reference console parity
+        # (evaluate.py:126); results also reach Logger.write_dict
         print(f"Validation ({dstype}) EPE: {results[dstype]:.3f}, "
               f"1px: {(epe_all < 1).mean():.3f}, "
               f"3px: {(epe_all < 3).mean():.3f}, "
@@ -183,6 +202,8 @@ def validate_kitti(evaluator: Evaluator, root: str = "datasets",
 
     epe = float(np.mean(epe_list))
     f1 = 100.0 * float(np.concatenate(out_list).mean())
+    # graftlint: disable=bare-print -- reference console parity
+    # (evaluate.py:165); results also reach Logger.write_dict
     print(f"Validation KITTI: EPE {epe:.3f}, F1-all {f1:.2f}")
     return {"kitti-epe": epe, "kitti-f1": f1}
 

@@ -1,0 +1,36 @@
+"""Runtime telemetry: the run ledger, metrics bus, phase spans and
+health sentinels.
+
+The compile-time half of observability lives in ``raft_tpu/analysis``
+(graftlint: what the program IS); this package records what a run DID —
+where each step's wall clock went, what the metrics were, when the run
+went unhealthy — into an append-only JSONL ledger that
+``python -m raft_tpu.obs report`` renders.  See docs/ARCHITECTURE.md
+"Observability".
+"""
+
+from raft_tpu.obs.events import RunLedger, SCHEMA_VERSION, read_ledger
+from raft_tpu.obs.health import (HealthMonitor, batch_signature,
+                                 nonfinite_sentinel)
+from raft_tpu.obs.meters import Counter, Gauge, Histogram, MetricsBus
+from raft_tpu.obs.report import build_report, render_report
+from raft_tpu.obs.spans import NULL, PHASES, NullSpanRecorder, SpanRecorder
+
+__all__ = [
+    "RunLedger",
+    "SCHEMA_VERSION",
+    "read_ledger",
+    "HealthMonitor",
+    "batch_signature",
+    "nonfinite_sentinel",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsBus",
+    "build_report",
+    "render_report",
+    "NULL",
+    "PHASES",
+    "NullSpanRecorder",
+    "SpanRecorder",
+]

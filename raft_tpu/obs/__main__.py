@@ -1,0 +1,175 @@
+"""CLI driver: ``python -m raft_tpu.obs report <ledger> [--json]``.
+
+Renders a run ledger (events.py) into throughput percentiles, per-phase
+stall attribution, memory watermarks and health incidents.  Exit codes:
+0 clean, 1 when ``--fail-on-incident`` is set and the ledger holds
+health incidents, 2 on usage errors — same ladder as graftlint.
+
+``python -m raft_tpu.obs --selfcheck`` exercises the whole subsystem
+end-to-end (ledger round-trip, no-premature-sync metering with a
+tripwire scalar, span attribution, NaN sentinel, report build) against
+a synthetic 20-step run in a temp dir, printing PASS/FAIL per property.
+Tier-1 runs it as a smoke (tests/test_obs.py), so a broken telemetry
+stack fails CI even if no training test touches it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def run_report(path: str, as_json: bool, fail_on_incident: bool) -> int:
+    from raft_tpu.obs.events import read_ledger, sanitize_json
+    from raft_tpu.obs.report import build_report, render_report
+
+    try:
+        records = read_ledger(path)
+    except (OSError, ValueError) as e:
+        print(f"obs report: cannot read ledger: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"obs report: {path} holds no records", file=sys.stderr)
+        return 2
+    report = build_report(records)
+    if as_json:
+        # sanitize: _percentiles legitimately produce NaN on empty
+        # windows, and bare NaN tokens are not strict JSON
+        print(json.dumps(sanitize_json(report), indent=2, default=str,
+                         allow_nan=False))
+    else:
+        print(render_report(report))
+    return 1 if (fail_on_incident and report["incidents"]) else 0
+
+
+def run_selfcheck() -> int:
+    """Synthetic end-to-end: every obs component against a canned run."""
+    import math
+    import os
+    import tempfile
+
+    from raft_tpu.obs.events import SCHEMA_VERSION, RunLedger, read_ledger
+    from raft_tpu.obs.health import HealthMonitor
+    from raft_tpu.obs.meters import MetricsBus
+    from raft_tpu.obs.report import build_report, render_report
+    from raft_tpu.obs.spans import SpanRecorder
+
+    class Tripwire:
+        """Device-scalar stand-in that detonates on premature host
+        conversion."""
+
+        def __init__(self, value):
+            self.value = value
+            self.armed = False
+
+        def __float__(self):
+            if not self.armed:
+                raise AssertionError("host conversion before the window "
+                                     "boundary")
+            return float(self.value)
+
+    failures = []
+
+    def check(name, ok):
+        print(f"  {'PASS' if ok else 'FAIL'}  {name}")
+        if not ok:
+            failures.append(name)
+
+    print("obs selfcheck:")
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "events.jsonl")
+        fake_now = [1000.0]
+        ledger = RunLedger(path, meta={"entry": "selfcheck",
+                                       "batch_size": 2},
+                           clock=lambda: fake_now[0])
+        spans = SpanRecorder(ledger=ledger, clock=lambda: fake_now[0],
+                             annotate=False)
+        health = HealthMonitor(ledger=ledger)
+        bus = MetricsBus(window=10, ledger=ledger)
+        bus.add_window_hook(health.on_window)
+
+        live = []
+        for step in range(20):
+            with spans.span("data"):
+                fake_now[0] += 0.003
+            with spans.span("dispatch"):
+                fake_now[0] += 0.006
+                # nested block span: attribution must be exclusive
+                with spans.span("block"):
+                    fake_now[0] += 0.001
+            loss = Tripwire(float("nan") if step == 13 else 0.5)
+            live.append(loss)
+            if (step + 1) % 10 == 0:       # the boundary IS the sync point
+                for t in live:
+                    t.armed = True
+            bus.push({"loss": loss})
+            fake_now[0] += 0.0005
+            spans.step_boundary()
+            if (step + 1) % 10 == 0:
+                spans.flush(bus.step)
+                health.sample_memory(bus.step)
+        health.observe_batch(20, {"x": type("A", (), {
+            "shape": (4, 4), "dtype": "float32"})()})
+        health.observe_batch(21, {"x": type("A", (), {
+            "shape": (8, 8), "dtype": "float32"})()})
+        ledger.close(summary=health.summary())
+
+        records = read_ledger(path)
+        check("ledger round-trip (versioned records)",
+              records and all(r["v"] == SCHEMA_VERSION for r in records))
+        check("no premature host sync (tripwire survived to boundary)",
+              len(bus.history) == 2)
+        report = build_report(records)
+        attr = report["stall_attribution_pct"]
+        check("stall attribution sums to 100%",
+              math.isclose(sum(attr.values()), 100.0, abs_tol=0.1))
+        check("exclusive attribution (dispatch excludes nested block)",
+              attr.get("block", 0) > 0
+              and report["phase_seconds_excl"]["dispatch"] < 20 * 0.0065)
+        # 18 timed steps: the first boundary of each 10-step window only
+        # anchors (flush re-anchors so inter-lane gaps never pollute)
+        pct = report["throughput"]["step_seconds"]
+        check("throughput percentiles over timed steps",
+              pct["n"] == 18 and pct["p50"] > 0 and pct["p95"] >= pct["p50"])
+        kinds = [i["kind"] for i in report["incidents"]]
+        check("NaN sentinel fired exactly once with the offending step",
+              kinds.count("nonfinite-loss") == 1
+              and report["incidents"][0]["step"] == 14)
+        check("recompile sentinel fired on the changed signature",
+              kinds.count("recompile") == 1)
+        check("memory watermark recorded",
+              bool(report["memory_watermarks"]))
+        check("report renders", bool(render_report(report)))
+
+    print(f"obs selfcheck: "
+          f"{'OK' if not failures else f'{len(failures)} failure(s)'}")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "python -m raft_tpu.obs",
+        description="raft_tpu runtime telemetry: render a run ledger")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="exercise the telemetry stack end-to-end against "
+                        "a synthetic run and exit 0/1")
+    sub = p.add_subparsers(dest="cmd")
+    rp = sub.add_parser("report", help="render a run ledger")
+    rp.add_argument("ledger", help="path to an events.jsonl run ledger")
+    rp.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    rp.add_argument("--fail-on-incident", action="store_true",
+                    help="exit 1 when the ledger holds health incidents")
+    args = p.parse_args(argv)
+
+    if args.selfcheck:
+        return run_selfcheck()
+    if args.cmd == "report":
+        return run_report(args.ledger, args.json, args.fail_on_incident)
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

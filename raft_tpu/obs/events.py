@@ -1,0 +1,174 @@
+"""The run ledger: a versioned, append-only JSONL record of one run.
+
+Every entry point (cli/train.py, bench.py, the eval harness) appends
+records here; ``python -m raft_tpu.obs report <ledger>`` turns them back
+into throughput percentiles, stall attribution, memory watermarks and
+health incidents.  This is the runtime half of the observability story —
+the compile-time half is the graftlint budget ledger
+(analysis/budgets.json), which pins what XLA *emits*; this ledger pins
+what the run *did*.
+
+Schema (one JSON object per line; every record carries ``v``,
+``kind``, ``t`` (unix seconds) and ``run``):
+
+==============  ===========================================================
+kind            payload
+==============  ===========================================================
+``run_start``   ``meta`` — free-form run metadata (entry point, config
+                summary, backend, device count, argv)
+``metrics``     ``step`` (last step of the window), ``n`` (window size),
+                ``means`` {name: float} — one record per metrics window
+``spans``       ``step``, ``wall`` (window wall seconds), ``phases``
+                {name: {"excl": s, "incl": s, "n": calls}},
+                ``step_times`` [per-step wall seconds]
+``memory``      ``step``, ``devices`` {device: {bytes_in_use,
+                peak_bytes_in_use, bytes_limit}}, ``host_rss_bytes``
+``incident``    ``incident`` (the incident type, e.g. ``nonfinite-loss``,
+                ``recompile``), ``step``, ``detail`` — health sentinel
+                firings
+``run_end``     ``summary`` — final counters (steps, incidents, ...)
+==============  ===========================================================
+
+Append-only by construction: the file is opened in append mode and
+records are flushed per write, so a preempted/killed run keeps every
+window it completed.  Readers tolerate unknown *kinds* (forward
+compatibility) but refuse a different major schema version.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+RECORD_KINDS = ("run_start", "metrics", "spans", "memory", "incident",
+                "run_end")
+
+
+def sanitize_json(obj):
+    """Strict-JSON form: non-finite floats become the strings "NaN" /
+    "Infinity" / "-Infinity".  Python's json module would happily emit
+    bare NaN tokens — which jq/JS/most strict parsers reject — and a
+    NaN window mean is exactly what the ledger's flagship scenario (a
+    non-finite loss) produces, so the 'machine-readable' surface must
+    not depend on a lenient reader."""
+    if isinstance(obj, float):
+        if obj != obj:
+            return "NaN"
+        if obj == float("inf"):
+            return "Infinity"
+        if obj == float("-inf"):
+            return "-Infinity"
+        return obj
+    if isinstance(obj, dict):
+        return {k: sanitize_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize_json(v) for v in obj]
+    return obj
+
+
+class RunLedger:
+    """Append-only JSONL writer for one run's telemetry records."""
+
+    def __init__(self, path: str, run_id: Optional[str] = None,
+                 meta: Optional[Dict] = None,
+                 clock=time.time):
+        self.path = path
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        self._clock = clock
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+        self.write("run_start", meta=dict(meta or {}))
+
+    def write(self, kind: str, **payload) -> Dict:
+        """Append one record; returns the record as written."""
+        if self._fh is None:
+            raise ValueError(f"ledger {self.path} is closed")
+        rec = {"v": SCHEMA_VERSION, "kind": kind,
+               "t": round(float(self._clock()), 6), "run": self.run_id}
+        rec.update(payload)
+        rec = sanitize_json(rec)
+        self._fh.write(json.dumps(rec, sort_keys=True, allow_nan=False)
+                       + "\n")
+        self._fh.flush()
+        return rec
+
+    # -- convenience writers (one per schema kind) --------------------------
+
+    def metrics(self, step: int, n: int, means: Dict[str, float]) -> Dict:
+        return self.write("metrics", step=int(step), n=int(n),
+                          means={k: float(v) for k, v in means.items()})
+
+    def spans(self, step: int, record: Dict) -> Dict:
+        return self.write("spans", step=int(step), **record)
+
+    def memory(self, step: int, devices: Dict,
+               host_rss_bytes: int = 0) -> Dict:
+        return self.write("memory", step=int(step), devices=devices,
+                          host_rss_bytes=int(host_rss_bytes))
+
+    def incident(self, incident: str, step: int, detail: str) -> Dict:
+        # the record kind is "incident"; the incident's own type rides in
+        # the "incident" field (e.g. "nonfinite-loss")
+        return self.write("incident", incident=incident, step=int(step),
+                          detail=detail)
+
+    def run_end(self, summary: Dict) -> Dict:
+        return self.write("run_end", summary=summary)
+
+    def close(self, summary: Optional[Dict] = None) -> None:
+        if self._fh is None:
+            return
+        if summary is not None:
+            self.run_end(summary)
+        self._fh.close()
+        self._fh = None
+
+    def __enter__(self) -> "RunLedger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_ledger(path: str) -> List[Dict]:
+    """Parse a ledger back into records.
+
+    Rejects records from a different major schema version loudly (a
+    silent partial read would feed the report wrong numbers); records of
+    unknown *kind* ride through so newer writers stay readable.  Blank
+    lines and a trailing partial line (killed mid-write) are skipped.
+    """
+    out: List[Dict] = []
+    torn: List[int] = []
+    last_nonblank = 0
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            last_nonblank = lineno
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                torn.append(lineno)
+                continue
+            v = rec.get("v")
+            if v != SCHEMA_VERSION:
+                raise ValueError(
+                    f"{path}:{lineno}: ledger schema v{v} != reader "
+                    f"v{SCHEMA_VERSION}; regenerate the ledger or use a "
+                    f"matching raft_tpu.obs")
+            out.append(rec)
+    # a torn FINAL line is the expected shape of a killed run and is
+    # dropped; a torn line anywhere else means corruption, not preemption
+    interior = [n for n in torn if n != last_nonblank]
+    if interior:
+        raise ValueError(f"{path}: unparseable ledger line(s) {interior} "
+                         f"before end of file — corrupt ledger")
+    return out

@@ -1,0 +1,217 @@
+"""Run-health sentinels: when a run goes unhealthy, say so, with a step.
+
+Three detectors, each designed to add nothing to the step's critical
+path:
+
+- **Non-finite loss/grad** — the train step computes a ``nonfinite``
+  flag *in-graph* from outputs it already produces
+  (:func:`nonfinite_sentinel`, folded into training/step.py's metrics:
+  two ``isfinite`` on existing scalars, no extra pass, no host sync).
+  The monitor inspects it at the window boundary — where the metrics bus
+  has just host-converted the window anyway — and records ONE
+  ``nonfinite-loss`` incident naming the first offending step.  The
+  incident latches: once state is poisoned every later step is
+  non-finite too, and a thousand-line incident log helps nobody; the
+  run-end summary carries the total count.
+- **Recompile storm** — each batch's signature (the leaf shapes/dtypes,
+  i.e. the runtime half of the recompile keys graftlint's
+  ``recompile_keys`` audit reports statically over STAGE_PRESETS) is
+  tracked; a signature never seen before, after the first, means the
+  jitted step just recompiled.  Every distinct new signature records one
+  ``recompile`` incident.
+- **HBM watermarks** — per-window ``device_memory_stats`` snapshots land
+  in the ledger as ``memory`` records (watermark math happens at report
+  time).  Backends without memory stats (CPU, some tunnels) fall back to
+  host RSS so the record — and the report's memory section — never
+  silently vanishes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+
+def nonfinite_sentinel(loss, grad_norm):
+    """The in-graph health flag: 1.0 when loss or grad-norm is not
+    finite.  Called from inside the jitted train step on scalars the
+    step already computed — two isfinite and a logical-and, fused into
+    the existing metrics outputs (no extra pass over params or
+    activations)."""
+    import jax.numpy as jnp
+
+    ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+    return jnp.logical_not(ok).astype(jnp.float32)
+
+
+def batch_signature(batch: Dict) -> Tuple:
+    """The runtime recompile key of one batch: sorted (name, shape,
+    dtype) of every array leaf.  A jitted step retraces exactly when
+    this (or a static arg, which the training loop never varies)
+    changes."""
+    sig = []
+    for k in sorted(batch):
+        v = batch[k]
+        shape = tuple(getattr(v, "shape", ()))
+        dtype = str(getattr(v, "dtype", type(v).__name__))
+        sig.append((k, shape, dtype))
+    return tuple(sig)
+
+
+class HealthMonitor:
+    """Accumulates incidents; wire ``on_window`` into a MetricsBus via
+    ``add_window_hook`` and call ``observe_batch``/``sample_memory``
+    from the loop."""
+
+    def __init__(self, ledger=None, metric: str = "loss"):
+        self._ledger = ledger
+        self.metric = metric
+        self.incidents: List[Dict] = []
+        self._nonfinite_steps = 0
+        self._nonfinite_latched = False
+        self._signatures: set = set()
+        self.memory_watermarks: Dict[str, Dict[str, int]] = {}
+
+    def _record(self, kind: str, step: int, detail: str) -> None:
+        self.incidents.append({"kind": kind, "step": int(step),
+                               "detail": detail})
+        if self._ledger is not None:
+            self._ledger.incident(kind, step, detail)
+
+    # -- non-finite sentinel (window hook) ---------------------------------
+
+    def on_window(self, first_step: int,
+                  per_step: List[Dict[str, float]]) -> None:
+        """MetricsBus window hook: scan the just-converted host values
+        for the first non-finite step.  Prefers the in-graph
+        ``nonfinite`` flag; falls back to isfinite(metric) for metrics
+        dicts that predate the sentinel."""
+        for i, m in enumerate(per_step):
+            flagged = m.get("nonfinite", 0.0) > 0.0
+            value = m.get(self.metric)
+            if not flagged and value is not None:
+                flagged = not math.isfinite(value)
+            if flagged:
+                self._nonfinite_steps += 1
+                if not self._nonfinite_latched:
+                    self._nonfinite_latched = True
+                    # name what actually blew up: the in-graph sentinel
+                    # covers loss AND grad_norm, and a bf16 gradient
+                    # overflow leaves the loss finite — citing a healthy
+                    # loss as the trigger would be self-contradictory
+                    culprits = [
+                        f"{k}={m[k]!r}"
+                        for k in (self.metric, "grad_norm")
+                        if k in m and not math.isfinite(m[k])
+                    ] or ["in-graph sentinel fired"]
+                    self._record(
+                        "nonfinite-loss", first_step + i,
+                        f"{', '.join(culprits)} at step {first_step + i}"
+                        f" — first non-finite step of this run; training "
+                        f"state is poisoned from here (later occurrences "
+                        f"counted in run_end.summary, not re-reported)")
+
+    # -- recompile sentinel ------------------------------------------------
+
+    def observe_batch(self, step: int, batch: Dict) -> bool:
+        """Track the batch's recompile key; returns True (and records a
+        ``recompile`` incident) when a NEW signature appears after the
+        first — i.e. the step function just retraced."""
+        sig = batch_signature(batch)
+        if sig in self._signatures:
+            return False
+        first = not self._signatures
+        self._signatures.add(sig)
+        if first:
+            return False
+        self._record(
+            "recompile", step,
+            f"new batch signature #{len(self._signatures)} at step "
+            f"{step}: {sig} — the jitted step retraced; a varying shape "
+            f"or dtype in the input pipeline causes a recompile storm")
+        return True
+
+    # -- HBM watermarks ----------------------------------------------------
+
+    def sample_memory(self, step: int) -> Dict:
+        """Per-window memory snapshot -> ledger ``memory`` record.
+
+        Device stats where the backend reports them
+        (training/profiler.py device_memory_stats); host RSS fallback
+        otherwise, so CPU dryruns still get a memory section in the
+        report."""
+        from raft_tpu.training.profiler import device_memory_stats
+
+        devices = device_memory_stats()
+        rss = _host_rss_bytes()
+        for name, stats in devices.items():
+            wm = self.memory_watermarks.setdefault(
+                name, {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+                       "bytes_limit": stats.get("bytes_limit", -1)})
+            wm["bytes_in_use"] = max(wm["bytes_in_use"],
+                                     stats.get("bytes_in_use", 0))
+            wm["peak_bytes_in_use"] = max(wm["peak_bytes_in_use"],
+                                          stats.get("peak_bytes_in_use", 0))
+        if not devices:
+            wm = self.memory_watermarks.setdefault(
+                "host", {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+                         "bytes_limit": -1})
+            wm["bytes_in_use"] = max(wm["bytes_in_use"], rss)
+            wm["peak_bytes_in_use"] = max(wm["peak_bytes_in_use"], rss)
+        if self._ledger is not None:
+            self._ledger.memory(step, devices, host_rss_bytes=rss)
+        return {"devices": devices, "host_rss_bytes": rss}
+
+    # -- shutdown ----------------------------------------------------------
+
+    def summary(self) -> Dict:
+        """Counters for the ledger's run_end record."""
+        return {
+            "incidents": len(self.incidents),
+            "nonfinite_steps": self._nonfinite_steps,
+            "batch_signatures": len(self._signatures),
+            "memory_watermarks": self.memory_watermarks,
+        }
+
+
+class NullHealthMonitor:
+    """No-op monitor: the ``--no_obs`` contract is that sentinels cost
+    nothing, so every probe short-circuits (no signature hashing, no
+    memory sampling, no incident accumulation)."""
+
+    incidents: List[Dict] = []
+    memory_watermarks: Dict = {}
+
+    def on_window(self, first_step, per_step) -> None:
+        pass
+
+    def observe_batch(self, step, batch) -> bool:
+        return False
+
+    def sample_memory(self, step) -> Dict:
+        return {}
+
+    def summary(self) -> Dict:
+        return {}
+
+
+NULL = NullHealthMonitor()
+
+
+def _host_rss_bytes() -> int:
+    try:
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux, bytes on macOS
+        scale = 1 if sys.platform == "darwin" else 1024
+        return int(ru.ru_maxrss * scale)
+    except Exception as e:
+        import sys
+
+        # graftlint: disable=bare-print -- degradation diagnostic; the
+        # memory record it annotates still lands in the ledger
+        print(f"obs.health: host RSS unavailable ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return 0

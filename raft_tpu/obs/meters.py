@@ -1,0 +1,176 @@
+"""Counters / gauges / histograms and the windowed metrics bus.
+
+The one discipline everything here enforces: **a push never forces a
+host sync**.  Values pushed inside the step loop may be live device
+scalars (the jitted train step's metrics dict); converting one to a
+Python float blocks the host on that step's execution and drains the
+dispatch pipeline.  So every instrument holds the *objects* it was
+given, untouched, and the single host conversion happens at the window
+boundary — the same ``sum_freq`` cadence the reference's console logger
+already imposed (train.py:112-123).  ``tests/test_obs.py`` proves the
+guarantee with a stub scalar that raises on any conversion attempt
+until the boundary.
+
+:class:`MetricsBus` is the hub: ``push`` accumulates a step's metrics
+dict; at each window boundary it converts once, computes means over the
+*actual* window count, hands the per-step host values to registered
+window hooks (the health monitor inspects them for non-finite losses —
+free, since conversion just happened anyway), fans the means out to
+sinks (console, TensorBoard, the run ledger), and resets.
+``flush(partial=True)`` drains a short final window at shutdown instead
+of dropping it.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable, Dict, List, Optional, Sequence
+
+# sink signature: (last_step_of_window, means, n_steps_in_window)
+Sink = Callable[[int, Dict[str, float], int], None]
+# window-hook signature: (first_step_of_window, per-step host-value dicts)
+WindowHook = Callable[[int, List[Dict[str, float]]], None]
+
+
+class Counter:
+    """Monotonic accumulator; ``inc`` never converts its argument."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._pending: list = []
+        self.total = 0.0
+
+    def inc(self, value=1) -> None:
+        self._pending.append(value)
+
+    def collect(self) -> float:
+        """Host-convert pending increments (the window boundary)."""
+        self.total += sum(float(v) for v in self._pending)
+        self._pending = []
+        return self.total
+
+
+class Gauge:
+    """Last-value-wins instrument; ``set`` never converts its argument."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._pending = None
+        self._has_pending = False
+        self.value = float("nan")
+
+    def set(self, value) -> None:
+        self._pending = value
+        self._has_pending = True
+
+    def collect(self) -> float:
+        if self._has_pending:
+            self.value = float(self._pending)
+            self._pending = None
+            self._has_pending = False
+        return self.value
+
+
+class Histogram:
+    """Fixed-bucket histogram; ``observe`` never converts its argument.
+
+    Buckets are upper edges; one overflow bucket is implicit.  Values are
+    bucketized host-side at ``collect`` time, so observing a device
+    scalar costs nothing until the window boundary.
+    """
+
+    def __init__(self, name: str, buckets: Sequence[float]):
+        if list(buckets) != sorted(buckets) or not buckets:
+            raise ValueError(f"buckets must be sorted and non-empty: "
+                             f"{buckets}")
+        self.name = name
+        self.buckets = [float(b) for b in buckets]
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.n = 0
+        self.sum = 0.0
+        self._pending: list = []
+
+    def observe(self, value) -> None:
+        self._pending.append(value)
+
+    def collect(self) -> List[int]:
+        for v in self._pending:
+            x = float(v)
+            self.counts[bisect.bisect_left(self.buckets, x)] += 1
+            self.n += 1
+            self.sum += x
+        self._pending = []
+        return list(self.counts)
+
+
+class MetricsBus:
+    """Windowed metrics hub: device-scalar pushes in, host records out.
+
+    ``push`` returns the window summary dict when this push closed a
+    window, else None — callers key end-of-window work (span flush,
+    memory sampling) off that without tracking the modulus themselves.
+    """
+
+    def __init__(self, window: int = 100, start_step: int = 0,
+                 ledger=None):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = window
+        self.step = start_step          # total steps pushed (global index)
+        self._pending: List[Dict] = []
+        self._sinks: List[Sink] = []
+        self._hooks: List[WindowHook] = []
+        self._ledger = ledger
+        self.history: List[Dict] = []
+
+    def add_sink(self, sink: Sink) -> None:
+        self._sinks.append(sink)
+
+    def add_window_hook(self, hook: WindowHook) -> None:
+        self._hooks.append(hook)
+
+    def push(self, metrics: Dict) -> Optional[Dict]:
+        """Accumulate one step's metrics (no conversion); flush the
+        window at the ``window`` boundary."""
+        self.step += 1
+        self._pending.append(metrics)
+        if self.step % self.window == 0:
+            return self.flush()
+        return None
+
+    def flush(self, partial: bool = False) -> Optional[Dict]:
+        """Host-convert the pending window and fan it out.
+
+        ``partial=True`` is the shutdown path: drains however many steps
+        are pending (possibly fewer than ``window``), dividing by the
+        ACTUAL count — the reference logger's tail-drop bug
+        (up to sum_freq-1 steps of metrics lost at end of training) is
+        exactly what this parameter exists to fix.
+        """
+        if not self._pending:
+            return None
+        n = len(self._pending)
+        if not partial and n != self.window:
+            # flush() mid-window without partial is a caller bug; divide
+            # correctly anyway rather than corrupting the means
+            partial = True
+        # THE host conversion: one float() per pushed value, once per
+        # window, after every step in the window has been dispatched.
+        per_step = [{k: float(v) for k, v in m.items()}
+                    for m in self._pending]
+        self._pending = []
+        first_step = self.step - n + 1
+        for hook in self._hooks:
+            hook(first_step, per_step)
+        sums: Dict[str, float] = {}
+        for m in per_step:
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + v
+        means = {k: v / n for k, v in sums.items()}
+        for sink in self._sinks:
+            sink(self.step, means, n)
+        if self._ledger is not None:
+            self._ledger.metrics(self.step, n, means)
+        summary = dict(means) | {"step": self.step, "n": n}
+        self.history.append(summary)
+        return summary

@@ -1,0 +1,230 @@
+"""Run-report builder: a ledger in, attribution/percentiles/health out.
+
+``build_report`` aggregates the raw records; ``render_report`` formats
+the human view the ``python -m raft_tpu.obs report`` CLI prints.  Both
+are pure functions over the parsed ledger so tests can golden them
+without a filesystem.
+
+Stall attribution: per-phase **exclusive** seconds over the summed
+window wall clock, plus an ``other`` bucket for loop time no span
+covered — the percentages sum to 100 by construction, so "where does
+the step go" always has a complete answer (a large ``other`` is itself
+a finding: un-instrumented work).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _percentiles(times: Sequence[float]) -> Dict[str, float]:
+    if not times:
+        nan = float("nan")
+        return {"p50": nan, "p95": nan, "max": nan, "mean": nan, "n": 0}
+    # graftlint: disable=f64-literal -- host-side report math over
+    # wall-clock seconds; never reaches a device
+    arr = np.asarray(list(times), dtype=np.float64)
+    return {
+        "p50": float(np.percentile(arr, 50)),
+        "p95": float(np.percentile(arr, 95)),
+        "max": float(arr.max()),
+        "mean": float(arr.mean()),
+        "n": int(arr.size),
+    }
+
+
+def build_report(records: List[Dict]) -> Dict:
+    """Aggregate parsed ledger records into one report dict.
+
+    A ledger file is append-only, so re-running with the same name
+    appends a second run.  Percentiles and attribution blended across
+    unrelated runs describe neither — the report covers the LAST run
+    only, and says how many runs the file holds (``runs``) so the
+    truncation is visible.
+    """
+    run_ids = [r.get("run") for r in records if r.get("kind") == "run_start"]
+    n_runs = len(set(run_ids))
+    if run_ids:
+        records = [r for r in records if r.get("run") == run_ids[-1]]
+
+    meta: Dict = {}
+    metrics_windows = []
+    span_windows = []
+    memory_records = []
+    incidents = []
+    summary: Optional[Dict] = None
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "run_start":
+            meta = rec.get("meta", {})
+        elif kind == "metrics":
+            metrics_windows.append(rec)
+        elif kind == "spans":
+            span_windows.append(rec)
+        elif kind == "memory":
+            memory_records.append(rec)
+        elif kind == "incident":
+            incidents.append(rec)
+        elif kind == "run_end":
+            summary = rec.get("summary")
+
+    # throughput: per-step wall times pooled across span windows
+    step_times: List[float] = []
+    wall = 0.0
+    phase_excl: Dict[str, float] = {}
+    phase_incl: Dict[str, float] = {}
+    for rec in span_windows:
+        step_times.extend(rec.get("step_times", []))
+        wall += rec.get("wall", 0.0)
+        for name, ph in rec.get("phases", {}).items():
+            phase_excl[name] = phase_excl.get(name, 0.0) + ph.get("excl", 0.0)
+            phase_incl[name] = phase_incl.get(name, 0.0) + ph.get("incl", 0.0)
+
+    pct = _percentiles(step_times)
+    batch = meta.get("batch_size")
+    throughput = {
+        "step_seconds": pct,
+        "steps_per_s": (1.0 / pct["p50"]
+                        if pct["n"] and pct["p50"] > 0 else float("nan")),
+    }
+    if batch and pct["n"] and pct["p50"] > 0:
+        throughput["items_per_s_p50"] = batch / pct["p50"]
+        throughput["items_per_s_p95"] = batch / pct["p95"]
+
+    attribution: Dict[str, float] = {}
+    if wall > 0:
+        covered = 0.0
+        for name, secs in phase_excl.items():
+            attribution[name] = 100.0 * secs / wall
+            covered += secs
+        attribution["other"] = max(100.0 * (wall - covered) / wall, 0.0)
+
+    # memory watermarks: max over records, per device (host fallback rides
+    # in as its own row)
+    watermarks: Dict[str, Dict[str, int]] = {}
+    for rec in memory_records:
+        for name, stats in (rec.get("devices") or {}).items():
+            wm = watermarks.setdefault(
+                name, {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+                       "bytes_limit": stats.get("bytes_limit", -1)})
+            wm["bytes_in_use"] = max(wm["bytes_in_use"],
+                                     stats.get("bytes_in_use", 0))
+            wm["peak_bytes_in_use"] = max(wm["peak_bytes_in_use"],
+                                          stats.get("peak_bytes_in_use", 0))
+        if not rec.get("devices") and rec.get("host_rss_bytes"):
+            wm = watermarks.setdefault(
+                "host", {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+                         "bytes_limit": -1})
+            rss = rec["host_rss_bytes"]
+            wm["bytes_in_use"] = max(wm["bytes_in_use"], rss)
+            wm["peak_bytes_in_use"] = max(wm["peak_bytes_in_use"], rss)
+
+    last_means = metrics_windows[-1]["means"] if metrics_windows else {}
+    steps = max([r.get("step", 0) for r in metrics_windows + span_windows]
+                or [0])
+    return {
+        "meta": meta,
+        "runs": n_runs,
+        "steps": steps,
+        "windows": len(metrics_windows),
+        "wall_seconds": round(wall, 6),
+        "throughput": throughput,
+        "stall_attribution_pct": {k: round(v, 2)
+                                  for k, v in attribution.items()},
+        "phase_seconds_excl": {k: round(v, 6)
+                               for k, v in phase_excl.items()},
+        "phase_seconds_incl": {k: round(v, 6)
+                               for k, v in phase_incl.items()},
+        "memory_watermarks": watermarks,
+        "incidents": [{"kind": r.get("incident", "unknown"),
+                       "step": r.get("step"),
+                       "detail": r.get("detail", "")} for r in incidents],
+        "last_window_means": last_means,
+        "run_end_summary": summary,
+    }
+
+
+def _fmt_bytes(n: int) -> str:
+    if n < 0:
+        return "n/a"
+    x = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if x < 1024 or unit == "TiB":
+            return f"{x:.1f} {unit}" if unit != "B" else f"{int(x)} B"
+        x /= 1024
+    return f"{n} B"
+
+
+def _fmt_ms(s: float) -> str:
+    return "n/a" if s != s else f"{1000 * s:.1f} ms"
+
+
+def render_report(report: Dict) -> str:
+    """Human-readable run report."""
+    lines: List[str] = []
+    meta = report["meta"]
+    head = meta.get("entry", "run")
+    extras = [f"{k}={meta[k]}" for k in
+              ("stage", "batch_size", "backend", "devices") if k in meta]
+    lines.append(f"== raft_tpu run report: {head}"
+                 + (f" ({', '.join(extras)})" if extras else " ="))
+    if report["runs"] > 1:
+        lines.append(f"(ledger holds {report['runs']} runs; reporting "
+                     f"the last)")
+    lines.append(f"steps: {report['steps']}  windows: {report['windows']}  "
+                 f"instrumented wall: {report['wall_seconds']:.2f} s")
+
+    pct = report["throughput"]["step_seconds"]
+    lines.append("")
+    lines.append(f"throughput ({pct['n']} timed steps):")
+    lines.append(f"  step time  p50 {_fmt_ms(pct['p50'])}   "
+                 f"p95 {_fmt_ms(pct['p95'])}   max {_fmt_ms(pct['max'])}")
+    if "items_per_s_p50" in report["throughput"]:
+        lines.append(
+            f"  items/s    p50 {report['throughput']['items_per_s_p50']:.2f}"
+            f"   p95 {report['throughput']['items_per_s_p95']:.2f}")
+
+    attr = report["stall_attribution_pct"]
+    if attr:
+        lines.append("")
+        lines.append("stall attribution (% of step wall, exclusive):")
+        total = 0.0
+        for name, v in sorted(attr.items(), key=lambda kv: -kv[1]):
+            secs = report["phase_seconds_excl"].get(name)
+            secs_s = f"{secs:.3f} s" if secs is not None else ""
+            lines.append(f"  {name:<10} {v:6.2f} %  {secs_s}")
+            total += v
+        lines.append(f"  {'total':<10} {total:6.2f} %")
+
+    wms = report["memory_watermarks"]
+    lines.append("")
+    if wms:
+        lines.append("memory watermarks:")
+        for name, wm in wms.items():
+            lines.append(
+                f"  {name}: in_use {_fmt_bytes(wm['bytes_in_use'])}  "
+                f"peak {_fmt_bytes(wm['peak_bytes_in_use'])}  "
+                f"limit {_fmt_bytes(wm.get('bytes_limit', -1))}")
+    else:
+        lines.append("memory watermarks: none recorded")
+
+    lines.append("")
+    incidents = report["incidents"]
+    if incidents:
+        lines.append(f"health incidents: {len(incidents)}")
+        for inc in incidents:
+            lines.append(f"  [{inc['kind']}] step {inc['step']}: "
+                         f"{inc['detail']}")
+    else:
+        lines.append("health incidents: none")
+
+    means = report["last_window_means"]
+    if means:
+        lines.append("")
+        # non-finite means arrive ledger-sanitized as strings ("NaN")
+        lines.append("last metrics window: " + "  ".join(
+            f"{k}={v:.4g}" if isinstance(v, (int, float)) else f"{k}={v}"
+            for k, v in sorted(means.items())))
+    return "\n".join(lines)

@@ -1,0 +1,185 @@
+"""Step-phase spans: where a step's wall-clock goes.
+
+A span names one phase of the host-side step loop.  The canonical
+taxonomy (:data:`PHASES`) splits a training step the way the hardware
+sees it:
+
+- ``data``     — waiting on the host pipeline for the next batch
+- ``h2d``      — host->device transfer dispatch (prefetch_to_device)
+- ``dispatch`` — handing the jitted step to the runtime (NOT device
+  execution: dispatch returns as soon as the computation is enqueued)
+- ``block``    — host blocked on device results (the window-boundary
+  metric conversion, explicit syncs, profiler flushes)
+
+Arbitrary additional names are allowed (eval uses ``dispatch`` for its
+shape-bucketed forward; bench adds none).  Device execution itself never
+appears as a span — it overlaps all of them; attribute it with a
+profiler trace (``--profile_dir`` + scripts/trace_top.py).  What spans
+buy is the complementary host-side truth: when ``data`` dominates the
+step wall time, the TPU is starving and no kernel work will fix it.
+
+Each span body is also wrapped in ``jax.profiler.TraceAnnotation`` (when
+jax is importable), so the SAME phase names land in TensorBoard profile
+traces — one taxonomy across the ledger and the trace viewer.
+
+Attribution is exclusive-time: a parent's ``excl`` excludes enclosed
+child spans, so per-phase exclusive seconds sum to at most the window's
+wall clock and stall attribution can never double-count.  ``incl`` keeps
+the inclusive total for nesting-aware consumers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+PHASES = ("data", "h2d", "dispatch", "block")
+
+
+class NullSpanRecorder:
+    """No-op recorder: the default for optional ``spans=`` parameters, so
+    production call sites pay one attribute lookup when telemetry is
+    off."""
+
+    def span(self, name: str):
+        return contextlib.nullcontext()
+
+    def step_boundary(self) -> Optional[float]:
+        return None
+
+    def reanchor(self) -> None:
+        pass
+
+    def flush(self, step: int) -> Optional[Dict]:
+        return None
+
+
+NULL = NullSpanRecorder()
+
+
+class _Frame:
+    __slots__ = ("name", "t0", "child")
+
+    def __init__(self, name: str, t0: float):
+        self.name = name
+        self.t0 = t0
+        self.child = 0.0
+
+
+class SpanRecorder:
+    """Accumulates per-phase wall time and per-step durations per window.
+
+    ``clock`` is injectable for deterministic tests; ``annotate=False``
+    drops the jax TraceAnnotation wrapping (and the jax import with it —
+    the recorder itself is pure stdlib).
+    """
+
+    def __init__(self, ledger=None, clock=time.perf_counter,
+                 annotate: bool = True):
+        self._ledger = ledger
+        self._clock = clock
+        self._annotate = annotate
+        self._annotation_cls = None     # resolved lazily on first span
+        self._stack: List[_Frame] = []
+        self._window_t0 = clock()
+        self._last_boundary: Optional[float] = None
+        self._phases: Dict[str, Dict[str, float]] = {}
+        self._step_times: List[float] = []
+
+    def _annotation(self, name: str):
+        if not self._annotate:
+            return contextlib.nullcontext()
+        if self._annotation_cls is None:
+            try:
+                import jax
+
+                self._annotation_cls = jax.profiler.TraceAnnotation
+            except Exception as e:  # jax absent/stub: spans still record
+                import sys
+
+                # graftlint: disable=bare-print -- one-time degradation
+                # diagnostic to stderr; no ledger exists to carry it
+                print(f"obs.spans: TraceAnnotation unavailable "
+                      f"({type(e).__name__}); ledger spans only",
+                      file=sys.stderr)
+                self._annotate = False
+                return contextlib.nullcontext()
+        return self._annotation_cls(name)
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        frame = _Frame(name, self._clock())
+        self._stack.append(frame)
+        try:
+            with self._annotation(name):
+                yield
+        finally:
+            self._stack.pop()
+            elapsed = self._clock() - frame.t0
+            if self._stack:
+                self._stack[-1].child += elapsed
+            rec = self._phases.setdefault(
+                name, {"excl": 0.0, "incl": 0.0, "n": 0})
+            rec["excl"] += max(elapsed - frame.child, 0.0)
+            rec["incl"] += elapsed
+            rec["n"] += 1
+
+    def step_boundary(self) -> Optional[float]:
+        """Mark the end of one loop iteration; returns that step's wall
+        seconds (None for the first boundary, which only anchors)."""
+        now = self._clock()
+        dt = None
+        if self._last_boundary is not None:
+            dt = now - self._last_boundary
+            self._step_times.append(dt)
+        self._last_boundary = now
+        return dt
+
+    def window_record(self) -> Dict:
+        """The current window's span summary (without resetting)."""
+        return {
+            "wall": self._clock() - self._window_t0,
+            "phases": {k: {"excl": round(v["excl"], 6),
+                           "incl": round(v["incl"], 6),
+                           "n": int(v["n"])}
+                       for k, v in self._phases.items()},
+            "step_times": [round(t, 6) for t in self._step_times],
+        }
+
+    def reanchor(self) -> None:
+        """Drop the step-boundary anchor so the NEXT boundary only
+        re-anchors.  Call after out-of-band work inside the loop (an
+        in-loop validation pass, a lane switch in bench) — otherwise
+        that gap lands in one step's wall time and corrupts the
+        report's p95/max."""
+        self._last_boundary = None
+
+    def flush(self, step: int) -> Dict:
+        """Write the window's span record to the ledger and reset.
+
+        Also re-anchors the step-boundary clock: whatever happens
+        between instrumented lanes (ledger I/O, memory sampling, the
+        next lane's warmup) must not be booked as one giant step."""
+        record = self.window_record()
+        if self._ledger is not None:
+            self._ledger.spans(step, record)
+        self._phases = {}
+        self._step_times = []
+        self._window_t0 = self._clock()
+        self.reanchor()
+        return record
+
+
+def iter_with_span(iterable, spans, name: str):
+    """Wrap an iterator so each ``next()`` is attributed to ``name`` —
+    how a training loop charges its batch wait to the ``data`` phase
+    without giving up the ``for batch in stream`` shape."""
+    it = iter(iterable)
+    while True:
+        with spans.span(name):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+        yield batch

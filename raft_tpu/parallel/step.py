@@ -32,7 +32,7 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
                              max_flow: float, freeze_bn: bool = False,
                              add_noise: bool = False, donate: bool = False,
                              accum_steps: int = 1,
-                             compiler_options=None):
+                             compiler_options=None, spans=None):
     """Build the mesh-aware train step.
 
     Usage:
@@ -48,12 +48,21 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
     shard-local — each device accumulates its own rows sequentially, no
     per-step resharding — when (batch / accum_steps) is a multiple of the
     'data' axis size.
+
+    ``spans`` (an obs.SpanRecorder) attributes the host-side hand-off to
+    the ``dispatch`` phase — the span closes when the runtime has
+    enqueued the sharded computation, not when the devices finish, so a
+    growing ``dispatch`` share means tracing/dispatch overhead, while
+    device-bound runs show up as ``block`` time at the window boundary.
     """
+    from raft_tpu.obs.spans import NULL
+
     base = make_train_step(model, iters=iters, gamma=gamma, max_flow=max_flow,
                            freeze_bn=freeze_bn, add_noise=add_noise,
                            donate=donate, accum_steps=accum_steps,
                            compiler_options=compiler_options)
     data_size = mesh.shape.get("data", 1)
+    spans = spans if spans is not None else NULL
 
     def step(state: TrainState, batch: Dict):
         if accum_steps > 1:
@@ -66,7 +75,7 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
                     f"axis ({data_size}): the shard-local accumulation "
                     f"guarantee breaks and GSPMD would insert per-step "
                     f"resharding")
-        with set_mesh(mesh):
+        with spans.span("dispatch"), set_mesh(mesh):
             return base(state, batch)
 
     return step

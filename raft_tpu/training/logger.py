@@ -5,6 +5,16 @@ means printed every SUM_FREQ=100 steps plus TensorBoard scalars for both
 training metrics (train.py:105-110) and validation results
 (train.py:125-130).
 
+Since PR 3 this is a thin parity shell over the observability metrics
+bus (raft_tpu/obs/meters.py): the bus owns the windowing and the
+no-per-step-host-sync discipline (device scalars are held until the
+window boundary); this class contributes the reference-format console
+line and the TensorBoard sink, and forwards window records to the run
+ledger when one is wired in.  Two reference bugs are fixed here rather
+than inherited: the final partial window is FLUSHED at ``close()``
+(the reference drops up to sum_freq-1 steps of metrics at end of
+training), and means divide by the actual window count, not sum_freq.
+
 TensorBoard backend: ``torch.utils.tensorboard`` when available (torch
 is part of the baked image), else a no-op — the console running means
 and the metrics history are always available.
@@ -14,24 +24,55 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+from raft_tpu.obs.meters import MetricsBus
+from raft_tpu.obs.spans import NULL
+
+# Metrics that exist for the health monitor, not for humans: they stay
+# in the ledger and the history, but are filtered from the reference-
+# parity console line and TensorBoard scalars (train.py:105-110).
+_SENTINEL_KEYS = frozenset({"nonfinite"})
+
 
 class Logger:
-    """Step-windowed running means + optional TensorBoard scalars."""
+    """Step-windowed running means + optional TensorBoard scalars.
+
+    ``ledger``/``spans``/``health`` wire the observability subsystem in:
+    window means land in the run ledger, the window-boundary host
+    conversion is attributed to the ``block`` span, and the health
+    monitor sees every window's per-step host values for the non-finite
+    sentinel.  All three default to off — library callers and tests get
+    the plain parity logger.
+    """
 
     def __init__(self, log_dir: str = "runs", sum_freq: int = 100,
                  scheduler_lr: Optional[callable] = None,
-                 enable_tensorboard: bool = True, start_step: int = 0):
+                 enable_tensorboard: bool = True, start_step: int = 0,
+                 ledger=None, spans=None, health=None):
         self.sum_freq = sum_freq
-        # start_step: resume offset, so the printed LR and TensorBoard
-        # global_step continue the original run instead of restarting.
-        self.total_steps = start_step
-        self._pending: list = []
+        # running kept for API compat (always {} between windows — the
+        # bus holds pending values now); history is the bus's.
         self.running: Dict[str, float] = {}
         self.scheduler_lr = scheduler_lr
-        self.history: list = []
         self.writer = None
         self._log_dir = log_dir
         self._tb = enable_tensorboard
+        self._spans = spans if spans is not None else NULL
+        # start_step: resume offset, so the printed LR and TensorBoard
+        # global_step continue the original run instead of restarting.
+        self.bus = MetricsBus(window=sum_freq, start_step=start_step,
+                              ledger=ledger)
+        if health is not None:
+            self.bus.add_window_hook(health.on_window)
+        self.bus.add_sink(self._console_sink)
+        self.bus.add_sink(self._tb_sink)
+
+    @property
+    def total_steps(self) -> int:
+        return self.bus.step
+
+    @property
+    def history(self) -> list:
+        return self.bus.history
 
     def _ensure_writer(self):
         if self.writer is None and self._tb:
@@ -43,44 +84,46 @@ class Logger:
                 # metrics history still work — but say WHY scalars are
                 # missing instead of disappearing silently.
                 import sys
+                # graftlint: disable=bare-print -- one-time setup
+                # diagnostic to stderr; no ledger is guaranteed here
                 print(f"tensorboard logging disabled "
                       f"({type(e).__name__}: {e})", file=sys.stderr)
                 self._tb = False
 
-    def _print_status(self):
-        lr = (self.scheduler_lr(self.total_steps)
-              if self.scheduler_lr else float("nan"))
-        status = f"[{self.total_steps + 1:6d}, {lr:10.7f}] "
-        keys = sorted(self.running.keys())
-        status += "".join(f"{self.running[k] / self.sum_freq:10.4f}, "
-                          for k in keys)
+    def _console_sink(self, step: int, means: Dict[str, float],
+                      n: int) -> None:
+        lr = (self.scheduler_lr(step) if self.scheduler_lr
+              else float("nan"))
+        status = f"[{step + 1:6d}, {lr:10.7f}] "
+        status += "".join(f"{means[k]:10.4f}, " for k in sorted(means)
+                          if k not in _SENTINEL_KEYS)
+        # graftlint: disable=bare-print -- the reference console parity
+        # surface itself (train.py:112-123); everything else flows
+        # through the bus this line is a sink of
         print(status)
 
-    def push(self, metrics: Dict[str, float]) -> None:
+    def _tb_sink(self, step: int, means: Dict[str, float],
+                 n: int) -> None:
+        self._ensure_writer()
+        if self.writer is not None:
+            for k, v in means.items():
+                if k not in _SENTINEL_KEYS:
+                    self.writer.add_scalar(k, v, step)
+
+    def push(self, metrics: Dict[str, float]) -> Optional[Dict]:
         """Accumulate one step's metrics; print + TB-log every sum_freq
-        steps (train.py:112-123).
+        steps (train.py:112-123).  Returns the window summary when this
+        push closed a window, else None.
 
         Values may be device arrays: host conversion happens only at the
-        window boundary, so pushing never forces a per-step sync.
+        window boundary, so pushing never forces a per-step sync.  The
+        boundary conversion is attributed to the ``block`` span when a
+        recorder is wired in — it is the loop's one deliberate sync.
         """
-        self.total_steps += 1
-        self._pending.append(metrics)
-
-        if self.total_steps % self.sum_freq == 0:
-            for m in self._pending:
-                for k, v in m.items():
-                    self.running[k] = self.running.get(k, 0.0) + float(v)
-            self._pending = []
-            self._print_status()
-            self._ensure_writer()
-            if self.writer is not None:
-                for k in self.running:
-                    self.writer.add_scalar(
-                        k, self.running[k] / self.sum_freq, self.total_steps)
-            self.history.append(
-                {k: v / self.sum_freq for k, v in self.running.items()}
-                | {"step": self.total_steps})
-            self.running = {}
+        if (self.bus.step + 1) % self.sum_freq == 0:
+            with self._spans.span("block"):
+                return self.bus.push(metrics)
+        return self.bus.push(metrics)
 
     def write_dict(self, results: Dict[str, float]) -> None:
         """Log a validation-results dict (train.py:125-130)."""
@@ -88,8 +131,15 @@ class Logger:
         if self.writer is not None:
             for k, v in results.items():
                 self.writer.add_scalar(k, float(v), self.total_steps)
-        self.history.append(dict(results) | {"step": self.total_steps})
+        self.bus.history.append(dict(results) | {"step": self.total_steps})
 
-    def close(self) -> None:
+    def close(self) -> Optional[Dict]:
+        """Flush the partial final window (the reference drops it), then
+        close the TB writer.  Returns the final window summary, if any
+        steps were pending."""
+        summary = None
+        with self._spans.span("block"):
+            summary = self.bus.flush(partial=True)
         if self.writer is not None:
             self.writer.close()
+        return summary

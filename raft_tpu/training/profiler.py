@@ -74,6 +74,27 @@ class StepTimer:
     def mean(self) -> float:
         return float(np.mean(self.times)) if self.times else float("nan")
 
+    @property
+    def p50(self) -> float:
+        return (float(np.percentile(self.times, 50)) if self.times
+                else float("nan"))
+
+    @property
+    def p95(self) -> float:
+        return (float(np.percentile(self.times, 95)) if self.times
+                else float("nan"))
+
+    @property
+    def max(self) -> float:
+        return float(np.max(self.times)) if self.times else float("nan")
+
+    def summary(self) -> Dict[str, float]:
+        """Mean/p50/p95/max step seconds — the tail matters: a mean-only
+        throughput number hides the stragglers (recompiles, host stalls)
+        that p95/max make visible."""
+        return {"mean": self.mean, "p50": self.p50, "p95": self.p95,
+                "max": self.max, "n": len(self.times)}
+
     def throughput(self, items_per_step: int) -> float:
         m = self.mean
         return items_per_step / m if m == m and m > 0 else float("nan")

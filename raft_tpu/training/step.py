@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.wire import WIRE_FLOW_MAX, decode_flow, decode_valid
+from raft_tpu.obs.health import nonfinite_sentinel
 from raft_tpu.training.loss import sequence_loss
 from raft_tpu.training.state import TrainState
 
@@ -156,6 +157,12 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
             batch_stats=new_model_state.get("batch_stats",
                                             state.batch_stats))
         metrics["grad_norm"] = optax_global_norm(grads)
+        # In-graph health sentinel (obs/health.py): two isfinite on
+        # scalars the step already computed — the metrics bus inspects it
+        # at the window boundary, so a NaN run is caught without any
+        # per-step host sync or extra pass over the gradients.
+        metrics["nonfinite"] = nonfinite_sentinel(metrics["loss"],
+                                                  metrics["grad_norm"])
         return new_state, metrics
 
     if not compiler_options:

@@ -107,8 +107,9 @@ def test_bench_tiny_smoke(tmp_path):
     import sys
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ledger = str(tmp_path / "bench_events.jsonl")
     env = dict(os.environ, RAFT_BENCH_TINY="1", RAFT_BENCH_ALLOW_CPU="1",
-               JAX_PLATFORMS="cpu")
+               JAX_PLATFORMS="cpu", RAFT_BENCH_LEDGER=ledger)
     r = subprocess.run([sys.executable, "bench.py"], cwd=root, env=env,
                        capture_output=True, text=True, timeout=500)
     assert r.returncode == 0, r.stderr[-2000:]
@@ -117,6 +118,17 @@ def test_bench_tiny_smoke(tmp_path):
     assert out["metric"] == "image-pairs/sec/chip"
     assert out["value"] > 0
     assert "mfu" in out and "fed_pairs_per_s" in out
+    # the percentile lane (per-step-synced StepTimer) must surface the
+    # step-time tail, not just the mean-derived headline
+    assert set(out["step_ms"]) == {"p50", "p95", "max"}
+    assert out["step_ms"]["max"] >= out["step_ms"]["p95"] >= \
+        out["step_ms"]["p50"] > 0
     from raft_tpu.config import RAFTConfig
     assert out["deferred_corr_grad"] is RAFTConfig().deferred_corr_grad
     assert out["tiny"] is True  # tiny runs must be self-identifying
+    # RAFT_BENCH_LEDGER: the run ledger renders through the report CLI
+    from raft_tpu.obs import build_report, read_ledger
+    report = build_report(read_ledger(ledger))
+    assert report["meta"]["entry"] == "bench"
+    assert report["throughput"]["step_seconds"]["n"] > 0
+    assert report["run_end_summary"]["pairs_per_s"] == out["value"]

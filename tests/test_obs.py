@@ -1,0 +1,599 @@
+"""Runtime telemetry (raft_tpu/obs): ledger schema round-trip, the
+metrics bus's no-premature-host-sync guarantee (a tripwire scalar that
+detonates on any conversion before the window boundary), span
+nesting/attribution math on an injected clock, health sentinels — the
+NaN one driven through the REAL jitted train step — the report CLI
+against a canned 20-step ledger, Logger's partial-window flush
+(the reference drops up to sum_freq-1 steps at end of training),
+StepTimer percentiles, and the ``--selfcheck`` tier-1 smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.obs.events import SCHEMA_VERSION, RunLedger, read_ledger
+from raft_tpu.obs.health import HealthMonitor, batch_signature
+from raft_tpu.obs.meters import Counter, Gauge, Histogram, MetricsBus
+from raft_tpu.obs.report import build_report, render_report
+from raft_tpu.obs.spans import SpanRecorder, iter_with_span
+
+
+class Tripwire:
+    """Device-scalar stand-in that raises on ANY host conversion until
+    armed — what `float(device_array)` would cost in the step loop is a
+    sync, so the bus must never do it before the window boundary."""
+
+    def __init__(self, value):
+        self.value = value
+        self.armed = False
+
+    def _detonate(self):
+        raise AssertionError("host conversion before the window boundary")
+
+    def __float__(self):
+        if not self.armed:
+            self._detonate()
+        return float(self.value)
+
+    def __int__(self):
+        self._detonate()
+
+    def __bool__(self):
+        self._detonate()
+
+    def __array__(self, *a, **k):
+        self._detonate()
+
+
+class FakeClock:
+    def __init__(self, t0: float = 0.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# --------------------------------------------------------------------------
+# events.py: ledger schema round-trip
+# --------------------------------------------------------------------------
+
+def test_ledger_roundtrip_all_kinds(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    led = RunLedger(path, meta={"entry": "test", "batch_size": 4})
+    led.metrics(step=10, n=10, means={"loss": 0.5})
+    led.spans(10, {"wall": 1.0, "phases": {"data": {"excl": 0.4,
+                                                    "incl": 0.4, "n": 10}},
+                   "step_times": [0.1] * 10})
+    led.memory(10, {"cpu:0": {"bytes_in_use": 100,
+                              "peak_bytes_in_use": 120,
+                              "bytes_limit": 1000}})
+    led.incident("nonfinite-loss", 7, "loss=nan")
+    led.close(summary={"steps": 10})
+
+    recs = read_ledger(path)
+    assert [r["kind"] for r in recs] == [
+        "run_start", "metrics", "spans", "memory", "incident", "run_end"]
+    assert all(r["v"] == SCHEMA_VERSION for r in recs)
+    assert len({r["run"] for r in recs}) == 1      # one run id throughout
+    assert recs[0]["meta"]["batch_size"] == 4
+    assert recs[1]["means"]["loss"] == 0.5 and recs[1]["n"] == 10
+    assert recs[4]["incident"] == "nonfinite-loss" and recs[4]["step"] == 7
+    assert recs[5]["summary"] == {"steps": 10}
+    with pytest.raises(ValueError, match="closed"):
+        led.write("metrics")
+
+
+def test_ledger_is_append_only_and_report_scopes_to_last_run(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    led1 = RunLedger(path, meta={"entry": "old"})
+    led1.metrics(step=50, n=10, means={"loss": 9.0})
+    led1.close()
+    led2 = RunLedger(path, meta={"entry": "new"})
+    led2.metrics(step=7, n=7, means={"loss": 1.0})
+    led2.close()
+    recs = read_ledger(path)
+    assert [r["kind"] for r in recs].count("run_start") == 2
+    # the report must NOT blend runs: last run only, with the truncation
+    # made visible via the runs count
+    report = build_report(recs)
+    assert report["runs"] == 2
+    assert report["meta"]["entry"] == "new"
+    assert report["steps"] == 7 and report["windows"] == 1
+    assert report["last_window_means"]["loss"] == 1.0
+    assert "2 runs" in render_report(report)
+
+
+def test_ledger_version_and_corruption_guards(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"v": 99, "kind": "metrics"}\n')
+    with pytest.raises(ValueError, match="schema v99"):
+        read_ledger(str(bad))
+
+    torn_tail = tmp_path / "tail.jsonl"
+    torn_tail.write_text(f'{{"v": {SCHEMA_VERSION}, "kind": "run_start", '
+                         f'"meta": {{}}}}\n{{"v": {SCHEMA_VERSION}, "ki')
+    assert len(read_ledger(str(torn_tail))) == 1   # killed mid-write: OK
+
+    torn_mid = tmp_path / "mid.jsonl"
+    torn_mid.write_text(f'{{"v": {SCHEMA_VERSION}, "ki\n'
+                        f'{{"v": {SCHEMA_VERSION}, "kind": "run_start", '
+                        f'"meta": {{}}}}\n')
+    with pytest.raises(ValueError, match="corrupt"):
+        read_ledger(str(torn_mid))
+
+
+# --------------------------------------------------------------------------
+# meters.py: the zero-per-step-host-sync guarantee
+# --------------------------------------------------------------------------
+
+def test_bus_never_converts_before_window_boundary(tmp_path):
+    """THE acceptance property: device-scalar pushes inside the step
+    loop perform no host conversion until the window boundary."""
+    led = RunLedger(str(tmp_path / "e.jsonl"), meta={})
+    bus = MetricsBus(window=5, ledger=led)
+    live = []
+    for i in range(4):
+        t = Tripwire(float(i))
+        live.append(t)
+        assert bus.push({"loss": t, "epe": Tripwire(2.0)}) is None
+    assert bus.history == []                        # nothing converted yet
+    # the boundary is the sanctioned sync point: arm everything pending
+    closer, closer_epe = Tripwire(4.0), Tripwire(2.0)
+    for t in live + [closer, closer_epe]:
+        t.armed = True
+    for m in bus._pending:
+        m["epe"].armed = True
+    window = bus.push({"loss": closer, "epe": closer_epe})
+    assert window is not None and window["epe"] == pytest.approx(2.0)
+    assert len(bus.history) == 1
+    assert bus.history[0]["loss"] == pytest.approx(2.0)  # mean(0..4)
+    assert bus.history[0]["n"] == 5
+    led.close()
+    (rec,) = [r for r in read_ledger(led.path) if r["kind"] == "metrics"]
+    assert rec["means"]["loss"] == pytest.approx(2.0)
+
+
+def test_bus_partial_flush_divides_by_actual_count():
+    bus = MetricsBus(window=5)
+    for i in range(7):
+        bus.push({"loss": float(i)})
+    assert len(bus.history) == 1                    # one full window
+    summary = bus.flush(partial=True)
+    assert summary["n"] == 2
+    assert summary["loss"] == pytest.approx((5 + 6) / 2)   # NOT /5
+    assert bus.flush(partial=True) is None          # nothing pending
+
+
+def test_bus_window_hook_sees_per_step_host_values():
+    seen = {}
+    bus = MetricsBus(window=3)
+    bus.add_window_hook(lambda first, steps: seen.update(
+        first=first, steps=steps))
+    for i in range(3):
+        bus.push({"loss": float(i)})
+    assert seen["first"] == 1                        # steps are 1-based
+    assert [s["loss"] for s in seen["steps"]] == [0.0, 1.0, 2.0]
+
+
+def test_instruments_defer_conversion_and_bucketize():
+    c = Counter("steps")
+    t = Tripwire(3.0)
+    c.inc(t)
+    c.inc(2)
+    t.armed = True
+    assert c.collect() == pytest.approx(5.0)
+
+    g = Gauge("lr")
+    g.set(Tripwire(1.5))
+    g._pending.armed = True
+    assert g.collect() == pytest.approx(1.5)
+    assert g.collect() == pytest.approx(1.5)         # last value sticks
+
+    h = Histogram("step_ms", buckets=[1.0, 10.0, 100.0])
+    for v in (0.5, 5.0, 5.0, 50.0, 500.0):
+        h.observe(Tripwire(v))
+    for p in h._pending:
+        p.armed = True
+    assert h.collect() == [1, 2, 1, 1]               # last = overflow
+    assert h.n == 5 and h.sum == pytest.approx(560.5)
+    with pytest.raises(ValueError):
+        Histogram("bad", buckets=[2.0, 1.0])
+
+
+# --------------------------------------------------------------------------
+# Logger (satellite: the reference's dropped-tail-window bug)
+# --------------------------------------------------------------------------
+
+def test_logger_close_flushes_partial_window_with_actual_divisor(capsys):
+    from raft_tpu.training.logger import Logger
+
+    logger = Logger(sum_freq=5, enable_tensorboard=False,
+                    scheduler_lr=lambda s: 1e-4)
+    for i in range(7):
+        logger.push({"epe": float(i)})
+    summary = logger.close()
+    # the reference drops steps 5-6 entirely; we flush them, divided by
+    # the ACTUAL window count (2), not sum_freq (5)
+    assert summary["n"] == 2
+    assert len(logger.history) == 2
+    assert logger.history[0]["epe"] == pytest.approx(2.0)   # mean(0..4)
+    assert logger.history[1]["epe"] == pytest.approx(5.5)   # mean(5,6)
+    assert capsys.readouterr().out.count("[") == 2   # tail printed too
+
+
+def test_logger_console_filters_sentinel_keys(capsys):
+    """The in-graph 'nonfinite' flag feeds the health monitor, not the
+    reference-parity console line (train.py:112-123 column format)."""
+    from raft_tpu.training.logger import Logger
+
+    logger = Logger(sum_freq=2, enable_tensorboard=False)
+    logger.push({"loss": 1.0, "nonfinite": 0.0})
+    logger.push({"loss": 1.0, "nonfinite": 0.0})
+    out = capsys.readouterr().out
+    assert out.split("]")[1].count(",") == 1     # loss only, no extra col
+    assert logger.history[-1]["nonfinite"] == 0.0  # ...but kept in history
+
+
+def test_logger_push_reports_window_closure():
+    from raft_tpu.training.logger import Logger
+
+    logger = Logger(sum_freq=2, enable_tensorboard=False)
+    assert logger.push({"l": 1.0}) is None
+    window = logger.push({"l": 3.0})
+    assert window["l"] == pytest.approx(2.0) and window["n"] == 2
+    assert logger.total_steps == 2
+
+
+# --------------------------------------------------------------------------
+# spans.py: nesting / attribution math
+# --------------------------------------------------------------------------
+
+def test_span_exclusive_attribution_with_nesting(tmp_path):
+    clock = FakeClock()
+    led = RunLedger(str(tmp_path / "e.jsonl"), meta={}, clock=clock)
+    spans = SpanRecorder(ledger=led, clock=clock, annotate=False)
+    with spans.span("data"):
+        clock.advance(3.0)
+        with spans.span("h2d"):
+            clock.advance(1.0)
+        clock.advance(2.0)
+    rec = spans.window_record()
+    assert rec["phases"]["data"]["incl"] == pytest.approx(6.0)
+    assert rec["phases"]["data"]["excl"] == pytest.approx(5.0)
+    assert rec["phases"]["h2d"]["excl"] == pytest.approx(1.0)
+    # flush writes the record and resets the window
+    spans.flush(step=1)
+    assert spans.window_record()["phases"] == {}
+    led.close()
+    (srec,) = [r for r in read_ledger(led.path) if r["kind"] == "spans"]
+    assert srec["phases"]["data"]["excl"] == pytest.approx(5.0)
+
+
+def test_span_step_boundaries_and_sibling_accumulation():
+    clock = FakeClock()
+    spans = SpanRecorder(clock=clock, annotate=False)
+    assert spans.step_boundary() is None             # anchor only
+    for dt in (0.1, 0.3):
+        with spans.span("dispatch"):
+            clock.advance(dt)
+        assert spans.step_boundary() == pytest.approx(dt)
+    rec = spans.window_record()
+    assert rec["phases"]["dispatch"]["n"] == 2
+    assert rec["phases"]["dispatch"]["excl"] == pytest.approx(0.4)
+    assert rec["step_times"] == [pytest.approx(0.1), pytest.approx(0.3)]
+
+
+def test_span_flush_reanchors_step_boundary():
+    """Inter-lane gaps (validation pass, bench lane switch) must not be
+    booked as one giant step time after a flush."""
+    clock = FakeClock()
+    spans = SpanRecorder(clock=clock, annotate=False)
+    spans.step_boundary()
+    clock.advance(0.1)
+    spans.step_boundary()
+    spans.flush(1)
+    clock.advance(5.0)                   # uninstrumented gap
+    assert spans.step_boundary() is None  # re-anchors, no 5.1s "step"
+    clock.advance(0.2)
+    assert spans.step_boundary() == pytest.approx(0.2)
+    assert spans.window_record()["step_times"] == [pytest.approx(0.2)]
+
+
+def test_iter_with_span_charges_next_to_phase():
+    clock = FakeClock()
+    spans = SpanRecorder(clock=clock, annotate=False)
+
+    def slow_gen():
+        for i in range(3):
+            clock.advance(0.2)
+            yield i
+
+    assert list(iter_with_span(slow_gen(), spans, "data")) == [0, 1, 2]
+    rec = spans.window_record()
+    assert rec["phases"]["data"]["n"] == 4           # 3 yields + exhaust
+    assert rec["phases"]["data"]["incl"] == pytest.approx(0.6)
+
+
+# --------------------------------------------------------------------------
+# health.py
+# --------------------------------------------------------------------------
+
+def test_nonfinite_sentinel_fires_through_the_real_train_step(tmp_path):
+    """Injected NaN batch -> the in-graph sentinel (training/step.py)
+    flags it as a device scalar -> the bus boundary converts -> the
+    monitor records EXACTLY ONE nonfinite-loss incident naming the
+    offending step, latched against the poisoned-state aftermath."""
+    import jax
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.training import create_train_state, make_optimizer
+    from raft_tpu.training.step import make_train_step
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image1": np.asarray(rng.uniform(0, 255, (1, 64, 64, 3)),
+                             np.float32),
+        "image2": np.asarray(rng.uniform(0, 255, (1, 64, 64, 3)),
+                             np.float32),
+        "flow": np.asarray(rng.standard_normal((1, 64, 64, 2)),
+                           np.float32),
+        "valid": np.ones((1, 64, 64), np.float32),
+    }
+    model = RAFT(RAFTConfig(small=True))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=10, wdecay=1e-4)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=1)
+    step = make_train_step(model, iters=1, gamma=0.8, max_flow=400.0)
+
+    nan_batch = dict(batch)
+    nan_batch["flow"] = batch["flow"] * np.float32("nan")
+
+    led = RunLedger(str(tmp_path / "e.jsonl"), meta={})
+    health = HealthMonitor(ledger=led)
+    bus = MetricsBus(window=4, ledger=led)
+    bus.add_window_hook(health.on_window)
+    for i in range(4):
+        state, metrics = step(state, nan_batch if i == 1 else batch)
+        assert "nonfinite" in metrics               # in-graph, every step
+        bus.push(metrics)
+    led.close()
+
+    # step 2 (1-based) got the NaN; step 3+ run on poisoned params but
+    # the incident is latched to the FIRST occurrence only
+    assert [i["kind"] for i in health.incidents] == ["nonfinite-loss"]
+    assert health.incidents[0]["step"] == 2
+    assert health.summary()["nonfinite_steps"] >= 1
+    (inc,) = [r for r in read_ledger(led.path) if r["kind"] == "incident"]
+    assert inc["incident"] == "nonfinite-loss" and inc["step"] == 2
+
+
+def test_ledger_sanitizes_nonfinite_to_strict_json(tmp_path):
+    """The flagship scenario (NaN loss means) must not leave bare NaN
+    tokens in the 'machine-readable' ledger — jq/JS-strict parsers
+    reject those."""
+    path = str(tmp_path / "e.jsonl")
+    led = RunLedger(path, meta={})
+    led.metrics(step=1, n=1, means={"loss": float("nan"),
+                                    "g": float("inf")})
+    led.close()
+
+    def boom(tok):
+        raise AssertionError(f"bare {tok} token in ledger JSON")
+
+    with open(path) as fh:
+        for line in fh:
+            json.loads(line, parse_constant=boom)   # strict parse
+    recs = read_ledger(path)
+    assert recs[1]["means"] == {"loss": "NaN", "g": "Infinity"}
+    # and the report renders the sanitized strings without crashing
+    assert "loss=NaN" in render_report(build_report(recs))
+
+
+def test_nonfinite_incident_names_the_actual_culprit():
+    """bf16 gradient overflow: grad_norm inf, loss finite — the
+    incident must cite grad_norm, not quote the healthy loss."""
+    health = HealthMonitor()
+    health.on_window(1, [{"loss": 0.5, "grad_norm": float("inf"),
+                          "nonfinite": 1.0}])
+    (inc,) = health.incidents
+    assert "grad_norm=inf" in inc["detail"]
+    assert "loss=0.5" not in inc["detail"]
+
+
+def test_recompile_sentinel_keys_on_batch_signature():
+    health = HealthMonitor()
+    b64 = {"image1": np.zeros((2, 64, 64, 3), np.float32)}
+    b96 = {"image1": np.zeros((2, 96, 64, 3), np.float32)}
+    assert health.observe_batch(1, b64) is False     # first sig: baseline
+    assert health.observe_batch(2, b64) is False     # same sig: no retrace
+    assert health.observe_batch(3, b96) is True      # new sig: retrace
+    assert health.observe_batch(4, b96) is False     # now known
+    (inc,) = health.incidents
+    assert inc["kind"] == "recompile" and inc["step"] == 3
+    # dtype changes are retraces too, and signatures are order-stable
+    assert batch_signature(b64) != batch_signature(
+        {"image1": np.zeros((2, 64, 64, 3), np.int16)})
+
+
+def test_memory_sampling_always_produces_a_watermark(tmp_path):
+    led = RunLedger(str(tmp_path / "e.jsonl"), meta={})
+    health = HealthMonitor(ledger=led)
+    sample = health.sample_memory(step=10)
+    led.close()
+    # CPU backends may not expose device stats; the host-RSS fallback
+    # guarantees the record (and the report's memory section) exists
+    assert sample["devices"] or sample["host_rss_bytes"] > 0
+    assert health.memory_watermarks
+    (rec,) = [r for r in read_ledger(led.path) if r["kind"] == "memory"]
+    assert rec["step"] == 10
+
+
+# --------------------------------------------------------------------------
+# report: canned 20-step ledger -> attribution / percentiles / incidents
+# --------------------------------------------------------------------------
+
+def _canned_ledger(path: str, nan_step: int = None) -> None:
+    """20 deterministic steps, window 10: per step data=2ms, h2d=1ms
+    (nested), dispatch=6ms, block=1ms, 1ms uninstrumented."""
+    clock = FakeClock(1000.0)
+    led = RunLedger(path, meta={"entry": "train", "stage": "synthetic",
+                                "batch_size": 4}, clock=clock)
+    spans = SpanRecorder(ledger=led, clock=clock, annotate=False)
+    health = HealthMonitor(ledger=led)
+    bus = MetricsBus(window=10, ledger=led)
+    bus.add_window_hook(health.on_window)
+    for step in range(1, 21):
+        with spans.span("data"):
+            clock.advance(0.001)
+            with spans.span("h2d"):
+                clock.advance(0.001)
+        with spans.span("dispatch"):
+            clock.advance(0.006)
+        loss = float("nan") if step == nan_step else 1.0 / step
+        with spans.span("block"):
+            clock.advance(0.001)
+            bus.push({"loss": loss, "nonfinite": float(loss != loss)})
+        clock.advance(0.001)
+        spans.step_boundary()
+        if step % 10 == 0:
+            spans.flush(step)
+            led.memory(step, {}, host_rss_bytes=100 << 20)
+    led.close(summary=health.summary())
+
+
+def test_report_on_canned_clean_run(tmp_path):
+    path = str(tmp_path / "clean.jsonl")
+    _canned_ledger(path)
+    report = build_report(read_ledger(path))
+    assert report["steps"] == 20 and report["windows"] == 2
+    attr = report["stall_attribution_pct"]
+    # per step: data 1ms excl, h2d 1ms, dispatch 6ms, block 1ms, other 1ms
+    assert attr["data"] == pytest.approx(10.0, abs=0.1)
+    assert attr["h2d"] == pytest.approx(10.0, abs=0.1)
+    assert attr["dispatch"] == pytest.approx(60.0, abs=0.1)
+    assert attr["block"] == pytest.approx(10.0, abs=0.1)
+    assert attr["other"] == pytest.approx(10.0, abs=0.1)
+    assert sum(attr.values()) == pytest.approx(100.0, abs=0.01)
+    pct = report["throughput"]["step_seconds"]
+    # 18 timed steps: each window's first boundary only anchors (flush
+    # re-anchors so inter-window/out-of-band gaps never inflate p95/max)
+    assert pct["n"] == 18
+    assert pct["p50"] == pytest.approx(0.010, abs=1e-4)
+    assert report["throughput"]["items_per_s_p50"] == pytest.approx(
+        400.0, rel=0.05)
+    assert report["memory_watermarks"]["host"]["bytes_in_use"] == 100 << 20
+    assert report["incidents"] == []
+
+    text = render_report(report)
+    assert "stall attribution" in text
+    assert "health incidents: none" in text
+    assert "p50" in text and "memory watermarks:" in text
+
+
+def test_report_on_canned_nan_run(tmp_path):
+    path = str(tmp_path / "nan.jsonl")
+    _canned_ledger(path, nan_step=13)
+    report = build_report(read_ledger(path))
+    (inc,) = report["incidents"]                     # exactly one, latched
+    assert inc["kind"] == "nonfinite-loss" and inc["step"] == 13
+    assert "nonfinite-loss" in render_report(report)
+
+
+def test_report_cli_contract(tmp_path, capsys):
+    from raft_tpu.obs.__main__ import main
+
+    path = str(tmp_path / "clean.jsonl")
+    _canned_ledger(path)
+    assert main(["report", path]) == 0
+    assert "stall attribution" in capsys.readouterr().out
+
+    assert main(["report", path, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["stall_attribution_pct"]["dispatch"] == pytest.approx(
+        60.0, abs=0.1)
+
+    nan_path = str(tmp_path / "nan.jsonl")
+    _canned_ledger(nan_path, nan_step=7)
+    assert main(["report", nan_path]) == 0           # reporting never gates
+    capsys.readouterr()
+    assert main(["report", nan_path, "--fail-on-incident"]) == 1
+    capsys.readouterr()
+    assert main(["report", str(tmp_path / "missing.jsonl")]) == 2
+    capsys.readouterr()
+
+
+def test_obs_selfcheck_smoke_is_green():
+    """Tier-1 wiring for `python -m raft_tpu.obs --selfcheck`: the
+    whole telemetry stack exercised end-to-end in a subprocess."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "--selfcheck"],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "FAIL" not in proc.stdout
+    assert "obs selfcheck: OK" in proc.stdout
+
+
+# --------------------------------------------------------------------------
+# profiler (satellite: percentiles, surfaced in bench.py's summary)
+# --------------------------------------------------------------------------
+
+def test_steptimer_percentiles():
+    from raft_tpu.training.profiler import StepTimer
+
+    t = StepTimer()
+    t.times = [0.1] * 18 + [0.2, 1.0]
+    assert t.p50 == pytest.approx(0.1)
+    assert t.p95 > t.p50
+    assert t.max == pytest.approx(1.0)
+    s = t.summary()
+    assert set(s) == {"mean", "p50", "p95", "max", "n"} and s["n"] == 20
+    empty = StepTimer()
+    assert math.isnan(empty.p50) and math.isnan(empty.max)
+
+
+@pytest.mark.slow
+def test_train_dryrun_writes_ledger_and_report_attributes(tmp_path):
+    """The acceptance dryrun: 20 CPU steps of cli/train.py -> a ledger
+    whose report shows attribution summing to ~100%, throughput
+    percentiles, a memory watermark and zero incidents; a second run
+    with --inject_nan_step reports exactly one nonfinite-loss incident
+    at the offending step."""
+    from raft_tpu.cli import train as train_cli
+
+    common = ["--stage", "synthetic", "--iters", "2", "--batch_size", "1",
+              "--image_size", "64", "64", "--small", "--num_steps", "20",
+              "--sum_freq", "10", "--no_tensorboard", "--num_workers", "1",
+              "--val_freq", "1000000",
+              "--log_dir", str(tmp_path / "runs"),
+              "--checkpoint_dir", str(tmp_path / "ckpt")]
+    train_cli.main(common + ["--name", "clean"])
+    ledger = tmp_path / "runs" / "clean" / "events.jsonl"
+    report = build_report(read_ledger(str(ledger)))
+    attr = report["stall_attribution_pct"]
+    assert sum(attr.values()) == pytest.approx(100.0, abs=0.1)
+    assert attr.get("dispatch", 0) > 0 and "data" in attr
+    assert report["throughput"]["step_seconds"]["n"] >= 18
+    assert report["memory_watermarks"]
+    assert report["incidents"] == []
+    assert report["run_end_summary"]["steps"] == 20
+
+    train_cli.main(common + ["--name", "nan", "--inject_nan_step", "10"])
+    nan_ledger = tmp_path / "runs" / "nan" / "events.jsonl"
+    nan_report = build_report(read_ledger(str(nan_ledger)))
+    (inc,) = nan_report["incidents"]
+    assert inc["kind"] == "nonfinite-loss"
+    assert inc["step"] == 10      # exactly the injected (1-based) step
