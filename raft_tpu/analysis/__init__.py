@@ -1,6 +1,6 @@
 """graftlint: the raft_tpu static-analysis subsystem.
 
-Two engines, one findings model:
+Four engines, one findings model:
 
 - **AST linter** (:mod:`raft_tpu.analysis.lint` +
   :mod:`raft_tpu.analysis.rules`): lexical JAX/TPU pitfalls — host
@@ -12,6 +12,17 @@ Two engines, one findings model:
   data — no f64 avals (traced under x64), bf16-policy conformance,
   no host transfers inside scans, donation reflected in the lowering,
   retrace stability, and a recompile-key report across presets.
+- **HLO auditor** (:mod:`raft_tpu.analysis.hlo_audit` +
+  :mod:`raft_tpu.analysis.budgets`): compiles the same entries and
+  pins what XLA emitted — collective op counts, cost/memory budgets
+  and lowering hygiene against the checked-in ``budgets.json``.
+- **numerics auditor** (:mod:`raft_tpu.analysis.numerics_audit` +
+  :mod:`raft_tpu.analysis.pallas_audit`): abstract-interprets the
+  entries' jaxprs — dtype flow, conservative value intervals, a
+  can-be-zero lattice (overflow, unguarded partial ops, bf16
+  accumulation, softmax hygiene) — and statically verifies the Pallas
+  kernels' BlockSpecs, index maps and VMEM footprints against the
+  ledger's ``pallas_vmem`` section.
 
 Run: ``python -m raft_tpu.analysis`` (or ``scripts/graftlint.py``), which
 exits nonzero on unwaived findings.  Gate semantics, waiver syntax and

@@ -12,6 +12,11 @@ Engine-specific extras:
   against the ``budgets.json`` ledger; ``--update-budgets`` re-baselines
   the ledger (commit the diff), ``--budgets PATH`` points at an
   alternate ledger (tests use a perturbed copy).
+- ``--engine numerics`` abstract-interprets the same entry points for
+  dtype-flow and value-range hazards (overflow, unguarded partial ops,
+  bf16 accumulation, softmax hygiene) and statically verifies the
+  Pallas kernels' BlockSpecs/VMEM against the ledger's ``pallas_vmem``
+  section; ``--update-budgets`` re-baselines that section too.
 - ``--list-waivers`` enumerates every active suppression in the tree —
   inline ``# graftlint: disable`` comments (with staleness: a waiver
   that no longer matches any finding is marked ``[stale]``) and the
@@ -88,10 +93,11 @@ def collect_waivers(paths) -> list:
                 "invariant": w.invariant, "provenance": w.provenance,
                 "scalar_only": w.scalar_only, "reason": w.reason})
 
-    from raft_tpu.analysis import hlo_audit, jaxpr_audit
+    from raft_tpu.analysis import hlo_audit, jaxpr_audit, numerics_audit
 
     data_waivers("jaxpr", jaxpr_audit)
     data_waivers("hlo", hlo_audit)
+    data_waivers("numerics", numerics_audit)
     return out
 
 
@@ -110,11 +116,12 @@ def render_waivers(waivers) -> str:
             lines.append(f"{w['path']}:{w['line']}: {w['engine']} "
                          f"{w['invariant']} @ {w['provenance']}{scope} "
                          f"-- {w['reason']}")
-    n = {"lint": 0, "jaxpr": 0, "hlo": 0}
+    n = {"lint": 0, "jaxpr": 0, "hlo": 0, "numerics": 0}
     for w in waivers:
         n[w["engine"]] += 1
     lines.append(f"graftlint waivers: {n['lint']} lint ({stale} stale), "
-                 f"{n['jaxpr']} jaxpr, {n['hlo']} hlo")
+                 f"{n['jaxpr']} jaxpr, {n['hlo']} hlo, "
+                 f"{n['numerics']} numerics")
     return "\n".join(lines)
 
 
@@ -122,12 +129,14 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "python -m raft_tpu.analysis",
         description="graftlint: AST lint + jaxpr audit + HLO "
-                    "collective/cost audit for raft_tpu")
+                    "collective/cost audit + numerics/Pallas audit "
+                    "for raft_tpu")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories for the AST engine "
                         "(default: raft_tpu/, scripts/, bench.py, "
                         "__graft_entry__.py)")
-    p.add_argument("--engine", choices=["lint", "jaxpr", "hlo", "all"],
+    p.add_argument("--engine",
+                   choices=["lint", "jaxpr", "hlo", "numerics", "all"],
                    default="all")
     p.add_argument("--rules", default=None,
                    help="comma-separated lint rule ids to run "
@@ -154,10 +163,12 @@ def main(argv=None) -> int:
                    help="also show waived findings and the full report")
     args = p.parse_args(argv)
 
-    if args.update_budgets and args.engine not in ("hlo", "all"):
-        p.error("--update-budgets requires --engine hlo (or all)")
+    if args.update_budgets and args.engine not in ("hlo", "numerics",
+                                                   "all"):
+        p.error("--update-budgets requires --engine hlo or numerics "
+                "(or all)")
 
-    if args.engine in ("jaxpr", "hlo", "all"):
+    if args.engine in ("jaxpr", "hlo", "numerics", "all"):
         _force_cpu_with_virtual_devices()
 
     from raft_tpu.analysis import findings as fmod
@@ -182,20 +193,41 @@ def main(argv=None) -> int:
         from raft_tpu.analysis.jaxpr_audit import ENTRY_AUDITS
 
         known = set()
+        numerics_known = set()
         if args.engine in ("jaxpr", "all"):
             known |= set(ENTRY_AUDITS)
         if args.engine in ("hlo", "all"):
             known |= set(ENTRIES) | set(FIXTURE_ENTRIES)
+        if args.engine in ("numerics", "all"):
+            from raft_tpu.analysis import pallas_audit
+            from raft_tpu.analysis.numerics_audit import \
+                ENTRIES as _NE, FIXTURE_ENTRIES as _NF
+
+            numerics_known = (set(_NE) | set(_NF)
+                              | set(pallas_audit.FIXTURE_ENTRIES.keys()))
+            known |= numerics_known
         unknown = sorted(set(audits) - known)
         if unknown:
             p.error(f"unknown audit(s) {unknown}; known: {sorted(known)}")
         if args.update_budgets:
-            from raft_tpu.analysis.hlo_audit import ENTRIES as _E, \
-                FIXTURE_ENTRIES as _F
+            budgetable = set()
+            if args.engine in ("hlo", "all"):
+                from raft_tpu.analysis.hlo_audit import ENTRIES as _E, \
+                    FIXTURE_ENTRIES as _F
 
-            if not any(a in _E or a in _F for a in audits):
+                budgetable |= set(_E) | set(_F)
+            if args.engine in ("numerics", "all"):
+                from raft_tpu.analysis.numerics_audit import ENTRIES as _N
+
+                # only pallas-carrying budgeted entries write ledger
+                # records; fixtures and pure-interpretation entries
+                # would silently no-op
+                budgetable |= {n for n, e in _N.items()
+                               if e.pallas and e.budgeted}
+            if not any(a in budgetable for a in audits):
                 p.error("--update-budgets needs --audits to name at "
-                        "least one hlo audit (or drop --audits to "
+                        "least one hlo audit or pallas-carrying "
+                        "numerics audit (or drop --audits to "
                         "re-baseline everything) — nothing would be "
                         "written")
     all_findings = []
@@ -242,6 +274,27 @@ def main(argv=None) -> int:
             all_findings += hfs
             report["hlo"] = hreport
         timings["hlo"] = round(time.monotonic() - t0, 2)
+    if args.engine in ("numerics", "all"):
+        from raft_tpu.utils.platform import ensure_platform
+
+        ensure_platform(strict=True)
+        t0 = time.monotonic()
+        from raft_tpu.analysis import pallas_audit
+        from raft_tpu.analysis.numerics_audit import ENTRIES as NENT, \
+            FIXTURE_ENTRIES as NFIX, run_numerics_audit
+
+        num_names = audits
+        if audits is not None:
+            num_known = (set(NENT) | set(NFIX)
+                         | set(pallas_audit.FIXTURE_ENTRIES.keys()))
+            num_names = [a for a in audits if a in num_known]
+        if num_names != []:
+            nfs, nreport = run_numerics_audit(
+                num_names, budgets_path=args.budgets,
+                update=args.update_budgets)
+            all_findings += nfs
+            report["numerics"] = nreport
+        timings["numerics"] = round(time.monotonic() - t0, 2)
 
     report["engine_timings"] = timings
     out = (fmod.render_json(all_findings, report) if args.json

@@ -22,6 +22,12 @@ hlo --update-budgets`` and COMMIT the diff — the ledger diff in review
 is the whole point: a perf PR shows its lowering got better, a refactor
 shows it stayed put.
 
+The same file carries the ``pallas_vmem`` section owned by engine 4's
+Pallas kernel verifier (``analysis/pallas_audit.py``): per-kernel
+double-buffered VMEM footprints and launch counts, re-baselined via
+``--engine numerics --update-budgets``.  Sections merge independently —
+an engine-3 re-baseline never drops the Pallas records and vice versa.
+
 Comparisons are only strict when the environment matches
 ``meta`` (platform + jax version + pinned optimization level): a
 different toolchain legitimately emits different programs, so there the
@@ -74,19 +80,34 @@ def load_budgets(path: Optional[str] = None) -> Optional[Dict]:
         return json.load(f)
 
 
-def save_budgets(path: Optional[str], meta: Dict,
-                 entries: Dict[str, Dict]) -> str:
+def save_budgets(path: Optional[str], meta: Optional[Dict],
+                 entries: Dict[str, Dict],
+                 section: str = "entries") -> str:
     """Write the ledger, merging over an existing file: only the entries
     measured this run are replaced (so ``--update-budgets --audits x``
-    re-baselines one entry without dropping the rest)."""
+    re-baselines one entry without dropping the rest).
+
+    ``section`` selects the top-level block to merge into — engine 3
+    owns ``entries``, engine 4's Pallas verifier owns ``pallas_vmem``;
+    every other section survives a write untouched.  ``meta=None``
+    keeps the existing meta (the Pallas facts are trace-structural and
+    carry no toolchain pin of their own).
+    """
     path = path or default_budgets_path()
-    existing = load_budgets(path) or {"entries": {}}
-    merged = dict(existing.get("entries", {}))
+    existing = load_budgets(path) or {}
+    merged = dict(existing.get(section, {}))
     merged.update(entries)
-    payload = {"meta": meta,
-               "entries": {k: merged[k] for k in sorted(merged)}}
+    payload = dict(existing)
+    if meta is not None:
+        payload["meta"] = meta
+    payload.setdefault("meta", {})
+    payload[section] = {k: merged[k] for k in sorted(merged)}
+    ordered = {k: payload[k] for k in ("meta", "entries")
+               if k in payload}
+    ordered.update({k: payload[k] for k in sorted(payload)
+                    if k not in ordered})
     with open(path, "w", encoding="utf-8") as f:
-        json.dump(payload, f, indent=2, sort_keys=False)
+        json.dump(ordered, f, indent=2, sort_keys=False)
         f.write("\n")
     return path
 

@@ -18,11 +18,13 @@ Waiver syntax (both engines):
   linter reports it as a ``waiver-no-reason`` finding instead), so every
   suppression in the tree is self-documenting.
 
-- jaxpr/HLO engines: entries in
+- jaxpr/HLO/numerics engines: entries in
   :data:`raft_tpu.analysis.jaxpr_audit.WAIVERS` /
-  :data:`raft_tpu.analysis.hlo_audit.WAIVERS` — invariants are asserted
-  as data, and so are their exceptions (e.g. optax's scalar
-  bias-correction arithmetic under x64).
+  :data:`raft_tpu.analysis.hlo_audit.WAIVERS` /
+  :data:`raft_tpu.analysis.numerics_audit.WAIVERS` — invariants are
+  asserted as data, and so are their exceptions (e.g. optax's scalar
+  bias-correction arithmetic under x64, flax's E[x^2]-E[x]^2 variance
+  under interval analysis).
 
 ``python -m raft_tpu.analysis --list-waivers`` inventories every
 declared waiver with file:line and reason, flagging stale ones.
@@ -41,7 +43,7 @@ SEVERITIES = ("error", "note")
 
 @dataclasses.dataclass
 class Finding:
-    engine: str              # "lint" | "jaxpr" | "hlo"
+    engine: str              # "lint" | "jaxpr" | "hlo" | "numerics"
     rule: str                # rule / invariant identifier
     path: str                # file (lint/hlo) or entry-point name (jaxpr)
     line: int                # 1-based line; 0 when not line-addressable

@@ -1,0 +1,1386 @@
+"""graftlint engine 4: the numerics auditor.
+
+Engines 1-3 audit syntax, graph structure and what XLA emits; none of
+them can say *"this value can exceed its dtype's max"* or *"this sqrt
+sees zero"* — the class of silent-NaN regression the obs nonfinite
+sentinel only catches at runtime, mid-run.  This engine closes that
+loop statically: it abstract-INTERPRETS the jaxprs of the same
+lowerable entry-point builders engines 2/3 use, propagating per-value
+facts through every primitive:
+
+- the **dtype** (from the aval),
+- a conservative **magnitude interval** ``[lo, hi]`` seeded from
+  declared input specs (images in [0, 255], flow in [-max_flow,
+  max_flow], params assumed |w| <= PARAM_BOUND — the audit's stated
+  assumptions, see :func:`declared_ranges`) and pushed through
+  per-primitive transfer functions (dot/conv scale by the contraction
+  size, reduce_sum by the reduced count, exp/log/rsqrt by their
+  monotone envelopes, clamp/max restore bounds the random path loses),
+- a **can-be-zero / can-be-negative lattice**, carried by the interval
+  itself plus a ``nonzero`` flag for values that are provably positive
+  in the limit but whose interval's lower bound is 0 (exp, logistic,
+  sums of provably-positive terms) — this is what proves a softmax
+  denominator safe.
+
+Intervals are sound but non-relational: ``x - max(x)`` cannot be
+proven non-positive, and a bound that grows past ``HORIZON`` (1e60)
+widens to +/-inf ("the domain stops pretending") so deep conv stacks
+produce *unknown*, never astronomically-finite, bounds.  Overflow
+findings therefore fire only on bounds *proven* under the horizon,
+which keeps them meaningful exactly where the issue lives: shallow
+contraction chains (the corr volume) and downcasts of spec-bounded
+values.  The deep model entries run the hazard rules but skip
+``dtype-overflow`` (their finite bounds would be vacuous); the
+shallow lookup entries and fixtures run everything (per-entry
+``rules``).
+
+Rules (each finding carries the provenance ``file:line`` of the
+offending primitive, same waiver machinery as engines 2/3):
+
+- ``dtype-overflow`` — a value whose proven interval exceeds its float
+  dtype's max (bf16 "3.4e38's little brother" is the f16 65504 case
+  and genuine bf16-range blowups), at the op producing it or at a
+  downcast.
+- ``unguarded-partial`` — ``log``/``rsqrt``/``div``/``pow`` whose
+  operand interval includes 0 (or negatives, for the domain cases)
+  with no dominating eps/clamp: a guard like ``maximum(x, eps)`` or
+  ``x + eps`` raises the proven lower bound above 0 and silences the
+  rule mechanically.
+- ``sqrt-at-zero`` — ``sqrt`` whose operand can be exactly 0: the
+  forward is fine (sqrt(0)=0) but d/dx sqrt = inf at 0, the NaN
+  gradient that hit ``training/loss.py`` before its safe-norm fix.
+- ``bf16-accum`` — a reduce_sum accumulating in bf16/f16 over more
+  than :data:`REDUCE_ACCUM_THRESHOLD` elements without an f32
+  accumulator (each partial sum rounds at 8 mantissa bits).
+- ``softmax-max-sub`` — an ``exp`` whose operand is not provably
+  bounded under ``ln(dtype.max)`` and is not the ``x - reduce_max(x)``
+  pattern (checked structurally through broadcast/convert/
+  stop_gradient hops): softmax without max-subtraction overflows on
+  the first large logit.  Also enforces the f32-softmax convention
+  (models/update.py:160): ``exp`` must not run in a 16-bit dtype.
+- ``eps-hygiene`` — an eps literal guarding a partial op that is below
+  its dtype's smallest normal (``finfo.tiny``: the guard flushes to
+  zero/subnormal and protects nothing), with a note tier for 16-bit
+  guards far below the dtype's ulp scale.
+
+The Pallas kernel verifier (``analysis/pallas_audit.py``) runs under
+this engine too: grid/BlockSpec divisibility, index-map bounds, and
+double-buffered VMEM footprints against the ``pallas_vmem`` section of
+``budgets.json`` (same ``--update-budgets`` re-baseline flow as engine
+3).
+
+``FIXTURE_ENTRIES`` are deliberately-broken programs (a bf16 overflow
+chain, the pre-fix loss sqrt, a long bf16 reduce, a no-max-sub
+softmax, a sub-tiny eps, an oversized/mis-sized BlockSpec); they never
+run by default — tests select them with ``--audits`` to prove each
+rule trips with exit 1 and file:line attribution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu.analysis.findings import Finding
+from raft_tpu.analysis.jaxpr_audit import (JaxprWaiver, apply_data_waivers,
+                                           provenance)
+
+INF = float("inf")
+
+# Bounds beyond this magnitude widen to +/-inf: a non-relational
+# interval through a deep conv stack is "finite" only in the vacuous
+# sense, and overflow findings must never rest on it.
+HORIZON = 1e60
+
+# reduce_sum in a 16-bit accumulator over more elements than this is a
+# bf16-accum finding (partial sums round at 8 mantissa bits; 512 is
+# roughly where the relative error of a same-sign bf16 sum passes 1%).
+REDUCE_ACCUM_THRESHOLD = 512
+
+# The audit's declared input-spec assumptions (documented contract, not
+# measurements): trained weights stay within PARAM_BOUND; optimizer
+# second moments are nonnegative and bounded; feature maps fed straight
+# into the lookup entries stay within FMAP_BOUND.
+PARAM_BOUND = 8.0
+MOMENT_BOUND = 1e6
+FMAP_BOUND = 64.0
+
+WAIVERS: Tuple[JaxprWaiver, ...] = (
+    JaxprWaiver(
+        invariant="sqrt-at-zero",
+        provenance="optax/",
+        reason="optax's sqrt(second moment) and global-norm sqrt sit on "
+               "provably-nonnegative operands and are never "
+               "differentiated (the optimizer update is outside the "
+               "loss grad); sqrt(0)=0 is exact in the forward"),
+    JaxprWaiver(
+        invariant="unguarded-partial",
+        provenance="flax/linen/normalization.py",
+        reason="flax computes variance as E[x^2] - E[x]^2, nonnegative "
+               "by Jensen but unprovable in a non-relational interval "
+               "domain; the rsqrt is eps-guarded in value "
+               "(var + epsilon with epsilon >= 1e-5)"),
+    JaxprWaiver(
+        invariant="sqrt-at-zero",
+        provenance="flax/linen/normalization.py",
+        reason="same E[x^2] - E[x]^2 variance operand as the "
+               "unguarded-partial waiver above; the sqrt input is "
+               "eps-shifted in value and the stats are f32"),
+    JaxprWaiver(
+        invariant="unguarded-partial",
+        provenance="optax/transforms/_clipping.py",
+        reason="clip_by_global_norm divides by its own global norm and "
+               "select()s the untouched branch whenever the norm is "
+               "below max_norm; the guard is a select the interval "
+               "domain cannot see, and norm == 0 implies all-zero "
+               "updates whose divided branch is discarded"),
+    JaxprWaiver(
+        invariant="bf16-accum",
+        provenance="raft_tpu/models/layers.py",
+        reason="parameter-gradient reductions (conv bias / norm scale "
+               "cotangents) accumulate in bf16 by design under the "
+               "bf16 compute policy — the measured mask_f32 A/B "
+               "(docs/ARCHITECTURE.md) showed forcing f32 through the "
+               "backward costs ~16 ms/step; master weights and the "
+               "optimizer update stay f32"),
+)
+
+
+# --------------------------------------------------------------------------
+# the value lattice (pure: unit-tested directly)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class VRange:
+    """Conservative value interval for one traced array (all elements).
+
+    ``nonzero`` marks values provably != 0 even when ``lo`` is 0 (an
+    exp output, a sum of provably-positive terms): the distinction
+    between "can divide by this" and "this can be exactly zero".
+    """
+
+    lo: float
+    hi: float
+    nonzero: bool = False
+
+    def __post_init__(self):
+        # widen vacuously-finite bounds (see HORIZON); normalize -0.0
+        lo, hi = self.lo, self.hi
+        if lo < -HORIZON:
+            lo = -INF
+        if hi > HORIZON:
+            hi = INF
+        object.__setattr__(self, "lo", lo + 0.0)
+        object.__setattr__(self, "hi", hi + 0.0)
+
+    @property
+    def can_be_zero(self) -> bool:
+        return self.lo <= 0.0 <= self.hi and not self.nonzero
+
+    @property
+    def can_be_negative(self) -> bool:
+        return self.lo < 0.0
+
+    @property
+    def is_point(self) -> bool:
+        return self.lo == self.hi and math.isfinite(self.lo)
+
+
+TOP = VRange(-INF, INF)
+UNIT = VRange(0.0, 1.0)
+# Identity-distinct sentinel for a literal-NaN value (jnp.var's ddof
+# error branch, where(ok, var, nan)): poison, but not a range — select
+# joins skip it so an error-path sentinel cannot unprove a variance.
+NAN_LITERAL = VRange(-INF, INF)
+
+
+def vjoin(*rs: VRange) -> VRange:
+    return VRange(min(r.lo for r in rs), max(r.hi for r in rs),
+                  all(r.nonzero for r in rs))
+
+
+def _mul_bound(a: float, b: float) -> float:
+    # interval-endpoint product; 0 * inf resolves to 0 (the other
+    # endpoint pair supplies the inf when it is genuinely reachable)
+    if a == 0.0 or b == 0.0:
+        return 0.0
+    return a * b
+
+
+def vadd(x: VRange, y: VRange) -> VRange:
+    lo, hi = x.lo + y.lo, x.hi + y.hi
+    if math.isnan(lo):
+        lo = -INF
+    if math.isnan(hi):
+        hi = INF
+    nz = (x.lo + y.lo > 0) or (x.hi + y.hi < 0)
+    return VRange(lo, hi, bool(nz))
+
+
+def vneg(x: VRange) -> VRange:
+    return VRange(-x.hi, -x.lo, x.nonzero)
+
+
+def vmul(x: VRange, y: VRange) -> VRange:
+    cands = [_mul_bound(a, b) for a in (x.lo, x.hi) for b in (y.lo, y.hi)]
+    return VRange(min(cands), max(cands), x.nonzero and y.nonzero)
+
+
+def vscale(x: VRange, k: float) -> VRange:
+    """x * k for a nonnegative scalar k (reduction counts)."""
+    return vmul(x, VRange(k, k, k != 0))
+
+
+def vdiv(x: VRange, y: VRange) -> VRange:
+    if y.lo <= 0.0 <= y.hi:
+        # denominator interval touches 0: unbounded either way (the
+        # nonzero flag guards the RULE, not the bound)
+        return TOP
+    cands = []
+    for a in (x.lo, x.hi):
+        for b in (y.lo, y.hi):
+            c = a / b
+            cands.append(0.0 if math.isnan(c) else c)
+    return VRange(min(cands), max(cands), x.nonzero)
+
+
+def vabs(x: VRange) -> VRange:
+    if x.lo >= 0:
+        return x
+    if x.hi <= 0:
+        return vneg(x)
+    return VRange(0.0, max(-x.lo, x.hi), x.nonzero)
+
+
+def vmax(x: VRange, y: VRange) -> VRange:
+    lo = max(x.lo, y.lo)
+    return VRange(lo, max(x.hi, y.hi),
+                  x.nonzero and y.nonzero or lo > 0)
+
+
+def vmin(x: VRange, y: VRange) -> VRange:
+    hi = min(x.hi, y.hi)
+    return VRange(min(x.lo, y.lo), hi,
+                  x.nonzero and y.nonzero or hi < 0)
+
+
+def _exp(v: float) -> float:
+    try:
+        return math.exp(v)
+    except OverflowError:
+        return INF
+
+
+def vexp(x: VRange) -> VRange:
+    return VRange(max(_exp(x.lo), 0.0), _exp(x.hi), True)
+
+
+def vlog(x: VRange) -> VRange:
+    if x.hi <= 0:
+        return TOP  # empty domain; the rule fires, bound stays sound
+    lo = -INF if x.lo <= 0 else math.log(x.lo)
+    return VRange(lo, math.log(x.hi) if x.hi != INF else INF)
+
+
+def vsqrt(x: VRange) -> VRange:
+    lo = math.sqrt(max(x.lo, 0.0))
+    hi = math.sqrt(max(x.hi, 0.0)) if x.hi != INF else INF
+    return VRange(lo, hi, x.lo > 0)
+
+
+def vrsqrt(x: VRange) -> VRange:
+    # a nonzero-flagged [0, c] operand (an exp/logistic output) is
+    # provably positive: keep the [1/sqrt(c), inf) bound instead of TOP
+    if x.lo < 0 or (x.lo == 0 and not x.nonzero):
+        return TOP
+    hi = INF if x.lo == 0 else 1.0 / math.sqrt(x.lo)
+    lo = 0.0 if x.hi == INF else 1.0 / math.sqrt(x.hi)
+    return VRange(lo, hi, True)
+
+
+def _powf(a: float, b: float) -> float:
+    try:
+        return math.pow(a, b)
+    except (OverflowError, ValueError):
+        return INF
+
+
+def vintpow(x: VRange, y: int) -> VRange:
+    if y == 0:
+        return VRange(1.0, 1.0, True)
+    if y < 0:
+        return vdiv(VRange(1.0, 1.0, True), vintpow(x, -y))
+    if y % 2 == 0:
+        m = max(abs(x.lo), abs(x.hi))
+        lo = 0.0
+        if x.lo > 0 or x.hi < 0:
+            lo = _powf(min(abs(x.lo), abs(x.hi)), y)
+        return VRange(lo, _powf(m, y), x.nonzero)
+    return VRange(math.copysign(_powf(abs(x.lo), y), x.lo),
+                  math.copysign(_powf(abs(x.hi), y), x.hi), x.nonzero)
+
+
+def vpow(x: VRange, y: VRange) -> VRange:
+    if x.lo < 0:
+        return TOP  # fractional pow of a negative: rule territory
+    cands = []
+    for a in (max(x.lo, 0.0), x.hi):
+        for b in (y.lo, y.hi):
+            if a == 0.0 and b < 0:
+                return TOP
+            cands.append(_powf(a, b) if a > 0 else 0.0)
+    return VRange(min(cands), max(cands))
+
+
+def vtanh(x: VRange) -> VRange:
+    return VRange(math.tanh(x.lo) if x.lo != -INF else -1.0,
+                  math.tanh(x.hi) if x.hi != INF else 1.0)
+
+
+def vlogistic(x: VRange) -> VRange:
+    def sig(v):
+        if v == -INF:
+            return 0.0
+        if v == INF:
+            return 1.0
+        return 1.0 / (1.0 + _exp(-v))
+    return VRange(sig(x.lo), sig(x.hi), True)
+
+
+def literal_range(val) -> VRange:
+    """Exact range of a literal / constvar value (numpy scalar/array;
+    ml_dtypes bf16/f16 handled via an f64 view)."""
+    import numpy as np
+
+    try:
+        arr = np.asarray(val)
+        if arr.dtype == bool:
+            return UNIT if arr.size else VRange(0.0, 0.0)
+        # graftlint: disable=f64-literal -- host-side analysis math:
+        # interval endpoints live in python floats (f64) by definition;
+        # nothing here is ever traced or lowered
+        arr = arr.astype(np.float64)
+    except (TypeError, ValueError):
+        return TOP
+    if arr.size == 0:
+        return VRange(0.0, 0.0)
+    lo, hi = float(np.min(arr)), float(np.max(arr))
+    if math.isnan(lo) or math.isnan(hi):
+        return NAN_LITERAL if bool(np.all(np.isnan(arr))) else TOP
+    return VRange(lo, hi, bool(np.all(arr != 0)))
+
+
+# --------------------------------------------------------------------------
+# dtype facts
+# --------------------------------------------------------------------------
+
+_NARROW_FLOATS = ("bfloat16", "float16")
+
+
+def _dtype_str(aval) -> str:
+    return str(getattr(aval, "dtype", ""))
+
+
+def float_max(dtype_str: str) -> Optional[float]:
+    import numpy as np
+    import jax.numpy as jnp
+
+    try:
+        if dtype_str == "bfloat16":
+            return float(jnp.finfo(jnp.bfloat16).max)
+        return float(np.finfo(dtype_str).max)
+    except (TypeError, ValueError):
+        return None
+
+
+def float_tiny(dtype_str: str) -> Optional[float]:
+    import numpy as np
+    import jax.numpy as jnp
+
+    try:
+        if dtype_str == "bfloat16":
+            return float(jnp.finfo(jnp.bfloat16).tiny)
+        return float(np.finfo(dtype_str).tiny)
+    except (TypeError, ValueError):
+        return None
+
+
+def _is_float(dtype_str: str) -> bool:
+    return dtype_str.startswith(("float", "bfloat"))
+
+
+def _reduce_count(eqn) -> int:
+    shape = getattr(eqn.invars[0].aval, "shape", ())
+    axes = eqn.params.get("axes", ())
+    n = 1
+    for a in axes:
+        n *= shape[a] if a < len(shape) else 1
+    return n
+
+
+# --------------------------------------------------------------------------
+# the interpreter
+# --------------------------------------------------------------------------
+
+_IDENTITY_PRIMS = {
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
+    "dynamic_slice", "rev", "copy", "stop_gradient", "reduce_precision",
+    "sharding_constraint", "gather", "real", "expand_dims", "copy_p",
+    "convert_element_type",
+}
+
+_BOOL_PRIMS = {"eq", "ne", "lt", "le", "gt", "ge", "is_finite",
+               "reduce_and", "reduce_or"}
+
+# hops the softmax max-sub walk may cross between exp, sub and
+# reduce_max without losing the pattern
+_TRANSPARENT_PRIMS = {"broadcast_in_dim", "reshape", "transpose",
+                      "squeeze", "convert_element_type", "stop_gradient",
+                      "copy", "expand_dims", "slice", "neg", "mul", "add"}
+
+
+class Interpreter:
+    """One abstract interpretation of one entry point's ClosedJaxpr."""
+
+    def __init__(self, entry: str, rules: frozenset):
+        self.entry = entry
+        self.rules = rules
+        self.findings: List[Finding] = []
+        self._seen: Dict[Tuple, Finding] = {}
+        self.eqn_count = 0
+        self.top_outputs = 0
+
+    # -- findings ----------------------------------------------------------
+
+    def _emit(self, rule: str, eqn, message: str, severity: str = "error",
+              data: Optional[Dict] = None):
+        if rule not in self.rules:
+            return
+        prov = provenance(eqn)
+        path, line = finding_anchor(prov)
+        key = (rule, path, line, eqn.primitive.name)
+        if key in self._seen:
+            d = self._seen[key].data
+            if d is not None:
+                d["count"] = d.get("count", 1) + 1
+            return
+        f = Finding(engine="numerics", rule=rule, path=path, line=line,
+                    message=f"{self.entry}: {message} [at {prov}]",
+                    severity=severity,
+                    data=dict(data or {}, entry=self.entry, count=1))
+        self._seen[key] = f
+        self.findings.append(f)
+
+    # -- environment -------------------------------------------------------
+
+    def run(self, closed, in_ranges: Sequence[VRange]) -> List[VRange]:
+        const_ranges = [literal_range(c) for c in closed.consts]
+        return self._interp(closed.jaxpr, list(in_ranges), const_ranges,
+                            check=True)
+
+    def _read(self, env, atom) -> VRange:
+        import jax._src.core as jcore
+
+        if isinstance(atom, jcore.Literal):
+            return literal_range(atom.val)
+        return env.get(atom, TOP)
+
+    def _interp(self, jaxpr, in_ranges, const_ranges, check: bool
+                ) -> List[VRange]:
+        env: Dict = {}
+        defs: Dict = {}
+        for v, r in zip(jaxpr.invars, in_ranges):
+            env[v] = r
+        for v, r in zip(jaxpr.constvars, const_ranges):
+            env[v] = r
+        for eqn in jaxpr.eqns:
+            self.eqn_count += check
+            in_rs = [self._read(env, x) for x in eqn.invars]
+            out_rs = self._transfer(eqn, in_rs, env, defs, check)
+            if check:
+                self._check_eqn(eqn, in_rs, out_rs, env, defs)
+            for v, r in zip(eqn.outvars, out_rs):
+                env[v] = r
+                defs[v] = eqn
+                if check and r is TOP:
+                    self.top_outputs += 1
+        return [self._read(env, x) for x in jaxpr.outvars]
+
+    # -- sub-jaxpr recursion ----------------------------------------------
+
+    def _sub(self, sub, in_ranges, check):
+        import jax._src.core as jcore
+
+        if isinstance(sub, jcore.Jaxpr):          # open jaxpr (remat &c.)
+            sub = jcore.ClosedJaxpr(sub, [])
+        n = len(sub.jaxpr.invars)
+        ins = list(in_ranges)
+        if len(ins) >= n:
+            # tail-align: HOPs that prepend consts keep args at the end
+            ins = ins[len(ins) - n:]
+        else:
+            ins = [TOP] * (n - len(ins)) + ins
+        return self._interp(sub.jaxpr, ins,
+                            [literal_range(c) for c in sub.consts],
+                            check)
+
+    def _fix_loop(self, body_closed, const_rs, carry_rs, x_rs, n_carry,
+                  check):
+        """Fixpoint over a scan/while body: iterate with join; from the
+        third pass widen only the MOVING bound of each unstable carry
+        (an accumulator that only grows keeps its proven floor — the
+        guard that matters for div/sqrt rules), falling back to TOP if
+        even the widened carries refuse to stabilize.  A fixpoint is
+        only accepted when a further body pass stays inside it (the
+        ``joined == carry`` break), so directional widening never
+        manufactures an unverified bound.  Rule findings come from one
+        final checked pass over the stable ranges."""
+        carry = list(carry_rs)
+        stable = False
+        for it in range(5):
+            outs = self._sub(body_closed, const_rs + carry + x_rs,
+                             check=False)
+            joined = [vjoin(c, o) for c, o in zip(carry, outs[:n_carry])]
+            if joined == carry:
+                stable = True
+                break
+            if it >= 2:
+                joined = [VRange(c.lo if j.lo == c.lo else -INF,
+                                 c.hi if j.hi == c.hi else INF,
+                                 j.nonzero)
+                          for c, j in zip(carry, joined)]
+            carry = joined
+        if not stable and carry != carry_rs:
+            carry = [TOP] * n_carry
+        return self._sub(body_closed, const_rs + carry + x_rs, check), carry
+
+    # -- transfer ----------------------------------------------------------
+
+    def _transfer(self, eqn, in_rs, env, defs, check) -> List[VRange]:
+        p = eqn.primitive.name
+        n_out = len(eqn.outvars)
+        params = eqn.params
+
+        if p in ("pjit", "closed_call", "core_call", "remat",
+                 "remat2", "checkpoint", "custom_vjp_call_jaxpr"):
+            sub = params.get("jaxpr") or params.get("call_jaxpr") \
+                or params.get("fun_jaxpr")
+            if sub is not None:
+                return self._sub(sub, in_rs, check)
+            return [TOP] * n_out
+        if p in ("custom_jvp_call", "custom_vjp_call"):
+            sub = params.get("call_jaxpr") or params.get("fun_jaxpr") \
+                or params.get("jaxpr")
+            if sub is not None:
+                return self._sub(sub, in_rs, check)
+            return [TOP] * n_out
+        if p == "scan":
+            nc, nk = params["num_consts"], params["num_carry"]
+            outs, carry = self._fix_loop(
+                params["jaxpr"], in_rs[:nc], in_rs[nc:nc + nk],
+                in_rs[nc + nk:], nk, check)
+            # stacked ys: per-slice range == body output range
+            return carry + outs[nk:]
+        if p == "while":
+            cn, bn = params["cond_nconsts"], params["body_nconsts"]
+            carry_rs = in_rs[cn + bn:]
+            outs, carry = self._fix_loop(params["body_jaxpr"],
+                                         in_rs[cn:cn + bn], carry_rs, [],
+                                         len(carry_rs), check)
+            return carry
+        if p == "cond":
+            branch_outs = [self._sub(b, in_rs[1:], check)
+                           for b in params["branches"]]
+            return [vjoin(*[bo[i] for bo in branch_outs])
+                    for i in range(n_out)]
+
+        out: Optional[VRange] = None
+        if p in _IDENTITY_PRIMS:
+            out = in_rs[0]
+        elif p in _BOOL_PRIMS:
+            out = UNIT
+        elif p == "add" or p == "add_any":
+            out = vadd(in_rs[0], in_rs[1])
+        elif p == "sub":
+            out = vadd(in_rs[0], vneg(in_rs[1]))
+        elif p == "mul":
+            if len(eqn.invars) == 2 and \
+                    _origin(eqn.invars[0], defs) is _origin(eqn.invars[1],
+                                                           defs):
+                # x*x (also x*conj(x), optax abs_sq): a square, not x*y
+                out = vintpow(in_rs[0], 2)
+            else:
+                out = vmul(in_rs[0], in_rs[1])
+        elif p == "div":
+            out = vdiv(in_rs[0], in_rs[1])
+        elif p == "neg":
+            out = vneg(in_rs[0])
+        elif p == "abs":
+            out = vabs(in_rs[0])
+        elif p == "max":
+            out = vmax(in_rs[0], in_rs[1])
+        elif p == "min":
+            out = vmin(in_rs[0], in_rs[1])
+        elif p == "clamp":
+            # clamp(min, x, max) == min(max(x, min), max): compose the
+            # sound vmax/vmin transfers — a non-constant upper bound
+            # below the lower clamp yields ITS value, so the naive
+            # "clip the interval" shortcut is unsound
+            mn, x, mx = in_rs
+            out = vmin(vmax(x, mn), mx)
+        elif p == "exp" or p == "exp2":
+            out = vexp(in_rs[0])
+        elif p == "expm1":
+            out = vadd(vexp(in_rs[0]), VRange(-1.0, -1.0, True))
+        elif p == "log":
+            out = vlog(in_rs[0])
+        elif p == "log1p":
+            out = vlog(vadd(in_rs[0], VRange(1.0, 1.0, True)))
+        elif p == "sqrt":
+            out = vsqrt(in_rs[0])
+        elif p == "rsqrt":
+            out = vrsqrt(in_rs[0])
+        elif p == "integer_pow":
+            out = vintpow(in_rs[0], int(params.get("y", 1)))
+        elif p == "pow":
+            out = vpow(in_rs[0], in_rs[1])
+        elif p == "tanh":
+            out = vtanh(in_rs[0])
+        elif p == "logistic":
+            out = vlogistic(in_rs[0])
+        elif p in ("sin", "cos", "erf"):
+            out = VRange(-1.0, 1.0)
+        elif p == "sign":
+            out = VRange(-1.0, 1.0)
+        elif p == "floor":
+            out = VRange(math.floor(in_rs[0].lo) if in_rs[0].lo != -INF
+                         else -INF,
+                         math.floor(in_rs[0].hi) if in_rs[0].hi != INF
+                         else INF)
+        elif p == "ceil" or p == "round":
+            r = in_rs[0]
+            out = VRange(r.lo if r.lo == -INF else math.floor(r.lo),
+                         r.hi if r.hi == INF else math.ceil(r.hi))
+        elif p == "reduce_sum" or p == "cumsum":
+            out = vscale(in_rs[0], _reduce_count(eqn) if p == "reduce_sum"
+                         else max(1, _total_size(eqn.invars[0])))
+            if in_rs[0].nonzero and in_rs[0].lo >= 0:
+                out = VRange(out.lo, out.hi, True)
+        elif p in ("reduce_max", "reduce_min", "cummax", "cummin"):
+            out = in_rs[0]
+        elif p == "reduce_prod":
+            r = in_rs[0]
+            n = _reduce_count(eqn)
+            if r.lo >= 0:
+                out = VRange(_powf(r.lo, n) if r.lo > 0 else 0.0,
+                             _powf(r.hi, n), r.nonzero)
+            else:
+                out = TOP
+        elif p == "dot_general":
+            (lc, _), _ = params["dimension_numbers"]
+            shape = getattr(eqn.invars[0].aval, "shape", ())
+            k = 1
+            for d in lc:
+                k *= shape[d] if d < len(shape) else 1
+            out = vscale(vmul(in_rs[0], in_rs[1]), float(k))
+        elif p == "conv_general_dilated":
+            dn = params["dimension_numbers"]
+            rhs_spec = getattr(dn, "rhs_spec", None)
+            rshape = getattr(eqn.invars[1].aval, "shape", ())
+            k = 1
+            for i, d in enumerate(rshape):
+                if rhs_spec is None or i != rhs_spec[0]:
+                    k *= d
+            out = vscale(vmul(in_rs[0], in_rs[1]), float(k))
+        elif p == "select_n":
+            cases = [r for r in in_rs[1:] if r is not NAN_LITERAL]
+            out = vjoin(*cases) if cases else TOP
+        elif p == "concatenate":
+            out = vjoin(*in_rs)
+        elif p == "pad":
+            out = vjoin(in_rs[0], in_rs[1])
+        elif p == "dynamic_update_slice":
+            out = vjoin(in_rs[0], in_rs[1])
+        elif p.startswith("scatter"):
+            # combined elements must be in the join too: scatter-add
+            # reaches op+upd, scatter-mul op*upd (which can leave the
+            # plain join in either direction); min/max stay contained
+            if "add" in p:
+                out = vjoin(in_rs[0], in_rs[-1],
+                            vadd(in_rs[0], in_rs[-1]))
+            elif "mul" in p:
+                out = vjoin(in_rs[0], in_rs[-1],
+                            vmul(in_rs[0], in_rs[-1]))
+            elif p == "scatter" or "min" in p or "max" in p:
+                out = vjoin(in_rs[0], in_rs[-1])
+            else:
+                out = TOP  # unknown combiner: stay sound
+        elif p == "iota":
+            dim = params.get("dimension", 0)
+            shape = params.get("shape", (1,))
+            out = VRange(0.0, float(max(shape[dim] - 1, 0)))
+        elif p in ("argmax", "argmin"):
+            out = VRange(0.0, float(max(_total_size(eqn.invars[0]) - 1, 0)))
+        elif p == "sort":
+            return [in_rs[i] if i < len(in_rs) else TOP
+                    for i in range(n_out)]
+        elif p == "optimization_barrier":
+            return [in_rs[i] if i < len(in_rs) else TOP
+                    for i in range(n_out)]
+        elif p == "square":
+            out = vintpow(in_rs[0], 2)
+
+        if out is None:
+            return [TOP] * n_out
+        return [out] * n_out
+
+    # -- rules -------------------------------------------------------------
+
+    def _check_eqn(self, eqn, in_rs, out_rs, env, defs):
+        p = eqn.primitive.name
+        in_dt = _dtype_str(getattr(eqn.invars[0], "aval", None)) \
+            if eqn.invars else ""
+
+        if p == "sqrt" and _is_float(in_dt):
+            r = in_rs[0]
+            if r.can_be_negative:
+                self._emit(
+                    "unguarded-partial", eqn,
+                    f"sqrt of a possibly-negative operand "
+                    f"[{r.lo:.3g}, {r.hi:.3g}] — NaN in the forward; "
+                    f"clamp or prove the operand nonnegative")
+            elif r.can_be_zero:
+                self._emit(
+                    "sqrt-at-zero", eqn,
+                    f"sqrt sees an operand interval [{r.lo:.3g}, "
+                    f"{r.hi:.3g}] that includes 0 — d/dx sqrt is inf at "
+                    f"0, the NaN-gradient hazard; guard with "
+                    f"maximum(x, eps) (safe_sqrt)")
+        elif p == "rsqrt" and _is_float(in_dt):
+            r = in_rs[0]
+            if r.can_be_negative or r.can_be_zero:
+                self._emit(
+                    "unguarded-partial", eqn,
+                    f"rsqrt of an operand interval [{r.lo:.3g}, "
+                    f"{r.hi:.3g}] that reaches {'negatives' if r.can_be_negative else '0'} "
+                    f"— inf/NaN; add an eps before the rsqrt")
+        elif p in ("log", "log1p") and _is_float(in_dt):
+            r = in_rs[0] if p == "log" else vadd(in_rs[0],
+                                                 VRange(1.0, 1.0, True))
+            if r.lo <= 0 and not (r.nonzero and r.lo >= 0):
+                self._emit(
+                    "unguarded-partial", eqn,
+                    f"{p} of an operand interval [{r.lo:.3g}, "
+                    f"{r.hi:.3g}] that reaches {'<= 0' if r.lo < 0 else '0'} "
+                    f"— -inf/NaN; clamp the operand above 0")
+        elif p == "div" and _is_float(in_dt):
+            d = in_rs[1]
+            if d.can_be_zero:
+                self._emit(
+                    "unguarded-partial", eqn,
+                    f"division by an operand interval [{d.lo:.3g}, "
+                    f"{d.hi:.3g}] that includes 0 — inf/NaN; guard the "
+                    f"denominator (maximum(x, eps) or + eps)")
+        elif p == "pow" and _is_float(in_dt):
+            base, ex = in_rs
+            if base.can_be_negative and not ex.is_point:
+                self._emit(
+                    "unguarded-partial", eqn,
+                    f"pow with a possibly-negative base "
+                    f"[{base.lo:.3g}, {base.hi:.3g}] and non-constant "
+                    f"exponent — NaN on fractional exponents")
+            elif base.can_be_zero and ex.lo < 0:
+                self._emit(
+                    "unguarded-partial", eqn,
+                    "pow with a possibly-zero base and negative "
+                    "exponent — division by zero")
+        elif p == "integer_pow" and _is_float(in_dt):
+            if int(eqn.params.get("y", 1)) < 0 and in_rs[0].can_be_zero:
+                self._emit(
+                    "unguarded-partial", eqn,
+                    "x**-n with a possibly-zero x — division by zero")
+        elif p == "exp":
+            self._check_exp(eqn, in_rs, env, defs)
+        elif p == "reduce_sum":
+            out_dt = _dtype_str(getattr(eqn.outvars[0], "aval", None))
+            n = _reduce_count(eqn)
+            if out_dt in _NARROW_FLOATS and n > REDUCE_ACCUM_THRESHOLD:
+                self._emit(
+                    "bf16-accum", eqn,
+                    f"reduce_sum accumulates {n} elements in {out_dt} — "
+                    f"partial sums round at {'8' if out_dt == 'bfloat16' else '11'} "
+                    f"mantissa bits; accumulate in f32 "
+                    f"(sum(x.astype(f32)) or preferred_element_type)",
+                    data={"n": n, "dtype": out_dt})
+        elif p in ("add", "max"):
+            self._check_eps(eqn, in_rs, defs)
+
+        # dtype-overflow: a PROVEN bound past the output dtype's max, at
+        # the producing op (bf16 contraction chains) or at a downcast
+        if "dtype-overflow" in self.rules and eqn.outvars:
+            out_dt = _dtype_str(getattr(eqn.outvars[0], "aval", None))
+            if _is_float(out_dt) and out_rs and out_rs[0] is not None:
+                r = out_rs[0]
+                bound = max(abs(r.lo), abs(r.hi))
+                dmax = float_max(out_dt)
+                if (dmax is not None and math.isfinite(bound)
+                        and bound > dmax):
+                    kind = ("downcast" if p == "convert_element_type"
+                            else p)
+                    self._emit(
+                        "dtype-overflow", eqn,
+                        f"value with proven interval [{r.lo:.4g}, "
+                        f"{r.hi:.4g}] {'downcast to' if kind == 'downcast' else 'produced in'} "
+                        f"{out_dt} (max {dmax:.4g}) — overflows to inf "
+                        f"before any downstream clamp",
+                        data={"dtype": out_dt, "bound": bound})
+
+    def _check_exp(self, eqn, in_rs, env, defs):
+        in_dt = _dtype_str(getattr(eqn.invars[0], "aval", None))
+        if not _is_float(in_dt):
+            return
+        if in_dt in _NARROW_FLOATS:
+            self._emit(
+                "softmax-max-sub", eqn,
+                f"exp computed in {in_dt} — the f32-softmax convention "
+                f"(models/update.py MaskHead / ops/grid.py "
+                f"convex_upsample) requires exp/softmax to run in f32",
+                data={"dtype": in_dt})
+            return
+        r = in_rs[0]
+        dmax = float_max(in_dt) or float_max("float32")
+        if r.hi <= math.log(dmax):
+            return  # provably bounded logits need no max-subtraction
+        if self._has_max_sub(eqn.invars[0], defs):
+            return
+        self._emit(
+            "softmax-max-sub", eqn,
+            f"exp of an operand with unproven bound [{r.lo:.3g}, "
+            f"{r.hi:.3g}] and no dominating max-subtraction — softmax "
+            f"without x - max(x) overflows on the first large logit",
+            data={"ub": r.hi})
+
+    def _has_max_sub(self, var, defs, depth: int = 10) -> bool:
+        """True when ``var``'s def chain is the x - reduce_max(x)
+        pattern: a ``sub``/``add(-...)`` whose subtrahend chain reaches
+        a ``reduce_max``, crossing broadcast/convert/stop_gradient/
+        select hops in BFS over all operands (jax.nn.softmax clamps the
+        max via ``max(-inf, reduce_max(x))`` and may select around it)."""
+        import jax._src.core as jcore
+
+        def chain_has_reduce_max(root):
+            frontier, seen = [root], set()
+            for _ in range(depth):
+                nxt = []
+                for v in frontier:
+                    if isinstance(v, jcore.Literal) or id(v) in seen:
+                        continue
+                    seen.add(id(v))
+                    eqn = defs.get(v)
+                    if eqn is None:
+                        continue
+                    p = eqn.primitive.name
+                    if p in ("reduce_max", "reduce_min", "cummax"):
+                        return True
+                    if p in _TRANSPARENT_PRIMS or p in ("max", "min",
+                                                        "select_n"):
+                        nxt.extend(eqn.invars)
+                if not nxt:
+                    return False
+                frontier = nxt
+            return False
+
+        v = var
+        for _ in range(depth):
+            if isinstance(v, jcore.Literal):
+                return False
+            eqn = defs.get(v)
+            if eqn is None:
+                return False
+            p = eqn.primitive.name
+            if p in ("sub", "add"):
+                # add is commutative: (-max(x)) + x counts too, so every
+                # operand may carry the reduce_max chain
+                tail = eqn.invars if p == "add" else eqn.invars[1:]
+                if any(chain_has_reduce_max(iv) for iv in tail):
+                    return True
+                v = eqn.invars[0]
+                continue
+            if p in _TRANSPARENT_PRIMS or p == "select_n":
+                v = eqn.invars[-1] if p == "select_n" else eqn.invars[0]
+                continue
+            return False
+        return False
+
+    def _check_eps(self, eqn, in_rs, defs):
+        """eps-hygiene on add/max guards: the literal must be at least
+        the dtype's smallest normal, and for 16-bit dtypes not vanish
+        under the ulp at unit scale."""
+        if "eps-hygiene" not in self.rules:
+            return
+        consts = [(i, r) for i, r in enumerate(in_rs)
+                  if r.is_point and 0.0 < r.lo < 1e-2]
+        if not consts:
+            return
+        i, c = consts[0]
+        other = eqn.invars[1 - i] if len(eqn.invars) == 2 else None
+        dt = _dtype_str(getattr(other, "aval", None)) if other is not None \
+            else _dtype_str(getattr(eqn.outvars[0], "aval", None))
+        if not _is_float(dt):
+            return
+        tiny = float_tiny(dt)
+        if tiny is not None and c.lo < tiny:
+            self._emit(
+                "eps-hygiene", eqn,
+                f"eps literal {c.lo:.3g} guards a {dt} value but is "
+                f"below the dtype's smallest normal ({tiny:.3g}) — the "
+                f"guard flushes to zero/subnormal and protects nothing",
+                data={"eps": c.lo, "dtype": dt})
+        elif dt in _NARROW_FLOATS and c.lo < 1e-6:
+            self._emit(
+                "eps-hygiene", eqn,
+                f"eps literal {c.lo:.3g} guards a {dt} value — far "
+                f"below the dtype's ulp scale ({dt} eps is "
+                f"{'7.8e-3' if dt == 'bfloat16' else '9.8e-4'} at 1.0); "
+                f"the guard is absorbed once the operand leaves the "
+                f"subnormal range", severity="note",
+                data={"eps": c.lo, "dtype": dt})
+
+
+_VALUE_PRESERVING = {"conj", "copy", "real", "convert_element_type",
+                     "stop_gradient", "reduce_precision"}
+
+
+def _origin(var, defs):
+    """Resolve a var through sign/value-preserving unary hops (conj,
+    convert, copy, stop_gradient): lets ``x * conj(x)`` and
+    ``x * x.astype(...)`` register as squares (their product cannot be
+    negative — rounding and conjugation preserve sign)."""
+    import jax._src.core as jcore
+
+    for _ in range(6):
+        if isinstance(var, jcore.Literal):
+            return var
+        eqn = defs.get(var)
+        if eqn is None or eqn.primitive.name not in _VALUE_PRESERVING:
+            return var
+        var = eqn.invars[0]
+    return var
+
+
+def _total_size(var) -> int:
+    shape = getattr(getattr(var, "aval", None), "shape", ())
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def finding_anchor(prov: str) -> Tuple[str, int]:
+    """(path, line) from a provenance string ("a.py:12 via b.py:3")."""
+    first = prov.split(" via ")[0]
+    m = re.match(r"(.+):(\d+)$", first)
+    if m:
+        return m.group(1), int(m.group(2))
+    return first, 0
+
+
+# --------------------------------------------------------------------------
+# declared input specs
+# --------------------------------------------------------------------------
+
+def declared_ranges(args) -> List[VRange]:
+    """Flat per-leaf ranges for an entry's abstract args, assigned by
+    pytree key path — the audit's documented input assumptions:
+
+    - images in [0, 255] (uint8 pixels decoded to f32),
+    - ground-truth flow in [-1000, 1000] px (max_flow is 400; the spec
+      leaves slack for the wire's clip),
+    - valid masks in [0, 1],
+    - param leaves within +/-PARAM_BOUND (trained weights; stated
+      assumption, not a theorem),
+    - optimizer second moments (``nu``) in [0, MOMENT_BOUND]; first
+      moments (``mu``) within +/-MOMENT_BOUND; running variances
+      nonnegative,
+    - step counters in [0, 1e9]; everything else TOP.
+    """
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(args)[0]
+    out = []
+    for path, _leaf in leaves:
+        name = jax.tree_util.keystr(path).lower()
+        # optimizer-state moments FIRST: the state tree repeats every
+        # param name (flow_head, ...), so batch-key matches must never
+        # see it
+        if ".nu[" in name or name.endswith(".nu"):
+            out.append(VRange(0.0, MOMENT_BOUND))
+        elif ".mu[" in name or name.endswith(".mu"):
+            out.append(VRange(-MOMENT_BOUND, MOMENT_BOUND))
+        elif "count" in name or name.endswith(".step"):
+            out.append(VRange(0.0, 1e9))
+        elif "'mean'" in name:
+            out.append(VRange(-MOMENT_BOUND, MOMENT_BOUND))
+        elif "'var'" in name:
+            out.append(VRange(0.0, MOMENT_BOUND))
+        elif "image" in name:
+            out.append(VRange(0.0, 255.0))
+        elif "'flow'" in name:
+            out.append(VRange(-1000.0, 1000.0))
+        elif "'valid'" in name:
+            out.append(UNIT)
+        elif "params" in name or "batch_stats" in name:
+            out.append(VRange(-PARAM_BOUND, PARAM_BOUND))
+        else:
+            out.append(TOP)
+    return out
+
+
+def fmap_ranges(args) -> List[VRange]:
+    """Input ranges for the corr-lookup entries: feature maps within
+    +/-FMAP_BOUND, coordinates within the (tiny) audit extent."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten(args)[0]
+    out = []
+    for i, _leaf in enumerate(leaves):
+        if i == len(leaves) - 1:      # coords are the last arg
+            out.append(VRange(-16.0, 16.0))
+        else:
+            out.append(VRange(-FMAP_BOUND, FMAP_BOUND))
+    return out
+
+
+# --------------------------------------------------------------------------
+# entries
+# --------------------------------------------------------------------------
+
+ALL_RULES = frozenset({"dtype-overflow", "unguarded-partial",
+                       "sqrt-at-zero", "bf16-accum", "softmax-max-sub",
+                       "eps-hygiene"})
+# deep model entries skip dtype-overflow: a non-relational bound through
+# a 30-conv stack is either widened to inf or vacuously finite — the
+# overflow proof is meaningful on the shallow, spec-bounded programs
+DEEP_RULES = ALL_RULES - {"dtype-overflow"}
+
+
+class SkipEntry(Exception):
+    """Environment prerequisite absent — runner reports a note."""
+
+
+@dataclasses.dataclass(frozen=True)
+class NumEntry:
+    name: str
+    builder: Callable[[], Tuple[Callable, tuple, List[VRange]]]
+    rules: frozenset = ALL_RULES
+    pallas: bool = False          # run the Pallas kernel verifier too
+    budgeted: bool = True         # fixtures never get ledger records
+
+
+def _mesh_or_skip():
+    import jax
+
+    from raft_tpu.parallel.mesh import virtual_device_mesh
+
+    mesh = virtual_device_mesh()
+    if mesh is None:
+        raise SkipEntry(
+            f"needs 8 devices, have {jax.device_count()} (run via "
+            f"`python -m raft_tpu.analysis`, which forces 8 virtual "
+            f"CPU devices)")
+    return mesh
+
+
+def _build_train_step():
+    from raft_tpu.training.step import abstract_train_step
+
+    step, (state_sds, batch_sds) = abstract_train_step(
+        iters=2, add_noise=True)
+    return step, (state_sds, batch_sds), declared_ranges(
+        (state_sds, batch_sds))
+
+
+def _build_train_step_bf16():
+    from raft_tpu.training.step import abstract_train_step
+
+    step, (state_sds, batch_sds) = abstract_train_step(
+        iters=2,
+        overrides={"compute_dtype": "bfloat16", "corr_dtype": "bfloat16"})
+    return step, (state_sds, batch_sds), declared_ranges(
+        (state_sds, batch_sds))
+
+
+def _build_parallel_step():
+    from raft_tpu.parallel.mesh import set_mesh
+    from raft_tpu.parallel.step import abstract_parallel_step
+
+    mesh = _mesh_or_skip()
+    step, (state_sds, batch_sds) = abstract_parallel_step(mesh, iters=2)
+
+    class _Ctx:
+        def __enter__(self):
+            self._cm = set_mesh(mesh)
+            return self._cm.__enter__()
+
+        def __exit__(self, *a):
+            return self._cm.__exit__(*a)
+
+    return step, (state_sds, batch_sds), declared_ranges(
+        (state_sds, batch_sds)), _Ctx()
+
+
+def _build_eval_forward():
+    from raft_tpu.evaluation.evaluate import abstract_eval_forward
+
+    fwd, (variables_sds, img_sds, _) = abstract_eval_forward(iters=2)
+    args = (variables_sds, img_sds, img_sds)
+    return fwd, args, declared_ranges(args)
+
+
+def _build_corr(kind):
+    from raft_tpu.ops.corr import abstract_corr_lookup
+
+    fn, args = abstract_corr_lookup(kind)
+    return fn, args, fmap_ranges(args)
+
+
+def _build_corr_pallas():
+    from raft_tpu.ops.corr_pallas import abstract_ondemand_lookup
+
+    fn, args = abstract_ondemand_lookup(grad=True)
+    return fn, args, fmap_ranges(args)
+
+
+def _build_pyramid_pallas():
+    from raft_tpu.ops.corr_pallas import abstract_pyramid_lookup
+
+    fn, args = abstract_pyramid_lookup(grad=True)
+    return fn, args, fmap_ranges(args)
+
+
+def _build_pyramid_pallas_stacked():
+    from raft_tpu.ops.corr_pallas import abstract_pyramid_lookup
+
+    fn, args = abstract_pyramid_lookup(stacked=True, grad=True)
+    return fn, args, fmap_ranges(args)
+
+
+ENTRIES: Dict[str, NumEntry] = {
+    "train_step": NumEntry("train_step", _build_train_step,
+                           rules=DEEP_RULES),
+    "train_step_bf16": NumEntry("train_step_bf16", _build_train_step_bf16,
+                                rules=DEEP_RULES),
+    "parallel_step": NumEntry("parallel_step", _build_parallel_step,
+                              rules=DEEP_RULES),
+    "eval_forward": NumEntry("eval_forward", _build_eval_forward,
+                             rules=DEEP_RULES),
+    "corr_lookup_dense": NumEntry("corr_lookup_dense",
+                                  lambda: _build_corr("dense")),
+    "corr_lookup_chunked": NumEntry("corr_lookup_chunked",
+                                    lambda: _build_corr("chunked")),
+    "corr_lookup_pallas": NumEntry("corr_lookup_pallas",
+                                   _build_corr_pallas, pallas=True),
+    "corr_pyramid_pallas": NumEntry("corr_pyramid_pallas",
+                                    _build_pyramid_pallas, pallas=True),
+    "corr_pyramid_pallas_stacked": NumEntry(
+        "corr_pyramid_pallas_stacked", _build_pyramid_pallas_stacked,
+        pallas=True),
+}
+
+
+# --------------------------------------------------------------------------
+# seeded fixtures — deliberately broken, never run by default
+# --------------------------------------------------------------------------
+
+def _fixture_bf16_overflow():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        # a bf16 contraction chain whose PROVEN bound crosses bf16 max:
+        # |x| <= 1e10 -> x*x <= 1e20 -> 256-dim dot <= 2.6e42 > 3.39e38
+        y = x * x
+        z = jnp.einsum("ij,kj->ik", y, y,
+                       preferred_element_type=jnp.float32)
+        return z.astype(jnp.bfloat16)
+
+    sds = jax.ShapeDtypeStruct((8, 256), jnp.float32)
+    return jax.jit(fn), (sds,), [VRange(0.0, 1e10)]
+
+
+def _fixture_unguarded_sqrt():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(flow_gt):
+        # the PRE-FIX training/loss.py magnitude: bare sqrt of a sum of
+        # squares — NaN gradient at exactly-zero flow (fixed in the
+        # tree by safe_sqrt; this fixture pins the hazard)
+        mag = jnp.sqrt(jnp.sum(flow_gt.astype(jnp.float32) ** 2, axis=-1))
+        return jnp.mean(mag)
+
+    sds = jax.ShapeDtypeStruct((2, 8, 8, 2), jnp.float32)
+    return jax.jit(fn), (sds,), [VRange(-400.0, 400.0)]
+
+
+def _fixture_bf16_reduce():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def fn(x):
+        # a 4096-element reduction with a bf16 ACCUMULATOR (jnp.sum
+        # would auto-upcast to f32; lax.reduce keeps the hazard)
+        return jax.lax.reduce(x, np.asarray(0, jnp.bfloat16),
+                              jax.lax.add, (1,))
+
+    sds = jax.ShapeDtypeStruct((4, 4096), jnp.bfloat16)
+    return jax.jit(fn), (sds,), [VRange(-1.0, 1.0)]
+
+
+def _fixture_softmax_nomax():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(logits):
+        e = jnp.exp(logits)          # no max-subtraction
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    sds = jax.ShapeDtypeStruct((4, 64), jnp.float32)
+    return jax.jit(fn), (sds,), [VRange(-1000.0, 1000.0)]
+
+
+def _fixture_eps_hygiene():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        # 1e-7 is below float16's smallest normal (6.1e-5): the guard
+        # flushes to a subnormal and the rsqrt stays effectively bare
+        return jax.lax.rsqrt(x + jnp.float16(1e-7))
+
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.float16)
+    return jax.jit(fn), (sds,), [VRange(0.0, 100.0)]
+
+
+FIXTURE_ENTRIES: Dict[str, NumEntry] = {
+    "seeded_bf16_overflow": NumEntry("seeded_bf16_overflow",
+                                     _fixture_bf16_overflow),
+    "seeded_unguarded_sqrt": NumEntry("seeded_unguarded_sqrt",
+                                      _fixture_unguarded_sqrt),
+    "seeded_bf16_reduce": NumEntry("seeded_bf16_reduce",
+                                   _fixture_bf16_reduce),
+    "seeded_softmax_nomax": NumEntry("seeded_softmax_nomax",
+                                     _fixture_softmax_nomax),
+    "seeded_eps_hygiene": NumEntry("seeded_eps_hygiene",
+                                   _fixture_eps_hygiene),
+}
+
+
+def _pallas_fixtures():
+    # defined in pallas_audit to keep the kernel plumbing in one place;
+    # items() forces the lazy fill (dict.update's fast path would
+    # bypass the subclass overrides and merge nothing)
+    from raft_tpu.analysis import pallas_audit
+
+    return dict(pallas_audit.FIXTURE_ENTRIES.items())
+
+
+# --------------------------------------------------------------------------
+# the audit
+# --------------------------------------------------------------------------
+
+def _note(entry: str, message: str) -> Finding:
+    return Finding(engine="numerics", rule="numerics-audit", path=entry,
+                   line=0, message=message, severity="note")
+
+
+def _apply_waivers(findings: List[Finding]) -> List[Finding]:
+    return apply_data_waivers(findings, WAIVERS)
+
+
+def run_numerics_audit(names: Optional[Sequence[str]] = None,
+                       budgets_path: Optional[str] = None,
+                       update: bool = False
+                       ) -> Tuple[List[Finding], Dict]:
+    """Run the named numerics audits (default: every non-fixture entry).
+
+    Traces each entry's builder, abstract-interprets the jaxpr under
+    the declared input specs, and — for entries carrying Pallas kernels
+    — runs the static kernel verifier against the ``pallas_vmem``
+    ledger section (``update=True`` re-baselines it, merge semantics).
+    Returns ``(findings, report)``.
+    """
+    import jax
+
+    from raft_tpu.analysis import pallas_audit
+
+    all_entries = dict(ENTRIES)
+    all_entries.update(FIXTURE_ENTRIES)
+    all_entries.update(_pallas_fixtures())
+    if names is None:
+        selected = list(ENTRIES)
+    else:
+        unknown = [n for n in names if n not in all_entries]
+        if unknown:
+            raise KeyError(f"unknown numerics audit(s) {unknown}; known: "
+                           f"{sorted(all_entries)}")
+        selected = list(names)
+
+    findings: List[Finding] = []
+    report: Dict = {}
+    pallas_measurements: Dict[str, Dict] = {}
+    for name in selected:
+        entry = all_entries[name]
+        t0 = time.monotonic()
+        try:
+            built = entry.builder()
+        except SkipEntry as e:
+            findings.append(_note(name, f"skipped: {e}"))
+            continue
+        except ImportError as e:
+            findings.append(_note(name, f"skipped: unavailable here ({e})"))
+            continue
+        if len(built) == 4:
+            fn, args, ranges, ctx = built
+        else:
+            fn, args, ranges = built
+            ctx = None
+        try:
+            if ctx is not None:
+                with ctx:
+                    closed = jax.make_jaxpr(fn)(*args)
+            else:
+                closed = jax.make_jaxpr(fn)(*args)
+        except (TypeError, ValueError, NotImplementedError,
+                jax.errors.JAXTypeError) as e:
+            findings.append(_note(
+                name, f"skipped: does not trace on this jax "
+                      f"({type(e).__name__}: {e})"))
+            continue
+        interp = Interpreter(name, entry.rules)
+        interp.run(closed, ranges)
+        findings.extend(interp.findings)
+        entry_report = {
+            "eqns": interp.eqn_count,
+            "top_outputs": interp.top_outputs,
+            "findings": len(interp.findings),
+            "seconds": round(time.monotonic() - t0, 2),
+        }
+        if entry.pallas:
+            pfs, pmeas = pallas_audit.audit_entry_kernels(name, closed)
+            findings.extend(pfs)
+            if entry.budgeted:
+                pallas_measurements.update(pmeas)
+            entry_report["pallas_kernels"] = sorted(pmeas)
+        report[name] = entry_report
+
+    pfs, preport = pallas_audit.compare_budgets(
+        pallas_measurements, budgets_path=budgets_path, update=update)
+    findings.extend(pfs)
+    if preport:
+        report["pallas_vmem"] = preport
+    return _apply_waivers(findings), report

@@ -1,0 +1,430 @@
+"""Pallas static kernel verifier (runs under graftlint engine 4).
+
+The Pallas kernels in ``ops/corr_pallas.py`` encode three families of
+facts that nothing else checks before hardware: the grid/BlockSpec
+geometry (a block shape that does not divide its array silently
+truncates or masks), the index maps (an index map that can address one
+block past the end reads garbage or faults at Mosaic compile time, on
+the chip, mid-run), and the VMEM footprint (the module docstring's
+hand-computed double-buffer budget — which this pass now derives
+mechanically from the BlockSpecs and pins in the ledger).
+
+The verifier never executes or Mosaic-compiles anything: it walks the
+traced jaxpr of the abstract entry points, finds every ``pallas_call``
+equation, and checks each one statically:
+
+- ``pallas-divisibility`` — every BlockSpec dimension must divide its
+  array dimension (the kernels here rely on caller-side padding; a
+  non-dividing block means silently unwritten tail elements).
+- ``pallas-oob-index`` — each block mapping's ``index_map`` jaxpr is
+  evaluated over the (tiny, abstract-entry) grid — all points when the
+  grid is small, the corners otherwise — and every returned block
+  index must land inside ``ceil(dim / block)`` blocks.
+- ``pallas-vmem-cap`` — the double-buffered VMEM footprint (2x every
+  input/output block + scratch) must fit :data:`VMEM_CAP_BYTES` (16
+  MiB/core); a kernel that cannot fit is broken on every TPU
+  regardless of ledger state.
+- ``pallas-vmem-budget`` / ``pallas-launch-count`` — the footprint and
+  the per-kernel ``pallas_call`` count are compared against the
+  ``pallas_vmem`` section of ``budgets.json`` (``--update-budgets``
+  re-baselines by merge, same flow as engine 3's entries; commit the
+  diff).  Footprints are upper bounds (improvements never fail); call
+  counts compare exactly — the round-4 "96 launches per train step"
+  regression class.
+
+Kernel facts are trace-structural (shapes and specs, no compiler), so
+ledger records are platform-independent and never demoted on a
+toolchain mismatch.
+
+``FIXTURE_ENTRIES`` carries the deliberately-broken kernels (an
+oversized BlockSpec that cannot fit VMEM, a mis-sized BlockSpec with
+an out-of-bounds index map); tests select them with ``--audits``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.analysis import budgets as budgets_mod
+from raft_tpu.analysis.findings import Finding
+from raft_tpu.analysis.jaxpr_audit import iter_eqns
+
+VMEM_CAP_BYTES = 16 * 1024 * 1024
+# full-product index-map sweep below this many grid points; corners only
+# above (abstract entries keep grids tiny, so this is rarely binding)
+_GRID_SWEEP_LIMIT = 128
+
+_NAME_SRC_RE = re.compile(r"(\S+)\s+at\s+(.+?):(\d+)")
+
+
+def _kernel_anchor(eqn) -> Tuple[str, str, int]:
+    """(kernel_name, repo-relative path, line) of a pallas_call eqn."""
+    info = str(eqn.params.get("name_and_src_info", ""))
+    m = _NAME_SRC_RE.search(info)
+    if m:
+        return (m.group(1), budgets_mod.display_path(m.group(2)),
+                int(m.group(3)))
+    name = info.split(" ")[0] or "pallas_kernel"
+    return name, name, 0
+
+
+def _block_dims(block_shape) -> Tuple[int, ...]:
+    return tuple(1 if d is None else int(d) for d in block_shape)
+
+
+def _itemsize(dtype) -> int:
+    import numpy as np
+
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return 4
+
+
+def _scratch_bytes(eqn, gm) -> int:
+    n_scratch = getattr(gm, "num_scratch_operands", 0)
+    if not n_scratch:
+        return 0
+    body = eqn.params.get("jaxpr")
+    invars = getattr(body, "invars", [])
+    total = 0
+    for v in invars[len(invars) - n_scratch:]:
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", ())
+        total += math.prod(shape) * _itemsize(getattr(aval, "dtype",
+                                                      "float32"))
+    return total
+
+
+def measure_pallas_call(eqn) -> Dict:
+    """Static facts of one pallas_call eqn: anchor, grid, and the
+    double-buffered VMEM footprint (2x in/out blocks + scratch)."""
+    gm = eqn.params["grid_mapping"]
+    grid = tuple(int(g) for g in getattr(gm, "static_grid", gm.grid))
+    vmem = 0
+    blocks = []
+    for bm in gm.block_mappings:
+        dims = _block_dims(bm.block_shape)
+        sds = bm.array_shape_dtype
+        nbytes = math.prod(dims) * _itemsize(sds.dtype)
+        vmem += 2 * nbytes
+        blocks.append({"block": dims, "array": tuple(sds.shape),
+                       "bytes": nbytes})
+    vmem += _scratch_bytes(eqn, gm)
+    name, path, line = _kernel_anchor(eqn)
+    return {"kernel": name, "path": path, "line": line, "grid": grid,
+            "blocks": blocks, "vmem_bytes": int(vmem)}
+
+
+def _eval_index_map(closed, idxs) -> Optional[Tuple[int, ...]]:
+    import jax._src.core as jcore
+
+    try:
+        outs = jcore.eval_jaxpr(closed.jaxpr, closed.consts,
+                                *[int(i) for i in idxs])
+        return tuple(int(o) for o in outs)
+    # graftlint: disable=silent-except -- an index_map that this host
+    # evaluation cannot run (exotic primitive, symbolic dim) is exactly
+    # the "statically unevaluable: skip the bounds check" semantic;
+    # there is nothing actionable to log per grid point
+    except Exception:
+        return None
+
+
+def _grid_points(grid):
+    total = math.prod(grid) if grid else 0
+    if not grid or total == 0:
+        return []
+    if total <= _GRID_SWEEP_LIMIT:
+        return list(itertools.product(*[range(g) for g in grid]))
+    corners = itertools.product(*[(0, g - 1) if g > 1 else (0,)
+                                  for g in grid])
+    return list(corners)
+
+
+def check_pallas_call(entry: str, eqn,
+                      facts: Optional[Dict] = None) -> List[Finding]:
+    """Divisibility, index-map bounds and the hard VMEM cap for one
+    pallas_call (ledger-independent structural rules).  ``facts``
+    reuses a caller's :func:`measure_pallas_call` result."""
+    gm = eqn.params["grid_mapping"]
+    if facts is None:
+        facts = measure_pallas_call(eqn)
+    name, path, line = facts["kernel"], facts["path"], facts["line"]
+    out: List[Finding] = []
+
+    for i, bm in enumerate(gm.block_mappings):
+        dims = _block_dims(bm.block_shape)
+        arr = tuple(bm.array_shape_dtype.shape)
+        for d, (a, b) in enumerate(zip(arr, dims)):
+            if b and a % b:
+                out.append(Finding(
+                    engine="numerics", rule="pallas-divisibility",
+                    path=path, line=line,
+                    message=f"{entry}: kernel {name} operand {i} dim "
+                            f"{d}: block {b} does not divide array "
+                            f"extent {a} — the kernels rely on "
+                            f"caller-side padding; a non-dividing "
+                            f"block leaves a silently-masked tail",
+                    data={"entry": entry, "kernel": name, "operand": i,
+                          "dim": d, "array": a, "block": b}))
+
+    grid = facts["grid"]
+    points = _grid_points(grid)
+    for i, bm in enumerate(gm.block_mappings):
+        dims = _block_dims(bm.block_shape)
+        arr = tuple(bm.array_shape_dtype.shape)
+        nblocks = [max(1, -(-a // b)) if b else 1
+                   for a, b in zip(arr, dims)]
+        for pt in points:
+            idx = _eval_index_map(bm.index_map_jaxpr, pt)
+            if idx is None:
+                break
+            bad = [d for d, (j, nb) in enumerate(zip(idx, nblocks))
+                   if j < 0 or j >= nb]
+            if bad:
+                d = bad[0]
+                out.append(Finding(
+                    engine="numerics", rule="pallas-oob-index",
+                    path=path, line=line,
+                    message=f"{entry}: kernel {name} operand {i} "
+                            f"index_map at grid point {pt} returns "
+                            f"block index {idx[d]} on dim {d} "
+                            f"(array {arr[d]}, block {dims[d]}: "
+                            f"{nblocks[d]} blocks) — addresses out of "
+                            f"bounds",
+                    data={"entry": entry, "kernel": name, "operand": i,
+                          "dim": d, "index": idx[d],
+                          "nblocks": nblocks[d]}))
+                break
+
+    if facts["vmem_bytes"] > VMEM_CAP_BYTES:
+        out.append(Finding(
+            engine="numerics", rule="pallas-vmem-cap", path=path,
+            line=line,
+            message=f"{entry}: kernel {name} double-buffered VMEM "
+                    f"footprint {facts['vmem_bytes']} bytes exceeds "
+                    f"the {VMEM_CAP_BYTES} byte/core cap — this "
+                    f"BlockSpec cannot fit VMEM on any TPU; shrink the "
+                    f"block or re-tile the grid",
+            data={"entry": entry, "kernel": name,
+                  "vmem_bytes": facts["vmem_bytes"]}))
+    return out
+
+
+def audit_entry_kernels(entry: str, closed
+                        ) -> Tuple[List[Finding], Dict[str, Dict]]:
+    """All pallas_calls of one traced entry: structural findings plus
+    the per-kernel ledger measurements (max footprint over calls, call
+    count, anchor)."""
+    findings: List[Finding] = []
+    meas: Dict[str, Dict] = {}
+    for eqn, _ in iter_eqns(closed):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        facts = measure_pallas_call(eqn)
+        findings.extend(check_pallas_call(entry, eqn, facts))
+        key = f"{entry}/{facts['kernel']}"
+        rec = meas.setdefault(key, {
+            "vmem_bytes": 0, "calls": 0,
+            "_path": facts["path"], "_line": facts["line"]})
+        rec["vmem_bytes"] = max(rec["vmem_bytes"], facts["vmem_bytes"])
+        rec["calls"] += 1
+    return findings, meas
+
+
+def compare_budgets(measurements: Dict[str, Dict],
+                    budgets_path: Optional[str] = None,
+                    update: bool = False) -> Tuple[List[Finding], Dict]:
+    """Measured kernel facts vs the ledger's ``pallas_vmem`` section.
+
+    ``vmem_bytes`` is an upper bound (growth fails, improvement is a
+    note past 2x slack); ``calls`` compares exactly.  ``update=True``
+    merge-writes the section instead (commit the budgets.json diff).
+    Kernels with a cap violation still gate via the structural rule —
+    the ledger can never sanction an unfittable block.
+    """
+    if not measurements and not update:
+        return [], {}
+    ledger_path = budgets_path or budgets_mod.default_budgets_path()
+    ledger = budgets_mod.load_budgets(ledger_path) or {}
+    section = ledger.get("pallas_vmem", {})
+    findings: List[Finding] = []
+    report: Dict = {}
+
+    clean = {k: {"vmem_bytes": v["vmem_bytes"], "calls": v["calls"]}
+             for k, v in measurements.items()}
+    report["measured"] = clean
+
+    if update:
+        if not clean:
+            # nothing measured (no pallas entry selected): a merge of
+            # zero records would be a silent no-op write — skip it
+            report["budgets_written"] = {"kernels": []}
+            return findings, report
+        meta = ledger.get("meta") or {}
+        budgets_mod.save_budgets(ledger_path, meta or None, clean,
+                                 section="pallas_vmem")
+        report["budgets_written"] = {
+            "path": budgets_mod.display_path(ledger_path),
+            "kernels": sorted(clean)}
+        return findings, report
+
+    disp = budgets_mod.display_path(ledger_path)
+    for key, m in sorted(measurements.items()):
+        rec = section.get(key)
+        anchor_path, anchor_line = m["_path"], m["_line"]
+        if rec is None:
+            findings.append(Finding(
+                engine="numerics", rule="budget-missing", path=disp,
+                line=0,
+                message=f"pallas kernel '{key}' has no pallas_vmem "
+                        f"ledger record — run `python -m "
+                        f"raft_tpu.analysis --engine numerics "
+                        f"--update-budgets` and commit the "
+                        f"budgets.json diff",
+                data={"kernel": key}))
+            continue
+        if m["vmem_bytes"] > rec.get("vmem_bytes", 0):
+            findings.append(Finding(
+                engine="numerics", rule="pallas-vmem-budget",
+                path=disp,
+                line=budgets_mod.budget_line(ledger_path, key,
+                                             "vmem_bytes"),
+                message=f"{key}: VMEM footprint rose to "
+                        f"{m['vmem_bytes']} bytes (budget "
+                        f"{rec.get('vmem_bytes', 0)}) — a BlockSpec "
+                        f"grew; if intentional, re-baseline with "
+                        f"--update-budgets and commit the diff",
+                data={"kernel": key, "got": m["vmem_bytes"],
+                      "want": rec.get("vmem_bytes", 0)}))
+        elif (rec.get("vmem_bytes", 0) >= 2 * max(m["vmem_bytes"], 1)
+              and rec.get("vmem_bytes", 0) > 4096):
+            findings.append(Finding(
+                engine="numerics", rule="budget-slack", path=disp,
+                line=budgets_mod.budget_line(ledger_path, key,
+                                             "vmem_bytes"),
+                message=f"{key}: VMEM footprint improved to "
+                        f"{m['vmem_bytes']} bytes (budget "
+                        f"{rec.get('vmem_bytes', 0)}) — tighten with "
+                        f"--update-budgets to lock the win in",
+                severity="note", data={"kernel": key}))
+        want_calls = rec.get("calls", 0)
+        if m["calls"] != want_calls:
+            grew = m["calls"] > want_calls
+            findings.append(Finding(
+                engine="numerics", rule="pallas-launch-count",
+                path=anchor_path if grew else disp,
+                line=anchor_line if grew else budgets_mod.budget_line(
+                    ledger_path, key, "calls"),
+                message=f"{key}: {m['calls']} pallas_call launches vs "
+                        f"{want_calls} in the ledger — "
+                        f"{'launch-count regression (the round-4 96-launches class)' if grew else 'the kernel launches fewer times; re-baseline if intentional'}",
+                data={"kernel": key, "got": m["calls"],
+                      "want": want_calls}))
+    stale = sorted(set(section) - set(measurements))
+    if stale and measurements:
+        # only meaningful on a full default run; partial --audits runs
+        # legitimately measure a subset
+        report["not_measured"] = stale
+    return findings, report
+
+
+# --------------------------------------------------------------------------
+# seeded fixtures (NumEntry-shaped; registered by numerics_audit)
+# --------------------------------------------------------------------------
+
+def _fixture_oversized():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def fn(x):
+        # one (1024, 2048) f32 block is 8 MiB; double-buffered in+out
+        # is 32 MiB — no TPU core can fit it
+        return pl.pallas_call(
+            kernel, grid=(1,),
+            in_specs=[pl.BlockSpec((1024, 2048), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((1024, 2048), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1024, 2048), jnp.float32),
+            interpret=True)(x)
+
+    sds = jax.ShapeDtypeStruct((1024, 2048), jnp.float32)
+    from raft_tpu.analysis.numerics_audit import VRange
+
+    return jax.jit(fn), (sds,), [VRange(-1.0, 1.0)]
+
+
+def _fixture_missized():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def fn(x):
+        # 96 % 64 != 0 (mis-sized BlockSpec) AND the output index_map
+        # addresses one block past the end
+        return pl.pallas_call(
+            kernel, grid=(2,),
+            in_specs=[pl.BlockSpec((64, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((64, 128), lambda i: (i + 1, 0)),
+            out_shape=jax.ShapeDtypeStruct((96, 128), jnp.float32),
+            interpret=True)(x)
+
+    sds = jax.ShapeDtypeStruct((96, 128), jnp.float32)
+    from raft_tpu.analysis.numerics_audit import VRange
+
+    return jax.jit(fn), (sds,), [VRange(-1.0, 1.0)]
+
+
+def _fixture_entries():
+    from raft_tpu.analysis.numerics_audit import NumEntry
+
+    return {
+        "seeded_pallas_oversized": NumEntry(
+            "seeded_pallas_oversized", _fixture_oversized, pallas=True,
+            budgeted=False),
+        "seeded_pallas_missized": NumEntry(
+            "seeded_pallas_missized", _fixture_missized, pallas=True,
+            budgeted=False),
+    }
+
+
+class _LazyFixtures(dict):
+    """Materialized on first access so importing this module never
+    pulls numerics_audit (and vice versa) at import time."""
+
+    def _fill(self):
+        if not self:
+            self.update(_fixture_entries())
+
+    def __iter__(self):
+        self._fill()
+        return super().__iter__()
+
+    def __contains__(self, k):
+        self._fill()
+        return super().__contains__(k)
+
+    def __getitem__(self, k):
+        self._fill()
+        return super().__getitem__(k)
+
+    def keys(self):
+        self._fill()
+        return super().keys()
+
+    def items(self):
+        self._fill()
+        return super().items()
+
+
+FIXTURE_ENTRIES = _LazyFixtures()

@@ -1515,13 +1515,20 @@ pyramid_window_lookup.defvjp(_pyr_lookup_fwd, _pyr_lookup_bwd)
 
 
 def abstract_ondemand_lookup(batch: int = 1, hw=(8, 8), channels: int = 16,
-                             radius: int = 4, num_levels: int = 4):
+                             radius: int = 4, num_levels: int = 4,
+                             grad: bool = False):
     """Lowerable Pallas-lookup entry point for the static-analysis
     engines.  Off-TPU this lowers through the kernel's interpret-mode
     fallback (``_on_tpu`` dispatch), which is exactly what CPU callers
     of ``corr_impl="ondemand"`` execute — so the audit covers the
     fallback path's lowering, while Mosaic-specific behavior stays a
     hardware concern (``RAFT_TESTS_ON_DEVICE=1``).
+
+    ``grad=True`` differentiates a scalar reduction of the lookup with
+    respect to both feature maps, so the trace also carries the fused
+    backward kernels (``_bwd_df1_kernel`` / ``_bwd_df2_kernel``) — the
+    Pallas verifier (graftlint engine 4) audits their BlockSpecs and
+    VMEM footprints from this one entry.
 
     Returns ``(fn, (f1_sds, f2_sds, coords_sds))`` with ``fn``
     supporting ``.lower()``.  Raises ImportError where pallas itself is
@@ -1533,8 +1540,57 @@ def abstract_ondemand_lookup(batch: int = 1, hw=(8, 8), channels: int = 16,
     f_sds = jax.ShapeDtypeStruct((batch, H, W, channels), jnp.float32)
     coords_sds = jax.ShapeDtypeStruct((batch, H, W, 2), jnp.float32)
 
-    def fn(f1, f2, coords):
+    def fwd(f1, f2, coords):
         pyr = tuple(build_fmap_pyramid(f2, num_levels))
         return ondemand_corr_lookup(f1, pyr, coords, radius=radius)
 
+    if grad:
+        fn = jax.grad(lambda f1, f2, c: jnp.sum(fwd(f1, f2, c)),
+                      argnums=(0, 1))
+    else:
+        fn = fwd
+    return jax.jit(fn), (f_sds, f_sds, coords_sds)
+
+
+def abstract_pyramid_lookup(stacked: bool = False, grad: bool = True,
+                            batch: int = 1, hw=(8, 8), channels: int = 16,
+                            radius: int = 4, num_levels: int = 4,
+                            q_tile: int = 64):
+    """Lowerable dense-pyramid fused-lookup entry point (the all-pairs
+    training path's Pallas kernels) for the static-analysis engines.
+
+    ``stacked=False`` builds the padded per-level pyramid and rides
+    ``pyramid_window_lookup`` (one launch per level);  ``stacked=True``
+    builds the uniform-slot stack and rides
+    ``pyramid_window_lookup_stacked`` (one launch total).  ``grad=True``
+    differentiates a scalar reduction w.r.t. both feature maps so the
+    deferred cotangent kernels appear in the same trace — the Pallas
+    verifier audits grid/BlockSpec geometry, index maps and VMEM
+    footprints for the forward AND backward kernels from here.
+
+    Returns ``(fn, (f1_sds, f2_sds, coords_sds))`` with ``fn``
+    supporting ``.lower()``.
+    """
+    from raft_tpu.ops.corr import (build_corr_pyramid_padded,
+                                   build_corr_pyramid_stacked)
+
+    H, W = hw
+    f_sds = jax.ShapeDtypeStruct((batch, H, W, channels), jnp.float32)
+    coords_sds = jax.ShapeDtypeStruct((batch, H, W, 2), jnp.float32)
+
+    def fwd(f1, f2, coords):
+        if stacked:
+            st = build_corr_pyramid_stacked(f1, f2, num_levels,
+                                            q_pad_to=q_tile)
+            return pyramid_window_lookup_stacked(st, coords, radius,
+                                                 (H, W), q_tile)
+        pyr = tuple(build_corr_pyramid_padded(f1, f2, num_levels,
+                                              q_pad_to=q_tile))
+        return pyramid_window_lookup(pyr, coords, radius, (H, W), q_tile)
+
+    if grad:
+        fn = jax.grad(lambda f1, f2, c: jnp.sum(fwd(f1, f2, c)),
+                      argnums=(0, 1))
+    else:
+        fn = fwd
     return jax.jit(fn), (f_sds, f_sds, coords_sds)

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from raft_tpu.wire import WIRE_FLOW_MAX, decode_flow, decode_valid
 from raft_tpu.obs.health import nonfinite_sentinel
-from raft_tpu.training.loss import sequence_loss
+from raft_tpu.training.loss import safe_sqrt, sequence_loss
 from raft_tpu.training.state import TrainState
 
 
@@ -241,6 +241,11 @@ def abstract_train_step(iters: int = 2, donate: bool = False,
 
 
 def optax_global_norm(tree) -> jax.Array:
+    # guarded at f32's smallest normal: identical for any nonzero
+    # gradient, and the sqrt's operand is provably positive for the
+    # numerics auditor (sqrt-at-zero) — the all-zero-grads norm reads
+    # ~1.1e-19 instead of 0, far below any threshold that consumes it
     leaves = jax.tree.leaves(tree)
-    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
-                        for x in leaves))
+    return safe_sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                         for x in leaves),
+                     eps=float(jnp.finfo(jnp.float32).tiny))

@@ -1,21 +1,24 @@
 #!/usr/bin/env python
-"""graftlint gate: all three analysis engines, exit nonzero on findings.
+"""graftlint gate: all four analysis engines, exit nonzero on findings.
 
 Thin wrapper over ``python -m raft_tpu.analysis`` so CI lanes and
 pre-push hooks have a stable entry point:
 
-    python scripts/graftlint.py                  # full gate: lint + jaxpr + hlo
-    python scripts/graftlint.py --engine lint    # sub-second, jax-free
-    python scripts/graftlint.py --json           # machine-readable
-    python scripts/graftlint.py --list-waivers   # waiver inventory
+    python scripts/graftlint.py                   # full gate: lint + jaxpr + hlo + numerics
+    python scripts/graftlint.py --engine lint     # sub-second, jax-free
+    python scripts/graftlint.py --engine numerics # dtype/range + Pallas verifier
+    python scripts/graftlint.py --json            # machine-readable
+    python scripts/graftlint.py --list-waivers    # waiver inventory
 
-The full gate fans the three engines out as PARALLEL subprocesses —
+The full gate fans the four engines out as PARALLEL subprocesses —
 they are independent (each forces its own 8-virtual-device CPU
 backend), so the wall clock is max(engine) rather than sum(engine):
-~65 s on this container vs ~105 s serial, comfortably inside the 120 s
-CI budget.  A per-engine timing line is printed either way.  Any other
-flag combination (a single --engine, --update-budgets, --list-waivers,
-explicit paths) delegates to the module CLI in-process.
+the HLO engine's compiles dominate (numerics traces in ~25-40 s),
+keeping the whole gate around ~100 s wall vs ~130 s serial and inside
+the tier-1 timeout budget.  A per-engine timing line is printed
+either way.  Any other flag combination (a single --engine,
+--update-budgets, --list-waivers, explicit paths) delegates to the
+module CLI in-process.
 
 Exit code 0 = clean (all remaining findings carry waivers with
 reasons); 1 = at least one unwaived finding; 2 = usage error.  See
@@ -32,7 +35,7 @@ import time
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
-ENGINES = ("lint", "jaxpr", "hlo")
+ENGINES = ("lint", "jaxpr", "hlo", "numerics")
 
 
 def parallel_gate(json_out: bool, verbose: bool) -> int:

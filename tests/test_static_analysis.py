@@ -1,14 +1,15 @@
 """graftlint: one minimal failing fixture per lint rule, per jaxpr
-invariant and per HLO-audit rule, plus the repo-wide clean-run gates
-(all three engines must pass over the tree as committed — this is the
-tier-1 lint lane).
+invariant, per HLO-audit rule and per numerics-audit rule, plus the
+repo-wide clean-run gates (all four engines must pass over the tree as
+committed — this is the tier-1 lint lane).
 
 Everything here is CPU-only and fast-lane (no ``slow`` marker): the AST
-fixtures are string literals, the jaxpr fixtures are tiny abstract
-traces, the HLO parser/budget fixtures are pure text/dicts, and the
-repo gates reuse one audit run per engine via module-scoped fixtures
-(the HLO gate is the only one that compiles — ~1 min, the engine's
-whole cost).
+fixtures are string literals, the jaxpr/numerics fixtures are tiny
+abstract traces, the HLO parser/budget fixtures are pure text/dicts,
+and the repo gates reuse one audit run per engine via module-scoped
+fixtures (the HLO gate is the only one that compiles — ~1 min, the
+engine's whole cost; the numerics gate traces in ~25 s and its fixture
+asserts that stays inside the tier-1 budget).
 """
 
 from __future__ import annotations
@@ -799,3 +800,423 @@ def test_cli_gate_contract(tmp_path):
                       "x = np.float64(0)"
                       "  # graftlint: disable=f64-literal -- fixture\n")
     assert main(["--engine", "lint", str(waived)]) == 0
+
+
+# --------------------------------------------------------------------------
+# numerics engine (engine 4): interval-lattice unit tests
+# --------------------------------------------------------------------------
+
+from raft_tpu.analysis import numerics_audit as na  # noqa: E402
+from raft_tpu.analysis import pallas_audit as pa    # noqa: E402
+from raft_tpu.analysis.numerics_audit import VRange  # noqa: E402
+
+
+def test_vrange_lattice_basics():
+    assert na.vadd(VRange(1.0, 2.0), VRange(3.0, 4.0)) == VRange(4.0, 6.0,
+                                                                 True)
+    assert na.vmul(VRange(-2.0, 3.0), VRange(-1.0, 4.0)) == \
+        VRange(-8.0, 12.0)
+    # division by an interval touching zero is unbounded, never crashes
+    assert na.vdiv(VRange(1.0, 1.0, True), VRange(0.0, 2.0)) is na.TOP
+    d = na.vdiv(VRange(1.0, 4.0, True), VRange(2.0, 2.0, True))
+    assert (d.lo, d.hi) == (0.5, 2.0)
+    # maximum against a positive constant proves positivity — the
+    # mechanical effect of a maximum(x, eps) guard
+    g = na.vmax(VRange(0.0, 10.0), VRange(1e-12, 1e-12, True))
+    assert g.lo == 1e-12 and not g.can_be_zero
+    # exp is provably nonzero even when its lower bound underflows to 0
+    e = na.vexp(na.TOP)
+    assert e.lo == 0.0 and e.nonzero and not e.can_be_zero
+
+
+def test_clamp_and_scatter_transfers_are_sound():
+    # clamp with a NON-constant upper bound outputs that bound itself:
+    # sqrt(clamp(1.0, x, t)) with traced t must still flag
+    def f(x, t):
+        return jnp.sqrt(jax.lax.clamp(1.0, x, t))
+
+    jx = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((4,), jnp.float32),
+                           jax.ShapeDtypeStruct((4,), jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    it.run(jx, [na.TOP, VRange(-5.0, 5.0)])
+    assert any(f.rule == "unguarded-partial" for f in it.findings)
+    # constant bounds keep the proof working
+    jx = jax.make_jaxpr(lambda x: jnp.sqrt(jax.lax.clamp(1.0, x, 9.0)))(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    it.run(jx, [na.TOP])
+    assert it.findings == []
+    # scatter-mul reaches op*upd: [0.5,0.6] elements can fall to 0.25
+    def g(x, u):
+        return x.at[0].multiply(u)
+
+    jx = jax.make_jaxpr(g)(jax.ShapeDtypeStruct((4,), jnp.float32),
+                           jax.ShapeDtypeStruct((), jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    (out,) = it.run(jx, [VRange(0.5, 0.6, True), VRange(0.5, 0.6, True)])
+    assert out.lo <= 0.25 and out.hi >= 0.6
+
+
+def test_vrange_widens_past_horizon():
+    r = na.vmul(VRange(0.0, 1e40), VRange(0.0, 1e40))
+    assert r.hi == float("inf"), "vacuously-finite bounds must widen"
+    assert VRange(0.0, 1e59).hi == 1e59  # under the horizon: kept
+
+
+def test_interpreter_proves_squares_and_guards():
+    def guarded(x):
+        return jnp.sqrt(jnp.maximum(jnp.sum(x ** 2, axis=-1), 1e-12))
+
+    jx = jax.make_jaxpr(guarded)(jax.ShapeDtypeStruct((4, 2), jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    it.run(jx, [VRange(-400.0, 400.0)])
+    assert it.findings == [], [f.render() for f in it.findings]
+
+    def bare(x):
+        return jnp.sqrt(jnp.sum(x ** 2, axis=-1))
+
+    jx = jax.make_jaxpr(bare)(jax.ShapeDtypeStruct((4, 2), jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    it.run(jx, [VRange(-400.0, 400.0)])
+    assert [f.rule for f in it.findings] == ["sqrt-at-zero"]
+
+
+def test_interpreter_sees_through_conj_square_and_nan_sentinel():
+    # optax abs_sq: x * conj(x) must register as a square (nonnegative)
+    def norm_via_conj(x):
+        sq = (jnp.conj(x) * x).real
+        return jnp.sqrt(jnp.sum(sq) + 1e-8)
+
+    jx = jax.make_jaxpr(norm_via_conj)(jax.ShapeDtypeStruct((8,),
+                                                            jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    it.run(jx, [na.TOP])
+    assert it.findings == [], [f.render() for f in it.findings]
+
+    # jnp.var carries a where(ok, var, nan) ddof sentinel: the literal
+    # NaN branch must not unprove the variance's nonnegativity
+    def instance_norm_denom(x):
+        return jnp.sqrt(x.var(axis=0) + 1e-5)
+
+    jx = jax.make_jaxpr(instance_norm_denom)(
+        jax.ShapeDtypeStruct((16, 4), jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    it.run(jx, [na.TOP])
+    assert it.findings == [], [f.render() for f in it.findings]
+
+
+def test_interpreter_scan_fixpoint_widens_directionally():
+    """A scan accumulator keeps its proven floor (directional widening)
+    so a division by it stays provably safe; a sign-unconstrained
+    accumulator widens fully and the division flags."""
+    def f(xs):
+        def body(c, x):
+            c = c + x
+            return c, x / c
+        return jax.lax.scan(body, 1.0, xs)
+
+    jx = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((8,), jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    outs = it.run(jx, [na.VRange(0.0, 2.0)])
+    assert it.findings == [] and outs[0].lo == 1.0 and outs[0].nonzero
+    it = na.Interpreter("t", na.ALL_RULES)
+    outs = it.run(jx, [na.VRange(-2.0, 2.0)])
+    assert [f.rule for f in it.findings] == ["unguarded-partial"]
+    assert outs[0] == na.TOP
+
+
+def test_interpreter_softmax_max_sub_recognized():
+    jx = jax.make_jaxpr(lambda x: jax.nn.softmax(x, axis=-1))(
+        jax.ShapeDtypeStruct((4, 16), jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    it.run(jx, [na.TOP])
+    assert [f.rule for f in it.findings] == [], \
+        [f.render() for f in it.findings]
+    # bounded logits need no max-subtraction either
+    jx = jax.make_jaxpr(lambda x: jnp.exp(x))(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    it.run(jx, [VRange(-5.0, 5.0)])
+    assert it.findings == []
+    # the commuted add form (-max(x)) + x is max-subtraction too
+    def commuted(x):
+        return jnp.exp(jnp.negative(jnp.max(x, axis=-1, keepdims=True))
+                       + x)
+
+    jx = jax.make_jaxpr(commuted)(jax.ShapeDtypeStruct((4, 8),
+                                                       jnp.float32))
+    it = na.Interpreter("t", na.ALL_RULES)
+    it.run(jx, [na.TOP])
+    assert it.findings == [], [f.render() for f in it.findings]
+
+
+def test_numerics_waivers_are_provenance_scoped():
+    f = fmod.Finding(engine="numerics", rule="sqrt-at-zero", path="x",
+                     line=0, message="sqrt ... [at a.py:1 via "
+                                     "optax/_src/transform.py:236]")
+    (w,) = na._apply_waivers([f])
+    assert w.waived and "optax" in w.waiver_reason
+    g = fmod.Finding(engine="numerics", rule="sqrt-at-zero", path="x",
+                     line=0, message="sqrt ... [at raft_tpu/foo.py:1]")
+    (kept,) = na._apply_waivers([g])
+    assert not kept.waived
+
+
+# --------------------------------------------------------------------------
+# numerics engine: seeded fixtures each trip exit 1 with file:line
+# --------------------------------------------------------------------------
+
+def _numerics_fixture_findings(name):
+    findings, _ = na.run_numerics_audit([name])
+    return [f for f in findings if not f.waived and f.severity == "error"]
+
+
+def test_seeded_bf16_overflow_chain_trips():
+    out = _numerics_fixture_findings("seeded_bf16_overflow")
+    hits = [f for f in out if f.rule == "dtype-overflow"
+            and f.data.get("dtype") == "bfloat16"]
+    assert hits, [f.render() for f in out]
+    assert hits[0].path.endswith("numerics_audit.py") and hits[0].line > 0
+
+
+def test_seeded_unguarded_sqrt_pins_prefix_loss_code(capsys):
+    """The pre-fix training/loss.py magnitude formula (bare sqrt of a
+    sum of squares) must exit 1 via the CLI with file:line attribution
+    — and the fixed tree must be silent (the clean gate below)."""
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--engine", "numerics", "--audits",
+               "seeded_unguarded_sqrt", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    hits = [f for f in payload["findings"]
+            if f["rule"] == "sqrt-at-zero" and not f["waived"]]
+    assert hits
+    assert hits[0]["path"].endswith("numerics_audit.py")
+    assert hits[0]["line"] > 0
+
+
+def test_seeded_long_bf16_reduce_trips():
+    out = _numerics_fixture_findings("seeded_bf16_reduce")
+    hits = [f for f in out if f.rule == "bf16-accum"]
+    assert hits and hits[0].data["n"] == 4096
+    assert hits[0].line > 0
+
+
+def test_seeded_softmax_and_eps_fixtures_trip():
+    out = _numerics_fixture_findings("seeded_softmax_nomax")
+    assert any(f.rule == "softmax-max-sub" for f in out)
+    out = _numerics_fixture_findings("seeded_eps_hygiene")
+    hits = [f for f in out if f.rule == "eps-hygiene"]
+    assert hits and hits[0].data["dtype"] == "float16"
+
+
+def test_seeded_missized_blockspec_trips(capsys):
+    """The mis-sized BlockSpec fixture: non-dividing block AND an
+    out-of-bounds index_map, each file:line attributed, exit 1."""
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--engine", "numerics", "--audits",
+               "seeded_pallas_missized", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    rules = {f["rule"] for f in payload["findings"] if not f["waived"]}
+    assert "pallas-divisibility" in rules and "pallas-oob-index" in rules
+    for f in payload["findings"]:
+        if f["rule"].startswith("pallas-"):
+            assert f["path"].endswith("pallas_audit.py") and f["line"] > 0
+
+
+def test_seeded_oversized_blockspec_trips_vmem_cap():
+    out = _numerics_fixture_findings("seeded_pallas_oversized")
+    hits = [f for f in out if f.rule == "pallas-vmem-cap"]
+    assert hits and hits[0].data["vmem_bytes"] > pa.VMEM_CAP_BYTES
+
+
+# --------------------------------------------------------------------------
+# numerics engine: pallas budget ledger (pure fixtures, no traces)
+# --------------------------------------------------------------------------
+
+def _pallas_meas(**overrides):
+    base = {"vmem_bytes": 1000, "calls": 4, "_path": "x.py", "_line": 7}
+    base.update(overrides)
+    return {"e/k": base}
+
+
+@pytest.fixture()
+def pallas_ledger(tmp_path):
+    path = tmp_path / "budgets.json"
+    bmod.save_budgets(str(path), {"platform": "cpu"},
+                      {"e/k": {"vmem_bytes": 1000, "calls": 4}},
+                      section="pallas_vmem")
+    return str(path)
+
+
+def test_pallas_budget_compare_clean_growth_and_launches(pallas_ledger):
+    fs, _ = pa.compare_budgets(_pallas_meas(), budgets_path=pallas_ledger)
+    assert fs == []
+    fs, _ = pa.compare_budgets(_pallas_meas(vmem_bytes=2000),
+                               budgets_path=pallas_ledger)
+    assert [f.rule for f in fs] == ["pallas-vmem-budget"]
+    assert fs[0].line > 0     # points at the ledger's vmem_bytes line
+    fs, _ = pa.compare_budgets(_pallas_meas(calls=5),
+                               budgets_path=pallas_ledger)
+    (f,) = [x for x in fs if x.rule == "pallas-launch-count"]
+    assert (f.path, f.line) == ("x.py", 7)   # growth anchors at the kernel
+    fs, _ = pa.compare_budgets({"e/other": _pallas_meas()["e/k"]},
+                               budgets_path=pallas_ledger)
+    assert [f.rule for f in fs] == ["budget-missing"]
+
+
+def test_pallas_budget_update_heals_and_merges(pallas_ledger):
+    fs, report = pa.compare_budgets(_pallas_meas(vmem_bytes=4000),
+                                    budgets_path=pallas_ledger,
+                                    update=True)
+    assert report["budgets_written"]["kernels"] == ["e/k"]
+    healed = bmod.load_budgets(pallas_ledger)
+    assert healed["pallas_vmem"]["e/k"]["vmem_bytes"] == 4000
+    fs, _ = pa.compare_budgets(_pallas_meas(vmem_bytes=4000),
+                               budgets_path=pallas_ledger)
+    assert fs == []
+
+
+def test_engine3_rebaseline_preserves_pallas_section(tmp_path):
+    """save_budgets merges per section: an engine-3 entries write must
+    never drop engine 4's pallas_vmem records (and vice versa)."""
+    path = tmp_path / "budgets.json"
+    bmod.save_budgets(str(path), {"platform": "cpu"},
+                      {"e/k": {"vmem_bytes": 1, "calls": 1}},
+                      section="pallas_vmem")
+    bmod.save_budgets(str(path), {"platform": "cpu", "jax": "x"},
+                      {"train_step": {"flops": 1.0}})
+    payload = bmod.load_budgets(str(path))
+    assert payload["pallas_vmem"]["e/k"]["calls"] == 1
+    assert payload["entries"]["train_step"]["flops"] == 1.0
+    assert payload["meta"]["jax"] == "x"
+
+
+def test_pallas_vmem_ledger_checked_in():
+    """budgets.json ships the pallas_vmem section covering every
+    default pallas-carrying entry's kernels (regenerate ONLY via
+    --engine numerics --update-budgets)."""
+    payload = bmod.load_budgets()
+    section = payload.get("pallas_vmem", {})
+    assert section, "budgets.json must carry the pallas_vmem section"
+    budgeted = [n for n, e in na.ENTRIES.items() if e.pallas and e.budgeted]
+    for name in budgeted:
+        assert any(k.startswith(name + "/") for k in section), \
+            f"no pallas_vmem record for entry '{name}' — run " \
+            f"--engine numerics --update-budgets"
+    for rec in section.values():
+        assert rec["vmem_bytes"] <= pa.VMEM_CAP_BYTES
+        assert rec["calls"] >= 1
+    # fixtures must never be baselined
+    assert not any(k.startswith("seeded_") for k in section)
+
+
+# --------------------------------------------------------------------------
+# numerics engine: repo-wide clean-run gate + timing budget
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def numerics_results():
+    import time
+
+    if jax.device_count() < 8:
+        pytest.skip("numerics audit gate needs the 8-device CPU harness")
+    t0 = time.monotonic()
+    findings, report = na.run_numerics_audit()
+    return findings, report, time.monotonic() - t0
+
+
+def test_numerics_gate_repo_clean(numerics_results):
+    findings, _, _ = numerics_results
+    gating = fmod.gate(findings)
+    assert gating == [], "\n" + "\n".join(f.render() for f in gating)
+    assert all(f.waiver_reason for f in findings if f.waived)
+    # the sanctioned waivers: optax/flax provenance + the bf16
+    # param-gradient reductions — every one carries a reason above
+    assert any(f.waived and f.rule == "sqrt-at-zero" for f in findings)
+
+
+def test_numerics_report_and_timing_budget(numerics_results):
+    findings, report, elapsed = numerics_results
+    # the engine must keep the 4-way parallel graftlint wall under the
+    # tier-1 timeout: solo it traces in ~25 s on this container; 100 s
+    # is the gate's documented ceiling
+    assert elapsed < 100, f"numerics engine took {elapsed:.0f}s"
+    # the deep entries were actually interpreted, not skipped
+    assert report["train_step"]["eqns"] > 1000
+    assert report["train_step_bf16"]["eqns"] > 1000
+    # pallas measurements cover forward AND backward kernels
+    measured = report["pallas_vmem"]["measured"]
+    assert "corr_lookup_pallas/_blocked_kernel" in measured
+    assert "corr_lookup_pallas/_bwd_df1_kernel" in measured
+    # the stacked one-launch variant really is one launch per direction
+    assert measured[
+        "corr_pyramid_pallas_stacked/_pyr_lookup_stacked_kernel"][
+        "calls"] == 1
+
+
+def test_numerics_cli_json_and_timing_line(capsys):
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--engine", "numerics", "--audits", "seeded_eps_hygiene",
+               "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    rebuilt = [fmod.Finding(**f) for f in payload["findings"]]
+    assert {f.engine for f in rebuilt} == {"numerics"}
+    assert payload["report"]["engine_timings"]["numerics"] >= 0
+    # non-json runs print the per-engine timing line
+    rc = main(["--engine", "numerics", "--audits", "seeded_eps_hygiene"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "numerics=" in out.splitlines()[-1]
+
+
+def test_numerics_cli_usage_errors_exit_2():
+    from raft_tpu.analysis.__main__ import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "numerics", "--audits", "no_such_audit"])
+    assert e.value.code == 2
+    # --update-budgets is sanctioned for numerics (the pallas_vmem
+    # section) but still not for lint/jaxpr
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "jaxpr", "--update-budgets"])
+    assert e.value.code == 2
+    # a numerics audit that can never write a ledger record (no pallas
+    # kernels / a fixture) must refuse, not silently no-op
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "numerics", "--update-budgets",
+              "--audits", "train_step"])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "numerics", "--update-budgets",
+              "--audits", "seeded_pallas_missized"])
+    assert e.value.code == 2
+
+
+def test_numerics_list_waivers_coverage(capsys):
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--list-waivers"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "numerics_audit.py" in out
+    assert "optax/" in out and "flax/linen/normalization.py" in out
+    assert "numerics" in out.splitlines()[-1]   # the per-engine tally
+
+
+def test_graftlint_wrapper_fans_out_four_engines():
+    """The CI wrapper must run all four engines in parallel — the
+    per-engine timing line is its contract with the tier-1 budget."""
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_script", os.path.join(root, "scripts", "graftlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.ENGINES == ("lint", "jaxpr", "hlo", "numerics")

@@ -70,6 +70,53 @@ def test_sequence_loss_matches_reference():
                                    rtol=1e-4, atol=1e-6)
 
 
+def test_safe_sqrt_parity_on_nonzero_inputs():
+    """The safe-norm fix (graftlint engine 4's sqrt-at-zero finding)
+    must not move the loss: for any operand >= eps, safe_sqrt is
+    BIT-identical to bare sqrt, and the full sequence_loss on nonzero
+    flows matches the pre-fix bare-sqrt formula to well under 1e-6."""
+    from raft_tpu.training.loss import flow_metrics, safe_sqrt
+
+    x = jnp.asarray(RNG.uniform(1e-10, 1e4, size=(64,)).astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(safe_sqrt(x)),
+                                  np.asarray(jnp.sqrt(x)))
+
+    B, H, W = 2, 8, 10
+    # nonzero flows everywhere: |flow| >= ~0.1 px, so every sum of
+    # squares clears safe_sqrt's 1e-12 clamp by 10 orders of magnitude
+    flow = RNG.uniform(0.1, 5.0, size=(B, H, W, 2)).astype(np.float32) \
+        * np.where(RNG.uniform(size=(B, H, W, 2)) < 0.5, -1, 1)
+    gt = RNG.uniform(0.1, 5.0, size=(B, H, W, 2)).astype(np.float32)
+    valid = np.ones((B, H, W), np.float32)
+    m = flow_metrics(jnp.asarray(flow), jnp.asarray(gt), jnp.asarray(valid))
+    bare_epe = np.sqrt(((flow - gt) ** 2).sum(-1))
+    np.testing.assert_allclose(float(m["epe"]), bare_epe.mean(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_epe_gradient_finite_at_exactly_zero_flow():
+    """The hazard the numerics auditor flags: d/dx sqrt(sum(x^2)) is
+    NaN at x == 0.  The safe-norm loss must return a finite (zero)
+    gradient there, where the bare-sqrt formula returns NaN."""
+    from raft_tpu.training.loss import flow_metrics
+
+    zero = jnp.zeros((1, 4, 4, 2), jnp.float32)
+    valid = jnp.ones((1, 4, 4), jnp.float32)
+
+    def epe_of(pred):
+        return flow_metrics(pred, zero, valid)["epe"]
+
+    g = jax.grad(epe_of)(zero)
+    assert np.isfinite(np.asarray(g)).all(), "safe-norm gradient must be finite"
+
+    def bare_epe_of(pred):   # the pre-fix formula, pinned
+        return jnp.sqrt(jnp.sum(pred ** 2, axis=-1)).mean()
+
+    g_bare = jax.grad(bare_epe_of)(zero)
+    assert not np.isfinite(np.asarray(g_bare)).all(), \
+        "the bare formula should NaN at zero — else this test is vacuous"
+
+
 def test_onecycle_schedule_shape():
     sched = onecycle_linear_schedule(4e-4, 1000, pct_start=0.05)
     lrs = np.array([float(sched(i)) for i in range(0, 1001, 10)])
