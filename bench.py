@@ -11,14 +11,20 @@ bf16 compute) — the primary metric named in BASELINE.json.
 - ``value``: device-rate pairs/s, synthetic resident batch (pure step time).
 - ``mfu``: model FLOPs utilization — XLA's analyzed FLOPs per step divided
   by (step time x chip peak bf16 FLOP/s).
-- ``fed_pairs_per_s``: same step fed by the real host pipeline
-  (SyntheticShift + dense augmentor -> DataLoader -> prefetch_to_device).
-  Interpret against ``host_cores``: generation + dense augmentation cost
-  ~27 ms of CPU per sample, so a 1-core host (this tunnel environment)
-  tops out near 5 fed pairs/s no matter the loader design — the loader
-  itself sustains 37 samples/s standalone-with-aug and 111/s without
-  (scripts/data_bench.py), and a real TPU VM host (>= 100 cores) feeds
-  the 31 pairs/s device rate with one core per worker x 4 workers.
+- ``fed_pairs_per_s``: same step fed by the real host pipeline, on the
+  lane the train CLI's auto policy would run (``fed_lane``) — with an
+  accelerator attached, DEVICE-SIDE augmentation (SyntheticShift raw
+  frames + aug params -> DataLoader -> prefetch_to_device ->
+  data/device_aug.py jitted graph: the host only generates frames and
+  samples parameters; photometric/eraser/resize/flip/crop run on-chip
+  inside the h2d lane).  Both lanes are always reported:
+  ``fed_pairs_per_s_device`` and ``fed_pairs_per_s_host`` (the
+  numpy/cv2 parity fallback).  Interpret against ``host_cores``:
+  generation + dense augmentation cost ~27 ms of CPU per sample, which
+  capped the round-5 fed rate at 11.2 pairs/s on this 1-core tunnel
+  host against a 34 pairs/s device rate — the ~3x input-bound gap the
+  device-aug lane exists to close (the loader alone sustains 37
+  samples/s with host aug and 111/s without, scripts/data_bench.py).
 
 Baseline: the reference repo publishes no numbers (BASELINE.md).  The
 denominator used here is 7.0 pairs/s — an A100 estimate derived from the
@@ -128,11 +134,18 @@ def _peak_flops(device) -> float:
     return 0.0
 
 
-def _make_fed_loader(B, H, W, seed: int = 1):
+def _make_fed_loader(B, H, W, seed: int = 1, device_aug: bool = False):
     """Host pipeline for the fed benchmark: procedural image pairs run
     through the real dense augmentor (jitter/scale/crop — the chairs
-    recipe's host-side cost), batched and prefetched by the real loader."""
+    recipe's host-side cost), batched and prefetched by the real loader.
+
+    ``device_aug=True`` is the split pipeline (raft_tpu/data/device_aug):
+    the host only generates frames and samples aug params; the dense
+    augmentation runs as a jitted batch on the accelerator, fused into
+    the h2d lane.  Returns ``(loader, device_fn)`` — device_fn is None
+    on the host-augmented path."""
     from raft_tpu.data.datasets import SyntheticShift
+    from raft_tpu.data.device_aug import make_device_augment
     from raft_tpu.data.loader import DataLoader
 
     ds = SyntheticShift(
@@ -140,14 +153,19 @@ def _make_fed_loader(B, H, W, seed: int = 1):
         aug_params=dict(crop_size=(H, W), min_scale=0.0, max_scale=0.2,
                         do_flip=True),
         wire_format="int16")
-    # Workers capped at the core count: on the 1-core tunnel host, 4
-    # threads time-slicing one core add GIL/scheduler thrash on top of
-    # the ~27 ms/sample augment cost — the source of the round-4 fed
-    # lane's 2x run-to-run spread (6.5-10.8 pairs/s); a worker per core
-    # is the stable configuration, and real TPU-VM hosts have >= 4.
-    workers = max(1, min(4, os.cpu_count() or 4))
-    return DataLoader(ds, batch_size=B, num_workers=workers,
-                      drop_last=True, seed=seed, prefetch=3)
+    device_fn = None
+    if device_aug:
+        ds.enable_device_aug()
+        device_fn = make_device_augment((H, W), sparse=False,
+                                        wire_format="int16")
+    # Workers capped at the core count (loader.default_num_workers): on
+    # the 1-core tunnel host, 4 threads time-slicing one core add
+    # GIL/scheduler thrash on top of the ~27 ms/sample augment cost —
+    # the source of the round-4 fed lane's 2x run-to-run spread
+    # (6.5-10.8 pairs/s); a worker per core is the stable configuration,
+    # and real TPU-VM hosts have >= 4.
+    return DataLoader(ds, batch_size=B, num_workers=None,
+                      drop_last=True, seed=seed, prefetch=3), device_fn
 
 
 def main():
@@ -352,40 +370,73 @@ def main():
     health.sample_memory(n_steps)
     spans.flush(n_steps)
 
-    # Fed variant: identical step, batches produced by the host pipeline.
-    fed_pairs_per_s = 0.0
-    try:
-        loader = _make_fed_loader(B, H, W)
+    # Fed variants: identical step, batches produced by the real host
+    # pipeline.  Two lanes, so the device-aug win is measured rather
+    # than asserted: ``device`` ships raw frames + aug params and runs
+    # the dense augmentation on-chip (data/device_aug.py — the default
+    # production path); ``host`` runs the numpy/cv2 augmentor (the
+    # parity fallback, ~27 ms of host CPU per sample).
+    def _fed_lane(device_aug: bool):
+        nonlocal state, metrics
+        loader, device_fn = _make_fed_loader(B, H, W, device_aug=device_aug)
         from raft_tpu.data.loader import prefetch_to_device
-        it = prefetch_to_device(iter(loader), size=2)
-        fed0 = next(it)  # warm the pipeline (+ any reshape recompile)
-        state, metrics = step(state, fed0)
-        float(metrics["loss"])
-        # 30 timed fed steps (vs 10 for the device lane): the fed number
-        # is host-bound on this 1-core tunnel host; a longer window plus
-        # the worker-per-core loader cap above bounds the run-to-run
-        # spread that round 4 measured at 2x
-        n_fed = 2 if tiny else 30
-        t0 = time.perf_counter()
-        for _ in range(n_fed):
-            with spans.span("data"):
-                fed_batch = next(it)
-            with spans.span("dispatch"):
-                state, metrics = step(state, fed_batch)
-            spans.step_boundary()
-        float(metrics["loss"])
-        fed_pairs_per_s = B * n_fed / (time.perf_counter() - t0)
-        spans.flush(n_fed)
-        it.close()  # join the loader's worker pool cleanly (an abandoned
-        # generator otherwise tears down its executor at interpreter
-        # exit, after threading internals are gone)
+        it = prefetch_to_device(iter(loader), size=2, device_fn=device_fn)
+        try:
+            fed0 = next(it)  # warm the pipeline (+ any reshape recompile)
+            state, metrics = step(state, fed0)
+            float(metrics["loss"])
+            # 30 timed fed steps (vs 10 for the device lane): the fed
+            # number is host-bound on this 1-core tunnel host; a longer
+            # window plus the worker-per-core loader cap bounds the
+            # run-to-run spread that round 4 measured at 2x
+            n_fed = 2 if tiny else 30
+            t0 = time.perf_counter()
+            for _ in range(n_fed):
+                with spans.span("data"):
+                    fed_batch = next(it)
+                with spans.span("dispatch"):
+                    state, metrics = step(state, fed_batch)
+                spans.step_boundary()
+            float(metrics["loss"])
+            rate = B * n_fed / (time.perf_counter() - t0)
+            spans.flush(n_fed)
+        finally:
+            # join the loader's worker pool even when this lane dies:
+            # an abandoned pool would compete with the NEXT lane's
+            # timing for the single host core, and an abandoned
+            # generator tears down its executor at interpreter exit,
+            # after threading internals are gone
+            it.close()
+        return rate
+
+    fed_dev = 0.0                # device-aug path
+    fed_pairs_per_s_host = 0.0   # host-aug parity fallback
+    try:
+        fed_dev = _fed_lane(device_aug=True)
     except Exception as e:  # the fed lane must never sink the scoreboard
-        print(f"fed bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        print(f"fed bench (device aug) failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        fed_pairs_per_s_host = _fed_lane(device_aug=False)
+    except Exception as e:
+        print(f"fed bench (host aug) failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    # The headline fed lane mirrors the train CLI's auto policy: device
+    # aug on an accelerator, host aug on a CPU backend (where the
+    # matmul resample loses — an RAFT_BENCH_ALLOW_CPU smoke must not
+    # report the lane production would never run).  Both lanes stay in
+    # the output, so the comparison is always visible.
+    fed_pairs_per_s = fed_dev if platform != "cpu" else fed_pairs_per_s_host
+    fed_lane = "device" if platform != "cpu" else "host"
 
     if ledger is not None:
         ledger.close(summary=health.summary()
                      | {"pairs_per_s": round(pairs_per_s, 3),
-                        "fed_pairs_per_s": round(fed_pairs_per_s, 3)})
+                        "fed_pairs_per_s": round(fed_pairs_per_s, 3),
+                        "fed_pairs_per_s_device": round(fed_dev, 3),
+                        "fed_pairs_per_s_host":
+                            round(fed_pairs_per_s_host, 3),
+                        "fed_lane": fed_lane})
 
     print(json.dumps({
         "metric": "image-pairs/sec/chip",
@@ -398,6 +449,9 @@ def main():
         "step_ms": {k: round(1000 * step_pct[k], 2)
                     for k in ("p50", "p95", "max")},
         "fed_pairs_per_s": round(fed_pairs_per_s, 3),
+        "fed_lane": fed_lane,
+        "fed_pairs_per_s_device": round(fed_dev, 3),
+        "fed_pairs_per_s_host": round(fed_pairs_per_s_host, 3),
         "host_cores": os.cpu_count(),
         "deferred_corr_grad": deferred,
         **({"tiny": True} if tiny else {}),

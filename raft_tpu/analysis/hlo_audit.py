@@ -278,6 +278,18 @@ def _build_corr_ring():
     return abstract_ring_lookup(_audit_mesh())
 
 
+def _build_device_aug():
+    from raft_tpu.data.device_aug import abstract_device_aug
+
+    return abstract_device_aug(sparse=False)
+
+
+def _build_device_aug_sparse():
+    from raft_tpu.data.device_aug import abstract_device_aug
+
+    return abstract_device_aug(sparse=True, wire_format="f32")
+
+
 def _build_seeded_missharded():
     """Deliberate regression fixture: the dense lookup with its batch
     sharded over ``data`` but a REPLICATED forced output — the classic
@@ -331,6 +343,15 @@ ENTRIES: Dict[str, HloEntry] = {
         forbid=("all-gather", "all-gather-start", "all-to-all",
                 "ragged-all-to-all"),
         require=("collective-permute",)),
+    # the h2d-lane augmentation graphs (data/device_aug.py): strictly
+    # single-device programs — any collective means a sharding
+    # annotation leaked into the input pipeline
+    "device_aug": HloEntry(
+        "device_aug", _build_device_aug,
+        ("raft_tpu.data.device_aug", "abstract_device_aug")),
+    "device_aug_sparse": HloEntry(
+        "device_aug_sparse", _build_device_aug_sparse,
+        ("raft_tpu.data.device_aug", "abstract_device_aug")),
 }
 
 FIXTURE_ENTRIES: Dict[str, HloEntry] = {

@@ -445,6 +445,32 @@ def audit_corr_lookups() -> Tuple[List[Finding], Dict]:
     return _apply_waivers(findings), report
 
 
+def audit_device_aug() -> Tuple[List[Finding], Dict]:
+    """data/device_aug.py's jitted batch-augmentation graphs (dense and
+    sparse): f64 hygiene under x64 plus loop-transfer checks — the aug
+    graph runs inside the h2d lane every step, so a host round trip
+    here would serialize the whole input pipeline."""
+    import jax
+    from jax.experimental import enable_x64
+
+    from raft_tpu.data.device_aug import abstract_device_aug
+
+    findings: List[Finding] = []
+    report: Dict = {"traced": []}
+    for name, sparse in (("device_aug", False), ("device_aug_sparse", True)):
+        fn, args = abstract_device_aug(sparse=sparse)
+        with enable_x64():
+            jx = jax.make_jaxpr(fn)(*args)
+        report["traced"].append(name)
+        findings.extend(_f64_findings(name, jx))
+        for prim, prov in find_loop_transfers(jx):
+            findings.append(_finding(
+                "scan-transfer", name,
+                f"{prim} inside a loop body at {prov} — host round trip "
+                f"inside the h2d-lane augmentation graph"))
+    return _apply_waivers(findings), report
+
+
 def audit_recompile_keys() -> Tuple[List[Finding], Dict]:
     """Static-arg signature report across STAGE_PRESETS (data only).
 
@@ -486,6 +512,7 @@ ENTRY_AUDITS: Dict[str, Callable[[], Tuple[List[Finding], Dict]]] = {
     "parallel_step": audit_parallel_step,
     "eval_forward": audit_eval_forward,
     "corr_lookups": audit_corr_lookups,
+    "device_aug": audit_device_aug,
     "recompile_keys": audit_recompile_keys,
 }
 

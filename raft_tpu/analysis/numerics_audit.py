@@ -1054,6 +1054,41 @@ def fmap_ranges(args) -> List[VRange]:
     return out
 
 
+def device_aug_ranges(batch_sds) -> List[VRange]:
+    """Input ranges for the device-augmentation entry, keyed on the
+    batch dict's field names (scales provably nonzero — the sampler
+    floors them at min_scale; dims/counts >= their sampling floors)."""
+    import jax
+
+    per_key = {
+        "image1": VRange(0.0, 255.0), "image2": VRange(0.0, 255.0),
+        # int16 wire or f32 px — cover both
+        "flow": VRange(-32767.0, 32767.0),
+        "valid": VRange(0.0, 1.0),
+        "aug/h": VRange(1.0, 8192.0, nonzero=True),
+        "aug/w": VRange(1.0, 8192.0, nonzero=True),
+        "aug/asym": VRange(0.0, 1.0),
+        "aug/jit_f": VRange(0.0, 2.0),
+        "aug/hue_i": VRange(-180.0, 180.0),
+        "aug/order": VRange(0.0, 3.0),
+        "aug/eraser_n": VRange(0.0, 2.0),
+        "aug/eraser_rects": VRange(0.0, 8192.0),
+        "aug/do_spatial": VRange(0.0, 1.0),
+        "aug/fx": VRange(0.05, 16.0, nonzero=True),
+        "aug/fy": VRange(0.05, 16.0, nonzero=True),
+        "aug/new_h": VRange(1.0, 16384.0, nonzero=True),
+        "aug/new_w": VRange(1.0, 16384.0, nonzero=True),
+        "aug/hflip": VRange(0.0, 1.0), "aug/vflip": VRange(0.0, 1.0),
+        "aug/y0": VRange(0.0, 16384.0), "aug/x0": VRange(0.0, 16384.0),
+    }
+    out = []
+    for path, _leaf in jax.tree_util.tree_flatten_with_path(batch_sds)[0]:
+        name = jax.tree_util.keystr(path)
+        key = next((k for k in per_key if f"'{k}'" in name), None)
+        out.append(per_key[key] if key else TOP)
+    return out
+
+
 # --------------------------------------------------------------------------
 # entries
 # --------------------------------------------------------------------------
@@ -1168,6 +1203,20 @@ def _build_pyramid_pallas_stacked():
     return fn, args, fmap_ranges(args)
 
 
+def _build_device_aug():
+    from raft_tpu.data.device_aug import abstract_device_aug
+
+    fn, (batch_sds,) = abstract_device_aug(sparse=False)
+    return fn, (batch_sds,), device_aug_ranges(batch_sds)
+
+
+def _build_device_aug_sparse():
+    from raft_tpu.data.device_aug import abstract_device_aug
+
+    fn, (batch_sds,) = abstract_device_aug(sparse=True, wire_format="f32")
+    return fn, (batch_sds,), device_aug_ranges(batch_sds)
+
+
 ENTRIES: Dict[str, NumEntry] = {
     "train_step": NumEntry("train_step", _build_train_step,
                            rules=DEEP_RULES),
@@ -1188,6 +1237,12 @@ ENTRIES: Dict[str, NumEntry] = {
     "corr_pyramid_pallas_stacked": NumEntry(
         "corr_pyramid_pallas_stacked", _build_pyramid_pallas_stacked,
         pallas=True),
+    # h2d-lane augmentation graphs (data/device_aug.py): shallow,
+    # spec-bounded programs — the full rule set applies, incl. the
+    # dtype-overflow proof over the fixed-point photometric chains
+    "device_aug": NumEntry("device_aug", _build_device_aug),
+    "device_aug_sparse": NumEntry("device_aug_sparse",
+                                  _build_device_aug_sparse),
 }
 
 

@@ -99,7 +99,21 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--datasets_root", default="datasets")
     p.add_argument("--checkpoint_dir", default="checkpoints")
     p.add_argument("--log_dir", default="runs")
-    p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--num_workers", type=int, default=None,
+                   help="loader worker threads; default min(4, cpu_count)")
+    p.add_argument("--device_aug", action="store_true",
+                   help="force device-side augmentation: the host only "
+                        "samples aug params, the accelerator applies the "
+                        "dense photometric/spatial work "
+                        "(data/device_aug.py).  Default is automatic — "
+                        "on for single-family stages (chairs/things/"
+                        "kitti/synthetic_aug), off for the sintel "
+                        "mixture")
+    p.add_argument("--no_device_aug", action="store_true",
+                   help="force the host numpy/cv2 augmentor (the parity "
+                        "fallback; prefer it when the host has cores to "
+                        "spare or raw-frame padding would dominate the "
+                        "host->device wire)")
     p.add_argument("--wire_int16", action="store_true",
                    help="ship supervision wire-packed (flow int16 at "
                         "1/64 px, valid uint8) — 39%% fewer host->device "
@@ -173,11 +187,16 @@ def build_config(args):
         deferred_corr_grad=args.deferred_corr_grad,
         **({"corr_dtype": args.corr_dtype} if args.corr_dtype else {}),
     )
+    if args.device_aug and args.no_device_aug:
+        raise SystemExit(
+            "--device_aug and --no_device_aug both given; pick one")
     data = dataclasses.replace(
         preset.data,
         root=args.datasets_root,
         num_workers=args.num_workers,
         wire_format="int16" if args.wire_int16 else "f32",
+        device_aug=(True if args.device_aug
+                    else False if args.no_device_aug else None),
         **({"image_size": tuple(args.image_size)} if args.image_size else {}),
         **({"batch_size": args.batch_size} if args.batch_size else {}),
     )
@@ -249,9 +268,36 @@ def train(args) -> str:
     model_cfg, data_cfg, train_cfg = build_config(args)
     model = RAFT(model_cfg)
 
+    # Device-side augmentation (data/device_aug.py): auto policy unless
+    # forced; the dataset then ships raw padded frames + aug params and
+    # the jitted graph below applies the dense work on the accelerator,
+    # fused into the h2d lane.
+    from raft_tpu.data.datasets import default_device_aug
+    from raft_tpu.data.device_aug import device_augment_for
+
+    # Auto policy: stage must support it AND an accelerator must be
+    # attached — the separable-resample matmuls are ~free on an MXU but
+    # measured ~6x slower than cv2 on a CPU backend
+    # (scripts/data_bench.py --compare); --device_aug still forces.
+    use_device_aug = (data_cfg.device_aug
+                     if data_cfg.device_aug is not None
+                     else (default_device_aug(data_cfg.stage)
+                           and jax.default_backend() != "cpu"))
     dataset = fetch_dataset(data_cfg.stage, data_cfg.image_size,
                             root=data_cfg.root, seed=train_cfg.seed,
-                            wire_format=data_cfg.wire_format)
+                            wire_format=data_cfg.wire_format,
+                            device_aug=use_device_aug)
+    aug_fn = (device_augment_for(dataset, wire_format=data_cfg.wire_format)
+              if use_device_aug else None)
+    if use_device_aug and aug_fn is None:
+        # fetch_dataset already switched every part to the raw wire; a
+        # missing apply graph here would silently train on uncropped
+        # padded frames
+        raise SystemExit(
+            f"device augmentation requested but the stage's parts do "
+            f"not share one augmentation graph (mixed crop sizes or "
+            f"dense+sparse mixture in stage {data_cfg.stage!r}) — run "
+            f"with --no_device_aug")
     loader = DataLoader(dataset, data_cfg.batch_size,
                         num_workers=data_cfg.num_workers,
                         seed=train_cfg.seed,
@@ -262,7 +308,8 @@ def train(args) -> str:
           + (f" ({loader.local_batch_size}/process x "
              f"{jax.process_count()} processes)"
              if jax.process_count() > 1 else "")
-          + f", steps={train_cfg.num_steps}")
+          + f", steps={train_cfg.num_steps}"
+          + (", device_aug" if aug_fn is not None else ""))
 
     tx, schedule = make_optimizer(train_cfg.lr, train_cfg.num_steps,
                                   train_cfg.wdecay, train_cfg.epsilon,
@@ -294,6 +341,10 @@ def train(args) -> str:
     # then places them on the global mesh.
     first = next(iter(loader))
     init_batch = {k: v for k, v in first.items() if k != "extra_info"}
+    if aug_fn is not None:
+        # the model sees post-aug (cropped) shapes; run the aug graph on
+        # the init batch so parameter init traces the training shapes
+        init_batch = dict(aug_fn(init_batch))
     if jax.process_count() > 1 and sharding is None:
         raise SystemExit(
             "multi-host training needs a device mesh: set "
@@ -398,6 +449,7 @@ def train(args) -> str:
         ),
         sharding=sharding,
         spans=spans,
+        device_fn=aug_fn,   # device aug fuses into the h2d lane
     )
     # Batch waits charge to the 'data' phase (h2d nests inside it via
     # prefetch_to_device; exclusive attribution keeps them distinct).

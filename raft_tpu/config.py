@@ -226,12 +226,19 @@ class DataConfig:
     root: str = "datasets"
     image_size: Tuple[int, int] = (368, 496)
     batch_size: int = 10
-    num_workers: int = 4
+    # None = min(4, cpu_count), resolved by the DataLoader (a worker per
+    # core up to the reference's 4 — see loader.default_num_workers)
+    num_workers: Optional[int] = None
     prefetch: int = 2
     # "int16": ship flow as 1/64-px fixed point + valid as uint8 (39%
     # fewer host->device bytes/batch; quantization <= 1/128 px — KITTI GT
     # is already stored at exactly this precision, frame_utils.py:116-120)
     wire_format: str = "f32"
+    # Device-side augmentation (data/device_aug.py): host samples params,
+    # the accelerator applies the dense work.  None = auto (on for the
+    # single-family stages in datasets.DEVICE_AUG_STAGES, off for the
+    # sintel mixture and unaugmented synthetic); True/False forces.
+    device_aug: Optional[bool] = None
 
     def __post_init__(self):
         # raft_tpu.wire is numpy-only (deliberately outside the data

@@ -20,6 +20,12 @@ from raft_tpu.data.datasets import (
     fetch_dataset,
 )
 from raft_tpu.data.loader import DataLoader
+from raft_tpu.data.device_aug import (
+    device_augment_for,
+    make_device_augment,
+    sample_dense_params,
+    sample_sparse_params,
+)
 from raft_tpu.wire import encode_flow_i16, decode_flow, decode_valid
 
 __all__ = [
@@ -28,5 +34,7 @@ __all__ = [
     "FlowAugmentor", "SparseFlowAugmentor", "FlowDataset", "FlyingChairs",
     "FlyingThings3D", "MpiSintel", "KITTI", "HD1K", "SyntheticShift",
     "fetch_dataset", "DataLoader",
+    "device_augment_for", "make_device_augment",
+    "sample_dense_params", "sample_sparse_params",
     "encode_flow_i16", "decode_flow", "decode_valid",
 ]

@@ -45,9 +45,30 @@ class FlowDataset:
             cls = SparseFlowAugmentor if sparse else FlowAugmentor
             self.augmentor = cls(**aug_params)
         self.is_test = False
+        # Device-side augmentation (data/device_aug.py): when enabled,
+        # __getitem__ ships RAW padded frames plus the sampled aug/*
+        # param struct instead of running the numpy augmentor — the
+        # dense work then runs as a jitted batch on the accelerator.
+        self.device_aug = False
+        self.device_aug_pad: Optional[Tuple[int, int]] = None
         self.flow_list: List[str] = []
         self.image_list: List[List[str]] = []
         self.extra_info: List = []
+
+    def enable_device_aug(self, pad_to: Optional[Tuple[int, int]] = None
+                          ) -> None:
+        """Switch this dataset to the raw-frames + param-struct wire.
+
+        ``pad_to``: static (H, W) every raw frame is zero-padded to —
+        REQUIRED when source images vary in size (KITTI), or every size
+        change retraces the device graph and the loader cannot stack.
+        """
+        if self.augmentor is None:
+            raise ValueError(
+                "device augmentation needs an augmentor (aug_params); "
+                "unaugmented stages have no dense work to move")
+        self.device_aug = True
+        self.device_aug_pad = tuple(pad_to) if pad_to else None
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -79,6 +100,8 @@ class FlowDataset:
         img1 = self._load_image(self.image_list[index][0])
         img2 = self._load_image(self.image_list[index][1])
 
+        if self.device_aug:
+            return self._pack_raw(index, img1, img2, flow, valid)
         img1, img2, flow, valid = self._augment(index, img1, img2, flow,
                                                 valid)
         return self._pack(img1, img2, flow, valid)
@@ -94,6 +117,50 @@ class FlowDataset:
             else:
                 img1, img2, flow = aug(img1, img2, flow)
         return img1, img2, flow, valid
+
+    def _pack_raw(self, index, img1, img2, flow,
+                  valid=None) -> Dict[str, np.ndarray]:
+        """The device-augmentation wire: raw padded frames, CLEAN flow,
+        pre-aug validity, and the flat ``aug/*`` param struct sampled
+        from the same (seed, epoch, index)-derived generator the host
+        path would use — so both paths make identical decisions."""
+        from raft_tpu.data.device_aug import (sample_dense_params,
+                                              sample_sparse_params)
+
+        ht, wd = img1.shape[:2]
+        aug = copy.copy(self.augmentor)
+        aug.reseed(abs(hash((self.seed, self.epoch, index))) % (2 ** 31))
+        sample = sample_sparse_params if self.sparse else sample_dense_params
+        params = sample(aug, ht, wd)
+
+        pad = self.device_aug_pad or (ht, wd)
+        if ht > pad[0] or wd > pad[1]:
+            raise ValueError(
+                f"raw frame {(ht, wd)} exceeds device_aug pad {pad} — "
+                f"raise enable_device_aug(pad_to=...)")
+
+        def padded(arr, dtype):
+            arr = np.asarray(arr, dtype)
+            if (ht, wd) == tuple(pad):      # uniform-size fast path
+                return np.ascontiguousarray(arr)
+            out = np.zeros(pad + arr.shape[2:], dtype)
+            out[:ht, :wd] = arr
+            return out
+
+        if valid is None:
+            # dense: validity is decided post-aug by the |flow| < 1000
+            # rule on device; everything is a priori valid on the wire
+            valid = np.ones((ht, wd), np.float32)
+        out = {"image1": padded(img1, np.uint8),
+               "image2": padded(img2, np.uint8)}
+        if self.wire_format == "int16":
+            out["flow"] = wire.encode_flow_i16(padded(flow, np.float32))
+            out["valid"] = padded(valid, np.uint8)
+        else:
+            out["flow"] = padded(flow, np.float32)
+            out["valid"] = padded(valid, np.float32)
+        out.update(params)
+        return out
 
     def _pack(self, img1, img2, flow, valid=None) -> Dict[str, np.ndarray]:
         if valid is None:
@@ -353,6 +420,13 @@ class SyntheticShift(FlowDataset):
             valid[:, W - dx:] = 0
         elif dx < 0:
             valid[:, :-dx] = 0
+        if self.augmentor is not None and self.device_aug:
+            # raw wire: clean flow + the wrap-band mask; the device graph
+            # re-poisons invalid pixels with the same 1e9 sentinel the
+            # host path embeds below, so both paths train on identical
+            # supervision semantics
+            return self._pack_raw(index, img1.astype(np.uint8),
+                                  img2.astype(np.uint8), flow, valid)
         if self.augmentor is not None:
             # Carry the wrap-band invalidity THROUGH the dense augmentor:
             # a huge sentinel flow in the band survives crop/scale (scale
@@ -372,9 +446,33 @@ class SyntheticShift(FlowDataset):
                           flow, valid)
 
 
+# Static raw-frame pad sizes for the device-augmentation wire, per
+# dataset family (the standard release dimensions; KITTI varies a few
+# px per frame, the pad covers the maxima).
+DEVICE_AUG_PAD = {
+    "FlyingChairs": (384, 512),
+    "FlyingThings3D": (540, 960),
+    "MpiSintel": (436, 1024),
+    "KITTI": (376, 1248),
+    "HD1K": (1080, 2560),
+}
+
+# Stages where device augmentation defaults ON (single augmentor family,
+# bounded padding waste).  The sintel mixture stays host-side: its parts
+# mix dense and sparse augmentors (two different device graphs per
+# batch) and HD1K's 1080p pad would dominate the wire.  Plain
+# "synthetic" has no augmentor at all.
+DEVICE_AUG_STAGES = ("synthetic_aug", "chairs", "things", "kitti")
+
+
+def default_device_aug(stage: str) -> bool:
+    """The auto policy behind DataConfig.device_aug=None."""
+    return stage in DEVICE_AUG_STAGES
+
+
 def fetch_dataset(stage: str, image_size, root: str = "datasets",
                   train_ds: str = "C+T+K+S+H", seed: int = 0,
-                  wire_format: str = "f32"):
+                  wire_format: str = "f32", device_aug: bool = False):
     """Stage mixture construction (datasets.py:199-228).
 
     chairs -> FlyingChairs;  things -> clean+final passes;
@@ -383,13 +481,26 @@ def fetch_dataset(stage: str, image_size, root: str = "datasets",
 
     wire_format="int16" packs supervision compactly for transfer
     (raft_tpu/wire.py); applied to every dataset in the stage mixture.
+    device_aug=True switches every part to the raw-frames + param-struct
+    wire (data/device_aug.py) — only valid for stages in
+    DEVICE_AUG_STAGES; pair it with ``device_augment_for``.
     """
     wire.check_wire_format(wire_format)
     ds = _fetch_dataset(stage, image_size, root, train_ds, seed)
+    parts = [p for p, _ in (ds.parts if isinstance(ds, CombinedDataset)
+                            else [(ds, 1)])]
     if wire_format != "f32":
-        for part, _ in (ds.parts if isinstance(ds, CombinedDataset)
-                        else [(ds, 1)]):
+        for part in parts:
             part.wire_format = wire_format
+    if device_aug:
+        if not default_device_aug(stage):
+            raise ValueError(
+                f"device augmentation is not supported for stage "
+                f"{stage!r} (supported: {DEVICE_AUG_STAGES}); run with "
+                f"--no_device_aug")
+        for part in parts:
+            part.enable_device_aug(
+                DEVICE_AUG_PAD.get(type(part).__name__))
     return ds
 
 

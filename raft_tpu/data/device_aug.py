@@ -1,0 +1,603 @@
+"""Device-side augmentation: the host/device split of the data pipeline.
+
+The numpy/cv2 augmentors (augmentor.py) cost ~27 ms of host CPU per
+sample at the chairs config — on a 1-core host that caps the fed rate at
+~11 pairs/s against a 34 pairs/s device rate (BENCH_r05): the pipeline
+is input-bound by ~3x.  The expensive work is all *dense* (photometric
+jitter, occlusion eraser, bilinear scale/stretch, flip, crop); only the
+*parameter sampling* is branchy and size-dynamic.  So the pipeline is
+split at exactly that line:
+
+- **host** (this module's ``sample_dense_params`` /
+  ``sample_sparse_params``): decode + draw every augmentation decision
+  with the SAME ``np.random.Generator`` in the SAME order as the numpy
+  augmentors — determinism per (seed, epoch, index) is preserved, and a
+  given seed produces the identical crop/flip/jitter decisions on both
+  paths.  Raw frames are padded to a static shape and shipped with the
+  flat ``aug/*`` param struct.
+- **device** (``make_device_augment``): a jitted, ``vmap``-batched,
+  static-shape XLA graph applies the params — photometric ops in the
+  sampled order (cv2-exact integer luma/HSV math, <= 1 uint8 LSB from
+  the cv2 path), the eraser, and resize+stretch+flip+crop fused into ONE
+  separable bilinear resample (two one-hot matmuls per tensor — MXU
+  work, no gathers), plus the sparse-flow-aware scatter resize
+  (last-write-wins via ``segment_max``) for KITTI/HD1K.
+
+The host keeps only decode + sampling; parity with the numpy path is
+enforced by tests/test_device_aug.py (exact for flip/crop and the
+eraser fill, <= 1 LSB for photometric and uint8 resize).
+
+Wire contract (what travels over PCIe per sample):
+
+- ``image1``/``image2`` uint8 ``(Hraw, Wraw, 3)`` (zero-padded),
+- ``flow`` f32 or int16-wire ``(Hraw, Wraw, 2)`` — CLEAN values (no
+  sentinel; the device re-poisons from ``valid``),
+- ``valid`` f32/uint8 ``(Hraw, Wraw)`` — pre-aug validity (dense: the
+  wrap-band mask or all-ones; sparse: the KITTI occlusion mask),
+- ``aug/*`` — the param struct (see ``PARAM_KEYS``).
+
+The device graph emits the post-crop wire batch the train step already
+consumes (uint8 images, f32 or int16 flow + valid), so the compiled
+step executable is shared with the host-augmented path bit-for-bit at
+the signature level.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# cv2's fixed-point HSV tables, computed inline on device:
+#   sdiv_table[v] = round((255 << 12) / v)   -> 1044480.0 / v
+#   hdiv_table[d] = round((180 << 12) / (6 d)) -> 122880.0 / d
+# Both numerators are 2^13 * odd, so no quotient ever lands exactly on a
+# .5 rounding boundary and the f32 division is round-safe for every
+# v, d in 1..255 (relative margin >= 4e-7 vs f32's 6e-8 error).
+_SDIV_NUM = np.float32(1044480.0)
+_HDIV_NUM = np.float32(122880.0)
+_HSCALE = np.float32(np.float32(6.0) / np.float32(180.0))
+# HSV->RGB sector table, cv2 layout (columns select tab[] for B, G, R)
+_SECTOR_BGR = np.array([[1, 3, 0], [1, 0, 2], [3, 0, 1],
+                        [0, 2, 1], [0, 1, 3], [2, 1, 0]], np.int32)
+
+# Flat param-struct keys (all prefixed so the loader stacks them as
+# ordinary batch entries; make_device_augment strips them from the
+# output batch).  Shapes are per-sample.
+PARAM_KEYS = (
+    "aug/h", "aug/w",                    # true (unpadded) raw dims, i32
+    "aug/asym",                          # f32 flag: asymmetric photometric
+    "aug/jit_f",                         # f32 (2,3): per-image (b, c, s)
+    "aug/hue_i",                         # i32 (2,): hue shift in H steps
+    "aug/order",                         # i32 (2,4): photometric op order
+    "aug/eraser_n",                      # i32: 0..2 rectangles
+    "aug/eraser_rects",                  # i32 (2,4): x0, y0, dx, dy
+    "aug/do_spatial",                    # f32 flag: resize happened
+    "aug/fx", "aug/fy",                  # f32 effective scales (1.0 if not)
+    "aug/new_h", "aug/new_w",            # i32 resized dims (raw if not)
+    "aug/hflip", "aug/vflip",            # f32 flags
+    "aug/y0", "aug/x0",                  # i32 crop origin (resized coords)
+)
+
+
+# ==========================================================================
+# host side: parameter sampling (numpy; mirrors the augmentors' draw order)
+# ==========================================================================
+
+def _draw_jitter(photo_aug, rng) -> Tuple[np.ndarray, int, np.ndarray]:
+    """One ColorJitter parameter set, in ColorJitter.__call__'s exact
+    draw order: b, c, s, hue, then the op permutation."""
+    b = rng.uniform(max(0, 1 - photo_aug.brightness), 1 + photo_aug.brightness)
+    c = rng.uniform(max(0, 1 - photo_aug.contrast), 1 + photo_aug.contrast)
+    s = rng.uniform(max(0, 1 - photo_aug.saturation), 1 + photo_aug.saturation)
+    h = rng.uniform(-photo_aug.hue, photo_aug.hue)
+    order = rng.permutation(4)
+    return (np.array([b, c, s], np.float32), int(round(h * 180)),
+            np.asarray(order, np.int32))
+
+
+def _eraser_draws(aug, ht: int, wd: int, bounds=(50, 100)):
+    """FlowAugmentor.eraser_transform's draws (shared by both augmentors)."""
+    rng = aug.rng
+    n = 0
+    rects = np.zeros((2, 4), np.int32)
+    if rng.random() < aug.eraser_aug_prob:
+        n = int(rng.integers(1, 3))
+        for k in range(n):
+            x0 = int(rng.integers(0, wd))
+            y0 = int(rng.integers(0, ht))
+            dx = int(rng.integers(bounds[0], bounds[1]))
+            dy = int(rng.integers(bounds[0], bounds[1]))
+            rects[k] = (x0, y0, dx, dy)
+    return n, rects
+
+
+def _pack_params(ht, wd, asym, jit_f, hue_i, order, eraser_n, rects,
+                 do_spatial, fx, fy, new_h, new_w, hflip, vflip, y0, x0
+                 ) -> Dict[str, np.ndarray]:
+    return {
+        "aug/h": np.int32(ht), "aug/w": np.int32(wd),
+        "aug/asym": np.float32(asym),
+        "aug/jit_f": np.asarray(jit_f, np.float32),
+        "aug/hue_i": np.asarray(hue_i, np.int32),
+        "aug/order": np.asarray(order, np.int32),
+        "aug/eraser_n": np.int32(eraser_n),
+        "aug/eraser_rects": np.asarray(rects, np.int32),
+        "aug/do_spatial": np.float32(do_spatial),
+        "aug/fx": np.float32(fx), "aug/fy": np.float32(fy),
+        "aug/new_h": np.int32(new_h), "aug/new_w": np.int32(new_w),
+        "aug/hflip": np.float32(hflip), "aug/vflip": np.float32(vflip),
+        "aug/y0": np.int32(y0), "aug/x0": np.int32(x0),
+    }
+
+
+def sample_dense_params(aug, ht: int, wd: int) -> Dict[str, np.ndarray]:
+    """Draw a FlowAugmentor's full decision set for one (ht, wd) sample.
+
+    Consumes ``aug.rng`` in exactly the order FlowAugmentor.__call__
+    would (color -> eraser -> spatial), so the same seed yields the
+    same augmentation on the host and device paths.
+    """
+    rng = aug.rng
+    # color_transform
+    asym = rng.random() < aug.asymmetric_color_aug_prob
+    j1 = _draw_jitter(aug.photo_aug, rng)
+    j2 = _draw_jitter(aug.photo_aug, rng) if asym else j1
+    jit_f = np.stack([j1[0], j2[0]])
+    hue_i = np.array([j1[1], j2[1]], np.int32)
+    order = np.stack([j1[2], j2[2]])
+    # eraser_transform
+    eraser_n, rects = _eraser_draws(aug, ht, wd)
+    # spatial_transform
+    min_scale = max((aug.crop_size[0] + 8) / float(ht),
+                    (aug.crop_size[1] + 8) / float(wd))
+    scale = 2 ** rng.uniform(aug.min_scale, aug.max_scale)
+    scale_x = scale_y = scale
+    if rng.random() < aug.stretch_prob:
+        scale_x *= 2 ** rng.uniform(-aug.max_stretch, aug.max_stretch)
+        scale_y *= 2 ** rng.uniform(-aug.max_stretch, aug.max_stretch)
+    scale_x = max(scale_x, min_scale)
+    scale_y = max(scale_y, min_scale)
+    do_spatial = rng.random() < aug.spatial_aug_prob
+    if do_spatial:
+        # cv2.resize computes dsize with saturate_cast<int> == round
+        # half-to-even; np.rint matches
+        new_h, new_w = int(np.rint(ht * scale_y)), int(np.rint(wd * scale_x))
+        fx, fy = scale_x, scale_y
+    else:
+        new_h, new_w, fx, fy = ht, wd, 1.0, 1.0
+    hflip = vflip = False
+    if aug.do_flip:
+        hflip = rng.random() < aug.h_flip_prob
+        vflip = rng.random() < aug.v_flip_prob
+    y0 = int(rng.integers(0, new_h - aug.crop_size[0]))
+    x0 = int(rng.integers(0, new_w - aug.crop_size[1]))
+    return _pack_params(ht, wd, asym, jit_f, hue_i, order, eraser_n, rects,
+                        do_spatial, fx, fy, new_h, new_w, hflip, vflip,
+                        y0, x0)
+
+
+def sample_sparse_params(aug, ht: int, wd: int) -> Dict[str, np.ndarray]:
+    """SparseFlowAugmentor's decision set (symmetric photometric, single
+    scale, h-flip only, margin-biased crop) in its exact draw order."""
+    rng = aug.rng
+    j = _draw_jitter(aug.photo_aug, rng)            # symmetric: one set
+    jit_f = np.stack([j[0], j[0]])
+    hue_i = np.array([j[1], j[1]], np.int32)
+    order = np.stack([j[2], j[2]])
+    eraser_n, rects = _eraser_draws(aug, ht, wd)
+    min_scale = max((aug.crop_size[0] + 1) / float(ht),
+                    (aug.crop_size[1] + 1) / float(wd))
+    scale = 2 ** rng.uniform(aug.min_scale, aug.max_scale)
+    scale_x = scale_y = max(scale, min_scale)
+    do_spatial = rng.random() < aug.spatial_aug_prob
+    if do_spatial:
+        new_h, new_w = int(np.rint(ht * scale_y)), int(np.rint(wd * scale_x))
+        fx, fy = scale_x, scale_y
+    else:
+        new_h, new_w, fx, fy = ht, wd, 1.0, 1.0
+    # short-circuit parity: no flip draw at all when do_flip is off
+    hflip = bool(aug.do_flip and rng.random() < aug.h_flip_prob)
+    margin_y, margin_x = 20, 50
+    y0 = int(rng.integers(0, new_h - aug.crop_size[0] + margin_y))
+    x0 = int(rng.integers(-margin_x, new_w - aug.crop_size[1] + margin_x))
+    y0 = int(np.clip(y0, 0, new_h - aug.crop_size[0]))
+    x0 = int(np.clip(x0, 0, new_w - aug.crop_size[1]))
+    return _pack_params(ht, wd, False, jit_f, hue_i, order, eraser_n, rects,
+                        do_spatial, fx, fy, new_h, new_w, hflip, False,
+                        y0, x0)
+
+
+# ==========================================================================
+# device side: the jitted application graph (jax; static shapes only)
+# ==========================================================================
+
+def _luma_i32(img_i32):
+    """cv2 COLOR_RGB2GRAY fixed point, 15-bit coefficients (the univ-
+    intrinsics path this container's cv2 4.x build runs — verified
+    exact against cv2 over full uint8 grids):
+    (R*9798 + G*19235 + B*3735 + 2^14) >> 15."""
+    import jax.numpy as jnp
+
+    r, g, b = img_i32[..., 0], img_i32[..., 1], img_i32[..., 2]
+    return jnp.right_shift(r * 9798 + g * 19235 + b * 3735 + 16384, 15)
+
+
+def _rounded_mean(s, n):
+    """floor(s/n + 1/2) in pure i32 (== the host's rounded f64 mean):
+    split as q + (2r + n) // (2n) so nothing overflows at 1080p sums."""
+    q = s // n
+    r = s - q * n
+    return q + (2 * r + n) // (2 * n)
+
+
+def _hue_u8(img_f32, shift_i):
+    """cv2's uint8 hue rotation: integer-exact RGB->HSV, H-channel shift
+    mod 180, float HSV->RGB (the same float ops cv2's 8u path runs)."""
+    import jax.numpy as jnp
+
+    rgb = img_f32.astype(jnp.int32)
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    v = jnp.maximum(jnp.maximum(r, g), b)
+    vmin = jnp.minimum(jnp.minimum(r, g), b)
+    diff = v - vmin
+    sdiv = jnp.rint(_SDIV_NUM / jnp.maximum(v, 1).astype(jnp.float32)) \
+        .astype(jnp.int32)
+    s = jnp.right_shift(diff * jnp.where(v > 0, sdiv, 0) + 2048, 12)
+    hdiv = jnp.rint(_HDIV_NUM / jnp.maximum(diff, 1).astype(jnp.float32)) \
+        .astype(jnp.int32)
+    h_num = jnp.where(v == r, g - b,
+                      jnp.where(v == g, b - r + 2 * diff,
+                                r - g + 4 * diff))
+    h = jnp.right_shift(h_num * jnp.where(diff > 0, hdiv, 0) + 2048, 12)
+    h = h + jnp.where(h < 0, 180, 0)
+    h = jnp.mod(h + shift_i, 180)
+    # HSV -> RGB, cv2's float path (f32 ops in cv2's exact order)
+    S = s.astype(jnp.float32) * np.float32(1.0 / 255.0)
+    V = v.astype(jnp.float32) * np.float32(1.0 / 255.0)
+    h6 = h.astype(jnp.float32) * _HSCALE
+    sector = jnp.floor(h6)
+    frac = h6 - sector
+    sec = jnp.clip(sector.astype(jnp.int32), 0, 5)
+    tab = jnp.stack([V, V * (1.0 - S), V * (1.0 - S * frac),
+                     V * (1.0 - S * (1.0 - frac))], axis=-1)
+    idx = jnp.asarray(_SECTOR_BGR)[sec]          # (..., 3) B,G,R tab slots
+    bgr = sum(jnp.where(idx == k, tab[..., k][..., None], 0.0)
+              for k in range(4))
+    # cv2's vectorized 8u path converts with a TRUNCATING cast (its
+    # scalar row-tail cvRounds instead — a <= 1 LSB, geometry-dependent
+    # wobble the parity tolerance absorbs); values are non-negative so
+    # floor == trunc
+    out = jnp.floor(bgr[..., ::-1] * np.float32(255.0))
+    out = jnp.where((s == 0)[..., None], v[..., None].astype(jnp.float32),
+                    out)
+    return jnp.clip(out, 0.0, 255.0)
+
+
+def _photometric_pair(im1, im2, p, mask):
+    """The four jitter ops in the sampled per-image order.  Images are
+    integer-valued f32 throughout (quantized to uint8 after every op,
+    like torchvision's PIL path and the host LUTs).  Contrast bases come
+    from the masked (true-pixel) luma mean — joint over both images in
+    symmetric mode, per-image in asymmetric mode, matching the host's
+    concat-stack vs independent application."""
+    import jax.numpy as jnp
+
+    asym = p["aug/asym"] > 0
+    n = p["aug/h"] * p["aug/w"]
+    mask_i = mask.astype(jnp.int32)
+
+    def one_op(im, gray, f3, hue_i, op, base):
+        bright = jnp.floor(f3[0] * im + 0.5)
+        contr = jnp.floor(base + f3[1] * (im - base) + 0.5)
+        grayf = gray.astype(jnp.float32)[..., None]
+        sat = jnp.rint(f3[2] * im + (1.0 - f3[2]) * grayf)
+        hue = _hue_u8(im, hue_i)
+        out = jnp.where(op == 0, bright,
+                        jnp.where(op == 1, contr,
+                                  jnp.where(op == 2, sat, hue)))
+        return jnp.clip(out, 0.0, 255.0)
+
+    for slot in range(4):
+        g1 = _luma_i32(im1.astype(jnp.int32))
+        g2 = _luma_i32(im2.astype(jnp.int32))
+        s1 = jnp.sum(g1 * mask_i)
+        s2 = jnp.sum(g2 * mask_i)
+        joint = _rounded_mean(s1 + s2, 2 * n).astype(jnp.float32)
+        base1 = jnp.where(asym, _rounded_mean(s1, n).astype(jnp.float32),
+                          joint)
+        base2 = jnp.where(asym, _rounded_mean(s2, n).astype(jnp.float32),
+                          joint)
+        im1 = one_op(im1, g1, p["aug/jit_f"][0], p["aug/hue_i"][0],
+                     p["aug/order"][0, slot], base1)
+        im2 = one_op(im2, g2, p["aug/jit_f"][1], p["aug/hue_i"][1],
+                     p["aug/order"][1, slot], base2)
+    return im1, im2
+
+
+def _eraser(im2, p, mask, iota_y, iota_x):
+    """Occlusion eraser on img2: up to two mean-color rectangles.  The
+    fill is the truncated per-channel mean over true pixels — integer
+    division replicates numpy's float->uint8 assignment cast exactly."""
+    import jax.numpy as jnp
+
+    n = p["aug/h"] * p["aug/w"]
+    sums = jnp.sum(im2.astype(jnp.int32) * mask.astype(jnp.int32)[..., None],
+                   axis=(0, 1))
+    fill = (sums // n).astype(jnp.float32)
+    hit = jnp.zeros(im2.shape[:2], bool)
+    for k in range(2):
+        x0, y0, dx, dy = (p["aug/eraser_rects"][k, i] for i in range(4))
+        rect = ((iota_x >= x0) & (iota_x < x0 + dx)
+                & (iota_y >= y0) & (iota_y < y0 + dy))
+        hit = hit | (rect & (k < p["aug/eraser_n"]))
+    return jnp.where(hit[..., None], fill, im2)
+
+
+def _resample_matrices(p, crop: Tuple[int, int], raw_hw: Tuple[int, int]):
+    """The composed resize->flip->crop map as two one-hot bilinear
+    matrices: out = Ry @ img @ Rx^T.  The map is separable (no rotation),
+    so the whole spatial transform is two matmuls per tensor — MXU work
+    with a single uint8 rounding at the end, exactly one quantization
+    like the host's resize-then-slice.  Coordinates clamp to the TRUE
+    (h-1, w-1) extent, so zero padding is never sampled (cv2's replicate
+    border on the unpadded frame)."""
+    import jax.numpy as jnp
+
+    ch, cw = crop
+    Hr, Wr = raw_hw
+    h = p["aug/h"].astype(jnp.float32)
+    w = p["aug/w"].astype(jnp.float32)
+    hflip = p["aug/hflip"] > 0
+    vflip = p["aug/vflip"] > 0
+
+    def axis_matrix(n_out, n_in, true_len, flip, origin, f, new_len):
+        i = jnp.arange(n_out, dtype=jnp.float32)
+        r = origin.astype(jnp.float32) + i
+        r = jnp.where(flip, new_len.astype(jnp.float32) - 1.0 - r, r)
+        src = (r + 0.5) / f - 0.5
+        src = jnp.clip(src, 0.0, true_len - 1.0)
+        lo = jnp.floor(src)
+        wt = src - lo
+        lo_i = lo.astype(jnp.int32)
+        hi_i = jnp.minimum(lo_i + 1, true_len.astype(jnp.int32) - 1)
+        iota = jnp.arange(n_in, dtype=jnp.int32)
+        return ((iota[None, :] == lo_i[:, None]) * (1.0 - wt)[:, None]
+                + (iota[None, :] == hi_i[:, None]) * wt[:, None])
+
+    Ry = axis_matrix(ch, Hr, h, vflip, p["aug/y0"], p["aug/fy"],
+                     p["aug/new_h"])
+    Rx = axis_matrix(cw, Wr, w, hflip, p["aug/x0"], p["aug/fx"],
+                     p["aug/new_w"])
+    return Ry, Rx
+
+
+def _resample(Ry, Rx, arr):
+    import jax.numpy as jnp
+
+    return jnp.einsum("ih,hwc,jw->ijc", Ry, arr, Rx)
+
+
+def _sparse_scatter(flow, valid, p, crop, raw_hw, iota_y, iota_x):
+    """The sparse-flow-aware resize: scatter valid source vectors onto
+    the rescaled grid, last-write-wins in source scan order (numpy's
+    fancy-assignment semantics) via a segment_max over source indices,
+    with flip and crop folded into the target coordinates."""
+    import jax
+    import jax.numpy as jnp
+
+    ch, cw = crop
+    Hr, Wr = raw_hw
+    hflip = p["aug/hflip"] > 0
+    src_ok = ((valid >= 1) & (iota_x < p["aug/w"]) & (iota_y < p["aug/h"]))
+    xi = jnp.rint(iota_x.astype(jnp.float32) * p["aug/fx"]).astype(jnp.int32)
+    yi = jnp.rint(iota_y.astype(jnp.float32) * p["aug/fy"]).astype(jnp.int32)
+    keep = (src_ok & (xi > 0) & (xi < p["aug/new_w"])
+            & (yi > 0) & (yi < p["aug/new_h"]))
+    xc = jnp.where(hflip, p["aug/new_w"] - 1 - xi, xi) - p["aug/x0"]
+    yc = yi - p["aug/y0"]
+    inb = keep & (xc >= 0) & (xc < cw) & (yc >= 0) & (yc < ch)
+    tgt = jnp.where(inb, yc * cw + xc, ch * cw).reshape(-1)
+    src_idx = jnp.arange(Hr * Wr, dtype=jnp.int32)
+    winner = jax.ops.segment_max(
+        jnp.where(inb.reshape(-1), src_idx, -1), tgt,
+        num_segments=ch * cw + 1)[:ch * cw]
+    has = winner >= 0
+    picked = flow.reshape(-1, 2)[jnp.maximum(winner, 0)]
+    u = picked[:, 0] * p["aug/fx"]
+    v = picked[:, 1] * p["aug/fy"]
+    u = jnp.where(hflip, -u, u)
+    out_flow = jnp.where(has[:, None], jnp.stack([u, v], axis=-1), 0.0)
+    return (out_flow.reshape(ch, cw, 2),
+            has.astype(jnp.float32).reshape(ch, cw))
+
+
+def _apply_sample(batch, crop: Tuple[int, int], raw_hw: Tuple[int, int],
+                  sparse: bool, wire_format: str):
+    """One sample's full device augmentation (runs under vmap)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.wire import decode_flow, decode_valid
+
+    ch, cw = crop
+    Hr, Wr = raw_hw
+    p = {k: batch[k] for k in PARAM_KEYS}
+    iota_y = jnp.arange(Hr, dtype=jnp.int32)[:, None]
+    iota_x = jnp.arange(Wr, dtype=jnp.int32)[None, :]
+    mask = (iota_y < p["aug/h"]) & (iota_x < p["aug/w"])
+
+    im1 = batch["image1"].astype(jnp.float32)
+    im2 = batch["image2"].astype(jnp.float32)
+    wire_i16 = batch["flow"].dtype == jnp.int16
+    flow = decode_flow(batch["flow"]).astype(jnp.float32)
+    valid = decode_valid(batch["valid"])
+    if wire_i16 and not sparse:
+        # The int16 raw wire saturates at +-WIRE_FLOW_MAX px BEFORE the
+        # scale is applied — unlike the host path, which encodes the
+        # post-resize flow.  A saturated value downscaled back under
+        # max_flow would silently supervise toward a clipped target, so
+        # saturated pixels are invalidated instead (conservative: the
+        # host path may keep such a pixel when downscaling brings it
+        # back in range).  Sparse GT is exempt — KITTI's on-disk format
+        # IS this encoding, so raw sparse flow is always representable.
+        from raft_tpu.wire import WIRE_FLOW_MAX
+
+        sat = jnp.any(jnp.abs(flow) >= np.float32(WIRE_FLOW_MAX), axis=-1)
+        valid = valid * (1.0 - sat.astype(jnp.float32))
+
+    im1, im2 = _photometric_pair(im1, im2, p, mask)
+    im2 = _eraser(im2, p, mask, iota_y, iota_x)
+
+    Ry, Rx = _resample_matrices(p, crop, raw_hw)
+    im1c = _resample(Ry, Rx, im1)
+    im2c = _resample(Ry, Rx, im2)
+
+    if sparse:
+        pass_fv = _resample(Ry, Rx, jnp.concatenate(
+            [flow, valid[..., None]], axis=-1))
+        flow_pass = pass_fv[..., :2] * jnp.stack([p["aug/fx"], p["aug/fy"]])
+        u = jnp.where(p["aug/hflip"] > 0, -flow_pass[..., 0],
+                      flow_pass[..., 0])
+        flow_pass = jnp.stack([u, flow_pass[..., 1]], axis=-1)
+        valid_pass = pass_fv[..., 2]
+        flow_sc, valid_sc = _sparse_scatter(flow, valid, p, crop, raw_hw,
+                                            iota_y, iota_x)
+        sp = p["aug/do_spatial"] > 0
+        flow_out = jnp.where(sp, flow_sc, flow_pass)
+        valid_out = jnp.where(sp, valid_sc, valid_pass)
+    else:
+        # dense: re-poison invalid source pixels so the bilinear blend
+        # spreads invalidity conservatively and the |flow| < 1000 pack
+        # rule recovers the mask — identical to the host's sentinel path
+        flow_sent = jnp.where((valid >= 1)[..., None], flow, 1e9)
+        flow_out = _resample(Ry, Rx, flow_sent)
+        flow_out = flow_out * jnp.stack([p["aug/fx"], p["aug/fy"]])
+        u = jnp.where(p["aug/hflip"] > 0, -flow_out[..., 0],
+                      flow_out[..., 0])
+        v = jnp.where(p["aug/vflip"] > 0, -flow_out[..., 1],
+                      flow_out[..., 1])
+        flow_out = jnp.stack([u, v], axis=-1)
+        valid_out = ((jnp.abs(flow_out[..., 0]) < 1000)
+                     & (jnp.abs(flow_out[..., 1]) < 1000)) \
+            .astype(jnp.float32)
+
+    out = {
+        "image1": jnp.clip(jnp.rint(im1c), 0, 255).astype(jnp.uint8),
+        "image2": jnp.clip(jnp.rint(im2c), 0, 255).astype(jnp.uint8),
+    }
+    if wire_format == "int16":
+        # device twin of wire.encode_flow_i16
+        q = jnp.rint(flow_out * np.float32(64.0))
+        out["flow"] = jnp.clip(q, -32767, 32767).astype(jnp.int16)
+        out["valid"] = valid_out.astype(jnp.uint8)
+    else:
+        out["flow"] = flow_out.astype(jnp.float32)
+        out["valid"] = valid_out.astype(jnp.float32)
+    return out
+
+
+def make_device_augment(crop_size: Tuple[int, int], sparse: bool = False,
+                        wire_format: str = "f32"):
+    """Build the jitted, vmap-batched device augmentation function.
+
+    Takes the raw wire batch (padded frames + ``aug/*`` params, numpy or
+    device arrays) and returns the post-crop train batch.  Call it on
+    the OUTPUT of ``prefetch_to_device``'s device_put (loader.py wires
+    this) so the dense work runs on the accelerator.
+    """
+    import jax
+
+    from raft_tpu.wire import check_wire_format
+
+    check_wire_format(wire_format)
+    crop = (int(crop_size[0]), int(crop_size[1]))
+
+    @jax.jit
+    def augment(batch):
+        raw_hw = batch["image1"].shape[1:3]
+
+        def one(b):
+            return _apply_sample(b, crop, raw_hw, sparse, wire_format)
+
+        aug_in = {k: batch[k] for k in ("image1", "image2", "flow", "valid")
+                  + tuple(PARAM_KEYS)}
+        out = jax.vmap(one)(aug_in)
+        # non-augmentation keys (if any) ride through untouched
+        passthrough = {k: v for k, v in batch.items()
+                       if k not in aug_in}
+        return {**passthrough, **out}
+
+    return augment
+
+
+def device_augment_for(dataset, wire_format: str = "f32"):
+    """The device augmentation function matching ``dataset``, or None.
+
+    Works for a single FlowDataset or a CombinedDataset whose parts all
+    run device augmentation with the same crop size and sparsity (a
+    mixed dense+sparse mixture — the sintel stage — needs two different
+    apply graphs per batch and stays on the host path)."""
+    from raft_tpu.data.augmentor import SparseFlowAugmentor
+
+    parts = ([d for d, _ in dataset.parts] if hasattr(dataset, "parts")
+             else [dataset])
+    if not parts or any(not getattr(d, "device_aug", False) for d in parts):
+        return None
+    augs = [d.augmentor for d in parts]
+    if any(a is None for a in augs):
+        return None
+    crops = {tuple(a.crop_size) for a in augs}
+    kinds = {isinstance(a, SparseFlowAugmentor) for a in augs}
+    if len(crops) != 1 or len(kinds) != 1:
+        return None
+    return make_device_augment(crops.pop(), sparse=kinds.pop(),
+                               wire_format=wire_format)
+
+
+# ==========================================================================
+# static-analysis entry point (graftlint engines 2-4)
+# ==========================================================================
+
+def abstract_device_aug(sparse: bool = False, batch: int = 2,
+                        raw_hw: Tuple[int, int] = (96, 112),
+                        crop: Tuple[int, int] = (64, 64),
+                        wire_format: str = "int16"):
+    """The lowerable device-augmentation entry point for the
+    static-analysis engines: the real jitted graph over abstract inputs.
+
+    Returns ``(fn, (batch_sds,))`` with ``fn`` supporting ``.lower()``.
+    The default int16 wire covers the decode/encode twins the production
+    fed lane runs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    Hr, Wr = raw_hw
+    sds = jax.ShapeDtypeStruct
+    flow_dt = jnp.int16 if wire_format == "int16" else jnp.float32
+    valid_dt = jnp.uint8 if wire_format == "int16" else jnp.float32
+    batch_sds = {
+        "image1": sds((batch, Hr, Wr, 3), jnp.uint8),
+        "image2": sds((batch, Hr, Wr, 3), jnp.uint8),
+        "flow": sds((batch, Hr, Wr, 2), flow_dt),
+        "valid": sds((batch, Hr, Wr), valid_dt),
+        "aug/h": sds((batch,), jnp.int32),
+        "aug/w": sds((batch,), jnp.int32),
+        "aug/asym": sds((batch,), jnp.float32),
+        "aug/jit_f": sds((batch, 2, 3), jnp.float32),
+        "aug/hue_i": sds((batch, 2), jnp.int32),
+        "aug/order": sds((batch, 2, 4), jnp.int32),
+        "aug/eraser_n": sds((batch,), jnp.int32),
+        "aug/eraser_rects": sds((batch, 2, 4), jnp.int32),
+        "aug/do_spatial": sds((batch,), jnp.float32),
+        "aug/fx": sds((batch,), jnp.float32),
+        "aug/fy": sds((batch,), jnp.float32),
+        "aug/new_h": sds((batch,), jnp.int32),
+        "aug/new_w": sds((batch,), jnp.int32),
+        "aug/hflip": sds((batch,), jnp.float32),
+        "aug/vflip": sds((batch,), jnp.float32),
+        "aug/y0": sds((batch,), jnp.int32),
+        "aug/x0": sds((batch,), jnp.int32),
+    }
+    fn = make_device_augment(crop, sparse=sparse, wire_format=wire_format)
+    return fn, (batch_sds,)

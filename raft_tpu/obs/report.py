@@ -124,6 +124,38 @@ def build_report(records: List[Dict]) -> Dict:
     last_means = metrics_windows[-1]["means"] if metrics_windows else {}
     steps = max([r.get("step", 0) for r in metrics_windows + span_windows]
                 or [0])
+
+    incident_rows = [{"kind": r.get("incident", "unknown"),
+                      "step": r.get("step"),
+                      "detail": r.get("detail", "")} for r in incidents]
+    # Derived input-bound incident: when the data phase eats more than
+    # half of every step, the pipeline is starving the device — the
+    # regression the device-aug path exists to fix must never return
+    # silently.  Rates are measured, not asserted: fed = what the
+    # pipeline actually sustained, device = the same steps with the data
+    # stall excluded.
+    data_pct = attribution.get("data", 0.0)
+    run_steps = steps - int(meta.get("start_step") or 0)
+    if data_pct > 50.0 and wall > 0 and run_steps > 0:
+        data_secs = phase_excl.get("data", 0.0)
+        fed_rate = run_steps / wall
+        compute_wall = max(wall - data_secs, 1e-9)
+        device_rate = run_steps / compute_wall
+        if batch:
+            unit = "items/s"
+            fed_rate *= batch
+            device_rate *= batch
+        else:
+            unit = "steps/s"
+        incident_rows.append({
+            "kind": "input-bound", "step": steps,
+            "detail": (f"data stall is {data_pct:.1f}% of step wall: the "
+                       f"pipeline feeds {fed_rate:.2f} {unit} against a "
+                       f"~{device_rate:.2f} {unit} device rate — "
+                       f"input-bound by {device_rate / max(fed_rate, 1e-9):.1f}x; "
+                       f"move augmentation on-device (--device_aug) or "
+                       f"add host decode cores")})
+
     return {
         "meta": meta,
         "runs": n_runs,
@@ -138,9 +170,7 @@ def build_report(records: List[Dict]) -> Dict:
         "phase_seconds_incl": {k: round(v, 6)
                                for k, v in phase_incl.items()},
         "memory_watermarks": watermarks,
-        "incidents": [{"kind": r.get("incident", "unknown"),
-                       "step": r.get("step"),
-                       "detail": r.get("detail", "")} for r in incidents],
+        "incidents": incident_rows,
         "last_window_means": last_means,
         "run_end_summary": summary,
     }

@@ -25,24 +25,88 @@ def main():
     p.add_argument("--root", default="datasets")
     p.add_argument("--batch_size", type=int, default=8)
     p.add_argument("--image_size", type=int, nargs=2, default=(368, 496))
-    p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--num_workers", type=int, default=None,
+                   help="loader worker threads; default min(4, cpu_count)")
     p.add_argument("--batches", type=int, default=30)
     p.add_argument("--aug", action="store_true",
                    help="run the dense augmentor too (the bench fed-lane "
                         "configuration; measures the full per-sample host "
                         "cost, not just decode/generation)")
+    p.add_argument("--compare", action="store_true",
+                   help="measure host-aug vs device-aug fed_pairs_per_s "
+                        "side by side (synthetic stage) and exit nonzero "
+                        "if the device path is slower — the device-aug "
+                        "speedup as a checked claim, not an assertion")
     args = p.parse_args()
 
     from raft_tpu.data import DataLoader, fetch_dataset
 
-    if args.aug and args.stage == "synthetic":
+    def synthetic_aug_ds(device_aug: bool = False, wire: str = "f32"):
         from raft_tpu.data.datasets import SyntheticShift
 
         H, W = args.image_size
         ds = SyntheticShift(
             image_size=(H + 32, W + 32), length=512,
             aug_params=dict(crop_size=(H, W), min_scale=0.0, max_scale=0.2,
-                            do_flip=True))
+                            do_flip=True),
+            wire_format=wire)
+        if device_aug:
+            ds.enable_device_aug()
+        return ds
+
+    def measure(ds, device_fn=None, tag=""):
+        loader = DataLoader(ds, args.batch_size,
+                            num_workers=args.num_workers)
+        if len(loader) == 0:
+            sys.exit(f"dataset too small: {len(ds)} samples < batch_size "
+                     f"{args.batch_size} (loader drops the last short "
+                     f"batch)")
+        it = iter(loader.epochs())
+        if device_fn is None:
+            consume = lambda b: next(iter(b.values()))  # noqa: E731
+        else:
+            import jax
+
+            def consume(b):
+                out = device_fn({k: v for k, v in b.items()
+                                 if k != "extra_info"})
+                jax.block_until_ready(out)
+                return out
+        consume(next(it))  # warm the pool (+ compile on the device lane)
+        t0 = time.perf_counter()
+        for _ in range(args.batches):
+            consume(next(it))
+        dt = time.perf_counter() - t0
+        sps = args.batches * args.batch_size / dt
+        print(f"{tag or args.stage}: {sps:.1f} samples/s "
+              f"({args.batches} batches of {args.batch_size}, "
+              f"{loader.num_workers} workers, "
+              f"{args.image_size[0]}x{args.image_size[1]})")
+        return sps
+
+    if args.compare:
+        if args.stage != "synthetic":
+            sys.exit("--compare is only wired for --stage synthetic")
+        from raft_tpu.data.device_aug import make_device_augment
+
+        H, W = args.image_size
+        # both lanes on the int16 wire, so the comparison isolates WHERE
+        # the augmentation runs rather than conflating it with the
+        # wire-format byte savings (both paths support both wires)
+        host_sps = measure(synthetic_aug_ds(False, wire="int16"),
+                           tag="host-aug  ")
+        dev_sps = measure(
+            synthetic_aug_ds(True, wire="int16"),
+            device_fn=make_device_augment((H, W), wire_format="int16"),
+            tag="device-aug")
+        print(f"device/host: {dev_sps / max(host_sps, 1e-9):.2f}x")
+        if dev_sps < host_sps:
+            sys.exit("device-aug path is SLOWER than host aug on this "
+                     "machine — keep --no_device_aug here")
+        return
+
+    if args.aug and args.stage == "synthetic":
+        ds = synthetic_aug_ds(False)
     elif args.aug:
         # reject the combination before touching the dataset — fetch can
         # be slow (or error on missing data) and would mask this message
@@ -50,21 +114,7 @@ def main():
     else:
         ds = fetch_dataset(args.stage, tuple(args.image_size),
                            root=args.root)
-    loader = DataLoader(ds, args.batch_size, num_workers=args.num_workers)
-    if len(loader) == 0:
-        sys.exit(f"dataset too small: {len(ds)} samples < batch_size "
-                 f"{args.batch_size} (loader drops the last short batch)")
-
-    it = iter(loader.epochs())
-    next(it)  # warm the pool
-    t0 = time.perf_counter()
-    for _ in range(args.batches):
-        next(it)
-    dt = time.perf_counter() - t0
-    sps = args.batches * args.batch_size / dt
-    print(f"{args.stage}: {sps:.1f} samples/s "
-          f"({args.batches} batches of {args.batch_size}, "
-          f"{args.num_workers} workers, {args.image_size[0]}x{args.image_size[1]})")
+    measure(ds)
 
 
 if __name__ == "__main__":

@@ -509,6 +509,38 @@ def test_report_on_canned_nan_run(tmp_path):
     assert "nonfinite-loss" in render_report(report)
 
 
+def test_report_input_bound_incident(tmp_path):
+    """When the data phase eats > 50% of step wall, the report derives
+    an ``input-bound`` incident naming the measured fed vs device rates
+    — the regression the device-aug path fixes can't return silently.
+    (The canned clean ledger above sits at 10% data and must NOT trip
+    it, which test_report_on_canned_clean_run already asserts.)"""
+    clock = FakeClock(1000.0)
+    path = str(tmp_path / "starved.jsonl")
+    led = RunLedger(path, meta={"entry": "train", "batch_size": 8},
+                    clock=clock)
+    spans = SpanRecorder(ledger=led, clock=clock, annotate=False)
+    for step in range(1, 21):
+        with spans.span("data"):
+            clock.advance(0.030)        # 75% of a 40 ms step: starved
+        with spans.span("dispatch"):
+            clock.advance(0.010)
+        spans.step_boundary()
+        if step % 10 == 0:
+            spans.flush(step)
+    led.close(summary={})
+    report = build_report(read_ledger(path))
+    assert report["stall_attribution_pct"]["data"] == pytest.approx(
+        75.0, abs=0.5)
+    (inc,) = report["incidents"]
+    assert inc["kind"] == "input-bound"
+    # fed = 8 items / 40 ms = 200/s; device = 8 / 10 ms = 800/s
+    assert "200.00 items/s" in inc["detail"]
+    assert "800.00 items/s" in inc["detail"]
+    assert "4.0x" in inc["detail"] and "--device_aug" in inc["detail"]
+    assert "input-bound" in render_report(report)
+
+
 def test_report_cli_contract(tmp_path, capsys):
     from raft_tpu.obs.__main__ import main
 
