@@ -14,7 +14,8 @@ import concurrent.futures
 import itertools
 import os
 import sys
-from typing import Dict, Iterator, Optional
+import time
+from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
 
@@ -66,7 +67,9 @@ class DataLoader:
                  num_workers: Optional[int] = None, drop_last: bool = True,
                  seed: int = 0, prefetch: int = 2,
                  pad_remainder: bool = False,
-                 process_index: int = 0, process_count: int = 1):
+                 process_index: int = 0, process_count: int = 1,
+                 retries: int = 2, retry_backoff: float = 0.05,
+                 on_incident: Optional[Callable[[str, str], None]] = None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -112,6 +115,19 @@ class DataLoader:
                     f"process_count {process_count}")
         self.process_index = process_index
         self.process_count = process_count
+        # Loader resilience (resilience layer): a failing __getitem__ is
+        # retried `retries` times with bounded exponential backoff, then
+        # the index is QUARANTINED and a deterministic substitute index
+        # is decoded instead — one rotten sample (bad file, flaky NFS)
+        # must not kill a multi-day run at f.result().  `on_incident`
+        # (kind, detail) makes every retry/quarantine a typed, ledger-
+        # visible event; quarantine decisions are deterministic given
+        # (seed, epoch, index), so the (seed, epoch) sample order stays
+        # replayable — a resumed run quarantines identically.
+        self.retries = max(int(retries), 0)
+        self.retry_backoff = retry_backoff
+        self.on_incident = on_incident
+        self.quarantined: Dict[int, str] = {}
         self.epoch = 0
 
     @property
@@ -129,6 +145,76 @@ class DataLoader:
             return n // self.batch_size
         return -(-n // self.batch_size)
 
+    def _incident(self, kind: str, detail: str) -> None:
+        if self.on_incident is not None:
+            self.on_incident(kind, detail)
+
+    def _substitute_index(self, idx: int, salt: int) -> int:
+        """Deterministic substitute for a quarantined index: a pure
+        function of (seed, epoch, idx, salt), so a replayed or resumed
+        (seed, epoch) run resamples identically."""
+        rng = np.random.default_rng((self.seed, self.epoch, int(idx), salt))
+        return int(rng.integers(len(self.dataset)))
+
+    def _fetch(self, idx: int):
+        """``dataset[idx]`` with retry, then quarantine-and-resample.
+
+        Retries `self.retries` times with bounded exponential backoff
+        (transient I/O: NFS hiccups, racing writers).  A sample that
+        keeps failing is quarantined — recorded, skipped for the rest of
+        the run — and a deterministic substitute index is decoded in its
+        place; substitutes that themselves fail get one attempt each
+        through a salted sequence before the loader gives up loudly.
+        """
+        last_err: Optional[BaseException] = None
+        if int(idx) not in self.quarantined:
+            delay = self.retry_backoff
+            for attempt in range(self.retries + 1):
+                try:
+                    sample = self.dataset[int(idx)]
+                    if attempt:
+                        self._incident(
+                            "sample-retried",
+                            f"sample {idx} succeeded on retry {attempt} "
+                            f"after {type(last_err).__name__}: {last_err}")
+                    return sample
+                except Exception as e:
+                    # broad by design: decode failures surface as OSError,
+                    # ValueError, cv2.error, ... — every one is retried,
+                    # then quarantined with the reason in the incident
+                    last_err = e
+                    if attempt < self.retries:
+                        time.sleep(min(delay, 1.0))
+                        delay *= 2
+            self.quarantined[int(idx)] = f"{type(last_err).__name__}: " \
+                                         f"{last_err}"
+            self._incident(
+                "sample-quarantined",
+                f"sample {idx} failed {self.retries + 1} attempts "
+                f"({type(last_err).__name__}: {last_err}); quarantined for "
+                f"this run, decoding deterministic substitute instead")
+        # quarantined (now or earlier): deterministic resample
+        for salt in range(8):
+            sub = self._substitute_index(idx, salt)
+            if sub == int(idx) or sub in self.quarantined:
+                continue
+            try:
+                return self.dataset[sub]
+            except Exception as e:
+                # a failed substitute is itself quarantined (one attempt,
+                # no retry budget): later quarantined samples that draw
+                # it must not pay the decode again
+                last_err = e
+                self.quarantined[sub] = f"{type(e).__name__}: {e}"
+                self._incident(
+                    "sample-quarantined",
+                    f"substitute {sub} for quarantined sample {idx} also "
+                    f"failed ({type(e).__name__}: {e}); quarantined too")
+        raise RuntimeError(
+            f"sample {idx} and 8 deterministic substitutes all failed; "
+            f"last error: {type(last_err).__name__}: {last_err} — "
+            f"dataset is unreadable, refusing to fabricate data")
+
     def _assemble(self, samples) -> Dict[str, np.ndarray]:
         batch = _stack_batch(samples)
         n = len(samples)
@@ -144,6 +230,15 @@ class DataLoader:
         return batch
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self.iter_from(0)
+
+    def iter_from(self, skip_batches: int = 0
+                  ) -> Iterator[Dict[str, np.ndarray]]:
+        """Iterate the epoch, skipping its first ``skip_batches`` batches
+        WITHOUT decoding them — the mid-epoch resume path: a run killed
+        at global step S re-enters epoch S // len(loader) and must
+        continue from batch S %% len(loader), not replay the epoch from
+        its start (the kill-and-resume equivalence gate pins this)."""
         n = len(self.dataset)
         rng = np.random.default_rng((self.seed, self.epoch))
         order = rng.permutation(n) if self.shuffle else np.arange(n)
@@ -159,6 +254,8 @@ class DataLoader:
             lo = self.process_index * lb
             batches = [idxs[lo:lo + lb] for idxs in batches
                        if len(idxs) == self.batch_size]
+        if skip_batches:
+            batches = batches[skip_batches:]
 
         # SAMPLE-level futures (round-3 rework): the old batch-level
         # submission decoded each batch serially in ONE thread, so
@@ -173,23 +270,32 @@ class DataLoader:
             pending = collections.deque()  # per-batch lists of futures
             batch_iter = iter(batches)
             for idxs in itertools.islice(batch_iter, self.prefetch + 1):
-                pending.append([ex.submit(self.dataset.__getitem__, int(i))
+                pending.append([ex.submit(self._fetch, int(i))
                                 for i in idxs])
             while pending:
+                # _fetch has already retried and resampled; a raise here
+                # means the dataset itself is unreadable (typed
+                # RuntimeError after quarantine exhaustion) — dying is
+                # correct, and the incident trail says why
                 samples = [f.result() for f in pending.popleft()]
                 nxt = next(batch_iter, None)
                 if nxt is not None:
                     pending.append(
-                        [ex.submit(self.dataset.__getitem__, int(i))
+                        [ex.submit(self._fetch, int(i))
                          for i in nxt])
                 yield self._assemble(samples)
 
-    def epochs(self, start_epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    def epochs(self, start_epoch: int = 0,
+               skip_batches: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         """Endless sample stream across epochs (the reference's
-        should_keep_training loop re-enters its loader, train.py:161-163)."""
+        should_keep_training loop re-enters its loader, train.py:161-163).
+
+        ``skip_batches`` skips that many batches of the FIRST epoch only
+        (mid-epoch resume; see :meth:`iter_from`)."""
         for epoch in itertools.count(start_epoch):
             self.set_epoch(epoch)
-            yield from self
+            yield from self.iter_from(
+                skip_batches if epoch == start_epoch else 0)
 
 
 def host_local_to_global(batch: Dict, sharding) -> Dict:

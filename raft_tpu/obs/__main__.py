@@ -1,9 +1,11 @@
 """CLI driver: ``python -m raft_tpu.obs report <ledger> [--json]``.
 
 Renders a run ledger (events.py) into throughput percentiles, per-phase
-stall attribution, memory watermarks and health incidents.  Exit codes:
-0 clean, 1 when ``--fail-on-incident`` is set and the ledger holds
-health incidents, 2 on usage errors — same ladder as graftlint.
+stall attribution, memory watermarks, health incidents and the
+resilience summary.  Exit codes: 0 clean, 1 when ``--fail-on-incident``
+trips (bare or ``any``: any incident; ``fatal``: only UNRECOVERED
+incidents — the chaos-run gate), 2 on usage errors — same ladder as
+graftlint.
 
 ``python -m raft_tpu.obs --selfcheck`` exercises the whole subsystem
 end-to-end (ledger round-trip, no-premature-sync metering with a
@@ -18,9 +20,11 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+from typing import Optional
 
 
-def run_report(path: str, as_json: bool, fail_on_incident: bool) -> int:
+def run_report(path: str, as_json: bool,
+               fail_on_incident: Optional[str]) -> int:
     from raft_tpu.obs.events import read_ledger, sanitize_json
     from raft_tpu.obs.report import build_report, render_report
 
@@ -40,7 +44,18 @@ def run_report(path: str, as_json: bool, fail_on_incident: bool) -> int:
                          allow_nan=False))
     else:
         print(render_report(report))
-    return 1 if (fail_on_incident and report["incidents"]) else 0
+    if fail_on_incident == "any" and report["incidents"]:
+        return 1
+    if fail_on_incident == "fatal":
+        # the chaos-run gate: recovered faults are the system WORKING;
+        # only unrecovered (fatal) incidents fail the run
+        fatal = [i for i in report["incidents"]
+                 if i.get("severity") == "fatal"]
+        if fatal:
+            print(f"obs report: {len(fatal)} unrecovered (fatal) "
+                  f"incident(s)", file=sys.stderr)
+            return 1
+    return 0
 
 
 def run_selfcheck() -> int:
@@ -159,8 +174,15 @@ def main(argv=None) -> int:
     rp.add_argument("ledger", help="path to an events.jsonl run ledger")
     rp.add_argument("--json", action="store_true",
                     help="machine-readable report")
-    rp.add_argument("--fail-on-incident", action="store_true",
-                    help="exit 1 when the ledger holds health incidents")
+    rp.add_argument("--fail-on-incident", nargs="?", const="any",
+                    default=None, choices=["any", "fatal"],
+                    help="exit 1 when the ledger holds health incidents: "
+                         "'any' (the default when the flag is given "
+                         "bare) fails on every incident; 'fatal' fails "
+                         "only on UNRECOVERED ones — recovered faults "
+                         "(retries, quarantines, skips, rollbacks, "
+                         "checkpoint fallbacks) pass, which is the gate "
+                         "chaos runs use")
     args = p.parse_args(argv)
 
     if args.selfcheck:

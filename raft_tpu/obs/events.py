@@ -23,11 +23,54 @@ kind            payload
                 ``step_times`` [per-step wall seconds]
 ``memory``      ``step``, ``devices`` {device: {bytes_in_use,
                 peak_bytes_in_use, bytes_limit}}, ``host_rss_bytes``
-``incident``    ``incident`` (the incident type, e.g. ``nonfinite-loss``,
-                ``recompile``), ``step``, ``detail`` — health sentinel
-                firings
+``incident``    ``incident`` (the incident type), ``step``, ``detail``,
+                ``severity`` — health sentinel / resilience firings
 ``run_end``     ``summary`` — final counters (steps, incidents, ...)
 ==============  ===========================================================
+
+Incident-type taxonomy (the ``incident`` field).  Severity is stamped
+per record (``severity``): **recovered** — the run absorbed the fault
+and kept training; **fatal** — training state or output is compromised;
+**warn** — advisory.  ``--fail-on-incident fatal`` gates on the
+unrecovered ones only:
+
+======================  ========  =====================================
+incident                severity  meaning
+======================  ========  =====================================
+``nonfinite-loss``      fatal     loss/grad-norm went non-finite and
+                                  the update was APPLIED (no recovery
+                                  policy active); state is poisoned
+``recompile``           warn      the jitted step retraced on a new
+                                  batch signature
+``input-bound``         warn      data stall > 50% of step wall
+                                  (derived at report time)
+``fault-injected``      warn      a scripted fault fired
+                                  (``--inject``; chaos runs)
+``sample-retried``      recovered loader retry succeeded after a
+                                  transient __getitem__ failure
+``sample-quarantined``  recovered a sample kept failing; quarantined,
+                                  deterministic substitute decoded
+``step-skipped``        recovered non-finite step; update discarded
+                                  in-graph (one incident per burst)
+``step-recovered``      recovered a skip burst ended before the
+                                  rollback threshold
+``rollback``            recovered consecutive skips reached
+                                  ``max_skip_steps``; restored the
+                                  newest verified checkpoint
+``ckpt-corrupt``        recovered a torn/corrupt checkpoint was
+                                  rejected at restore; fell back to
+                                  the next newest verified one
+``preempted``           recovered SIGTERM/SIGINT: state saved,
+                                  ``--resume`` continues the run
+``ckpt-save-failed``    fatal     a checkpoint save raised (full
+                                  disk); run terminates nonzero —
+                                  demoted to warn per-record when a
+                                  synchronous save immediately
+                                  re-protects the state (preemption
+                                  rescue, run end)
+``rollback-failed``     fatal     rollback wanted but no verified
+                                  checkpoint exists
+======================  ========  =====================================
 
 Append-only by construction: the file is opened in append mode and
 records are flushed per write, so a preempted/killed run keeps every
@@ -39,6 +82,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import uuid
 from typing import Dict, List, Optional
@@ -47,6 +91,39 @@ SCHEMA_VERSION = 1
 
 RECORD_KINDS = ("run_start", "metrics", "spans", "memory", "incident",
                 "run_end")
+
+# Default severity per incident type (see the taxonomy table above).
+# Writers may override per record (e.g. nonfinite-loss demotes to
+# "recovered" when the skip policy discarded the poisoned update);
+# readers use this map to classify records from older ledgers that
+# predate the severity field.
+INCIDENT_SEVERITIES = ("recovered", "fatal", "warn")
+DEFAULT_INCIDENT_SEVERITY = {
+    "nonfinite-loss": "fatal",
+    "ckpt-save-failed": "fatal",
+    "rollback-failed": "fatal",
+    "recompile": "warn",
+    "input-bound": "warn",
+    "fault-injected": "warn",
+    "sample-retried": "recovered",
+    "sample-quarantined": "recovered",
+    "step-skipped": "recovered",
+    "step-recovered": "recovered",
+    "rollback": "recovered",
+    "ckpt-corrupt": "recovered",
+    "preempted": "recovered",
+}
+
+
+def incident_severity(record: Dict) -> str:
+    """A record's severity: the stamped field when present (and valid),
+    else the taxonomy default for its type, else "warn" — unknown
+    incident kinds must not silently gate a chaos run."""
+    sev = record.get("severity")
+    if sev in INCIDENT_SEVERITIES:
+        return sev
+    return DEFAULT_INCIDENT_SEVERITY.get(
+        record.get("incident", record.get("kind")), "warn")
 
 
 def sanitize_json(obj):
@@ -80,22 +157,28 @@ class RunLedger:
         self.path = path
         self.run_id = run_id or uuid.uuid4().hex[:12]
         self._clock = clock
+        # loader workers (sample retry/quarantine incidents) and the
+        # async checkpointer (save-completion hooks) write from their
+        # own threads; interleaved partial lines would corrupt the JSONL
+        self._lock = threading.Lock()
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._fh = open(path, "a", encoding="utf-8")
         self.write("run_start", meta=dict(meta or {}))
 
     def write(self, kind: str, **payload) -> Dict:
-        """Append one record; returns the record as written."""
-        if self._fh is None:
-            raise ValueError(f"ledger {self.path} is closed")
+        """Append one record; returns the record as written.
+        Thread-safe: one record is one write under the ledger's lock."""
         rec = {"v": SCHEMA_VERSION, "kind": kind,
                "t": round(float(self._clock()), 6), "run": self.run_id}
         rec.update(payload)
         rec = sanitize_json(rec)
-        self._fh.write(json.dumps(rec, sort_keys=True, allow_nan=False)
-                       + "\n")
-        self._fh.flush()
+        line = json.dumps(rec, sort_keys=True, allow_nan=False) + "\n"
+        with self._lock:
+            if self._fh is None:
+                raise ValueError(f"ledger {self.path} is closed")
+            self._fh.write(line)
+            self._fh.flush()
         return rec
 
     # -- convenience writers (one per schema kind) --------------------------
@@ -112,11 +195,18 @@ class RunLedger:
         return self.write("memory", step=int(step), devices=devices,
                           host_rss_bytes=int(host_rss_bytes))
 
-    def incident(self, incident: str, step: int, detail: str) -> Dict:
+    def incident(self, incident: str, step: int, detail: str,
+                 severity: Optional[str] = None) -> Dict:
         # the record kind is "incident"; the incident's own type rides in
-        # the "incident" field (e.g. "nonfinite-loss")
+        # the "incident" field (e.g. "nonfinite-loss").  Severity is
+        # stamped at write time (taxonomy default unless overridden) so
+        # the report's recovered/fatal split never guesses.
+        if severity is not None and severity not in INCIDENT_SEVERITIES:
+            raise ValueError(f"unknown incident severity {severity!r} "
+                             f"(one of {INCIDENT_SEVERITIES})")
+        sev = severity or DEFAULT_INCIDENT_SEVERITY.get(incident, "warn")
         return self.write("incident", incident=incident, step=int(step),
-                          detail=detail)
+                          detail=detail, severity=sev)
 
     def run_end(self, summary: Dict) -> Dict:
         return self.write("run_end", summary=summary)
@@ -126,8 +216,10 @@ class RunLedger:
             return
         if summary is not None:
             self.run_end(summary)
-        self._fh.close()
-        self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self) -> "RunLedger":
         return self

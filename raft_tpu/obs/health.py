@@ -63,20 +63,29 @@ class HealthMonitor:
     ``add_window_hook`` and call ``observe_batch``/``sample_memory``
     from the loop."""
 
-    def __init__(self, ledger=None, metric: str = "loss"):
+    def __init__(self, ledger=None, metric: str = "loss",
+                 nonfinite_severity: str = "fatal"):
+        # nonfinite_severity: "fatal" by default (the update was applied;
+        # state is poisoned).  The train CLI passes "recovered" when the
+        # skip-nonfinite recovery policy is active — the same sentinel
+        # fires, but the poisoned update was discarded in-graph.
         self._ledger = ledger
         self.metric = metric
+        self.nonfinite_severity = nonfinite_severity
         self.incidents: List[Dict] = []
         self._nonfinite_steps = 0
         self._nonfinite_latched = False
         self._signatures: set = set()
         self.memory_watermarks: Dict[str, Dict[str, int]] = {}
 
-    def _record(self, kind: str, step: int, detail: str) -> None:
+    def _record(self, kind: str, step: int, detail: str,
+                severity: Optional[str] = None) -> None:
         self.incidents.append({"kind": kind, "step": int(step),
-                               "detail": detail})
+                               "detail": detail,
+                               **({"severity": severity} if severity
+                                  else {})})
         if self._ledger is not None:
-            self._ledger.incident(kind, step, detail)
+            self._ledger.incident(kind, step, detail, severity=severity)
 
     # -- non-finite sentinel (window hook) ---------------------------------
 
@@ -104,12 +113,18 @@ class HealthMonitor:
                         for k in (self.metric, "grad_norm")
                         if k in m and not math.isfinite(m[k])
                     ] or ["in-graph sentinel fired"]
+                    recovered = self.nonfinite_severity == "recovered"
                     self._record(
                         "nonfinite-loss", first_step + i,
                         f"{', '.join(culprits)} at step {first_step + i}"
-                        f" — first non-finite step of this run; training "
-                        f"state is poisoned from here (later occurrences "
-                        f"counted in run_end.summary, not re-reported)")
+                        f" — first non-finite step of this run; "
+                        + ("the update was discarded by the skip policy "
+                           "(state intact)"
+                           if recovered else
+                           "training state is poisoned from here")
+                        + " (later occurrences counted in "
+                          "run_end.summary, not re-reported)",
+                        severity=self.nonfinite_severity)
 
     # -- recompile sentinel ------------------------------------------------
 

@@ -125,8 +125,11 @@ def build_report(records: List[Dict]) -> Dict:
     steps = max([r.get("step", 0) for r in metrics_windows + span_windows]
                 or [0])
 
+    from raft_tpu.obs.events import incident_severity
+
     incident_rows = [{"kind": r.get("incident", "unknown"),
                       "step": r.get("step"),
+                      "severity": incident_severity(r),
                       "detail": r.get("detail", "")} for r in incidents]
     # Derived input-bound incident: when the data phase eats more than
     # half of every step, the pipeline is starving the device — the
@@ -148,13 +151,36 @@ def build_report(records: List[Dict]) -> Dict:
         else:
             unit = "steps/s"
         incident_rows.append({
-            "kind": "input-bound", "step": steps,
+            "kind": "input-bound", "step": steps, "severity": "warn",
             "detail": (f"data stall is {data_pct:.1f}% of step wall: the "
                        f"pipeline feeds {fed_rate:.2f} {unit} against a "
                        f"~{device_rate:.2f} {unit} device rate — "
                        f"input-bound by {device_rate / max(fed_rate, 1e-9):.1f}x; "
                        f"move augmentation on-device (--device_aug) or "
                        f"add host decode cores")})
+
+    # Resilience section: faults injected vs recovered, recovery latency.
+    # Injection counters and recovery counters ride in the run_end
+    # summary (train CLI: FaultPlan.summary() / RecoveryPolicy.summary());
+    # the recovered/fatal split comes from the per-record severities, so
+    # a chaos run can gate on "no *unrecovered* incidents".
+    by_severity: Dict[str, int] = {}
+    for row in incident_rows:
+        by_severity[row["severity"]] = by_severity.get(row["severity"], 0) + 1
+    faults_injected = (summary or {}).get("faults") or {}
+    recovery_counters = (summary or {}).get("recovery") or {}
+    resilience: Dict = {
+        "faults_injected": faults_injected,
+        "incidents_by_severity": by_severity,
+        "unrecovered": by_severity.get("fatal", 0),
+        "recovery": recovery_counters,
+    }
+    bursts = recovery_counters.get("skip_bursts", 0)
+    if bursts:
+        # recovery latency in steps: how long each fault burst held the
+        # run back before it recovered (skips per burst)
+        resilience["mean_recovery_latency_steps"] = round(
+            recovery_counters.get("skipped_steps", 0) / bursts, 2)
 
     return {
         "meta": meta,
@@ -171,6 +197,7 @@ def build_report(records: List[Dict]) -> Dict:
                                for k, v in phase_incl.items()},
         "memory_watermarks": watermarks,
         "incidents": incident_rows,
+        "resilience": resilience,
         "last_window_means": last_means,
         "run_end_summary": summary,
     }
@@ -245,10 +272,38 @@ def render_report(report: Dict) -> str:
     if incidents:
         lines.append(f"health incidents: {len(incidents)}")
         for inc in incidents:
-            lines.append(f"  [{inc['kind']}] step {inc['step']}: "
+            sev = inc.get("severity", "warn")
+            lines.append(f"  [{inc['kind']}/{sev}] step {inc['step']}: "
                          f"{inc['detail']}")
     else:
         lines.append("health incidents: none")
+
+    res = report.get("resilience", {})
+    if res.get("faults_injected") \
+            or any(res.get("recovery", {}).values()) \
+            or any(res.get("incidents_by_severity", {}).values()):
+        lines.append("")
+        lines.append("resilience:")
+        if res.get("faults_injected"):
+            lines.append("  faults injected: " + "  ".join(
+                f"{k}={v}" for k, v in
+                sorted(res["faults_injected"].items())))
+        sev = res.get("incidents_by_severity", {})
+        lines.append(
+            f"  incidents: {sev.get('recovered', 0)} recovered  "
+            f"{sev.get('fatal', 0)} fatal  {sev.get('warn', 0)} warn")
+        rec = res.get("recovery", {})
+        if rec:
+            lat = res.get("mean_recovery_latency_steps")
+            lines.append(
+                f"  recovery: {rec.get('skipped_steps', 0)} skipped "
+                f"step(s) in {rec.get('skip_bursts', 0)} burst(s), "
+                f"{rec.get('rollbacks', 0)} rollback(s)"
+                + (f", mean latency {lat} steps" if lat is not None
+                   else ""))
+        if res.get("unrecovered", 0):
+            lines.append(f"  UNRECOVERED fatal incidents: "
+                         f"{res['unrecovered']}")
 
     means = report["last_window_means"]
     if means:

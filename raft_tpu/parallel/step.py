@@ -32,7 +32,8 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
                              max_flow: float, freeze_bn: bool = False,
                              add_noise: bool = False, donate: bool = False,
                              accum_steps: int = 1,
-                             compiler_options=None, spans=None):
+                             compiler_options=None, spans=None,
+                             skip_nonfinite: bool = False):
     """Build the mesh-aware train step.
 
     Usage:
@@ -60,7 +61,8 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
     base = make_train_step(model, iters=iters, gamma=gamma, max_flow=max_flow,
                            freeze_bn=freeze_bn, add_noise=add_noise,
                            donate=donate, accum_steps=accum_steps,
-                           compiler_options=compiler_options)
+                           compiler_options=compiler_options,
+                           skip_nonfinite=skip_nonfinite)
     data_size = mesh.shape.get("data", 1)
     spans = spans if spans is not None else NULL
 

@@ -1,0 +1,31 @@
+"""Resilience layer: faults as first-class, injectable, recoverable.
+
+Three pieces, one discipline — every fault path either recovers (and
+says so with a typed incident in the run ledger) or terminates loudly;
+nothing corrupts silently:
+
+- :mod:`raft_tpu.resilience.faults` — deterministic fault injection
+  (``--inject sigterm@120,ckpt-torn@2,sample-ioerror@37:3,
+  nonfinite-burst@55:4``) driven by the train CLI, the chaos dryrun
+  (scripts/chaos_dryrun.py) and tests;
+- :mod:`raft_tpu.resilience.recovery` — the step-recovery policy: on a
+  non-finite loss/grad the update is discarded in-graph (state
+  passthrough), consecutive skips are counted at the metrics-window
+  boundary, and after ``max_skip_steps`` the run rolls back to the
+  newest *verified* checkpoint;
+- checkpoint hardening lives with the checkpoints themselves
+  (training/state.py: per-save manifest, verify-on-restore,
+  fallback restore, keep-last-k retention).
+"""
+
+from raft_tpu.resilience.faults import (Fault, FaultInjectingDataset,
+                                        FaultPlan, parse_fault_spec)
+from raft_tpu.resilience.recovery import RecoveryPolicy
+
+__all__ = [
+    "Fault",
+    "FaultInjectingDataset",
+    "FaultPlan",
+    "RecoveryPolicy",
+    "parse_fault_spec",
+]

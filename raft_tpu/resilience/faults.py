@@ -1,0 +1,242 @@
+"""Deterministic fault injection: the chaos harness the train CLI,
+bench and tests all drive.
+
+A fault spec is a comma-separated list of ``kind@arg[:count]`` items;
+steps are 1-based (the same indexing ledger incidents use):
+
+==============================  ==========================================
+spec item                       effect
+==============================  ==========================================
+``sigterm@S``                   raise SIGTERM in-process at the start of
+                                step S — exercises the preemption
+                                handler's save-and-exit path exactly as
+                                an external kill would, but at a
+                                reproducible step
+``ckpt-torn@K``                 truncate the K-th completed checkpoint
+                                save to half its bytes AFTER the atomic
+                                rename — a torn/corrupted file at rest,
+                                the case verify-on-restore exists for
+``sample-ioerror@IDX:N``        dataset index IDX raises OSError on its
+                                first N fetch attempts (N defaults to 1)
+                                — drives the loader's retry, then (when N
+                                exceeds the retry budget) the
+                                quarantine-and-resample path
+``nonfinite-burst@S:N``         poison the ground-truth flow with NaN
+                                for N consecutive steps starting at S (N
+                                defaults to 1) — drives the nonfinite
+                                sentinel, the in-graph update skip, and
+                                (when N reaches ``max_skip_steps``) the
+                                rollback escalation.  Generalizes the
+                                older ``--inject_nan_step``
+==============================  ==========================================
+
+Everything is deterministic: the plan is pure state derived from the
+spec, so a chaos run is replayable bit-for-bit.  The plan never prints —
+it reports what it did through ``record`` callbacks and ``summary()``
+(which the train CLI folds into the ledger's run_end record).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+from typing import Callable, Dict, List, Optional
+
+FAULT_KINDS = ("sigterm", "ckpt-torn", "sample-ioerror", "nonfinite-burst")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One parsed spec item: ``kind@arg[:count]``."""
+
+    kind: str
+    arg: int            # step (sigterm/nonfinite-burst), save ordinal
+                        # (ckpt-torn), or sample index (sample-ioerror)
+    count: int = 1      # burst length / failure count
+
+
+def parse_fault_spec(spec: Optional[str]) -> List[Fault]:
+    """Parse ``kind@arg[:count],...`` into :class:`Fault` items.
+
+    Raises ``ValueError`` with the offending item on any malformed spec
+    — a chaos run with a typo'd fault silently testing nothing would be
+    the exact failure mode this layer exists to kill.
+    """
+    faults: List[Fault] = []
+    if not spec:
+        return faults
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "@" not in item:
+            raise ValueError(
+                f"fault spec item {item!r} lacks '@' (grammar: "
+                f"kind@arg[:count], kinds: {', '.join(FAULT_KINDS)})")
+        kind, _, args = item.partition("@")
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {item!r} "
+                f"(known: {', '.join(FAULT_KINDS)})")
+        arg_s, _, count_s = args.partition(":")
+        try:
+            arg = int(arg_s)
+            count = int(count_s) if count_s else 1
+        except ValueError:
+            raise ValueError(
+                f"fault spec item {item!r}: arg/count must be integers")
+        if arg < (0 if kind == "sample-ioerror" else 1) or count < 1:
+            raise ValueError(
+                f"fault spec item {item!r}: arg/count out of range")
+        faults.append(Fault(kind, arg, count))
+    return faults
+
+
+class FaultInjectingDataset:
+    """Dataset proxy that raises OSError for scripted (index, attempt)
+    pairs — the ``sample-ioerror`` fault, injected below the loader so
+    the loader's retry/quarantine machinery is exercised for real.
+
+    Thread-safe: loader workers fetch concurrently, so the per-index
+    attempt counters are lock-guarded.
+    """
+
+    def __init__(self, dataset, faults: List[Fault],
+                 record: Optional[Callable[[str, str], None]] = None):
+        self._dataset = dataset
+        self._record = record
+        self._budget: Dict[int, int] = {}
+        for f in faults:
+            if f.kind == "sample-ioerror":
+                self._budget[f.arg] = self._budget.get(f.arg, 0) + f.count
+        self._lock = threading.Lock()
+        self.injected = 0
+
+    def __len__(self) -> int:
+        return len(self._dataset)
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self._dataset, "set_epoch"):
+            self._dataset.set_epoch(epoch)
+
+    def __getattr__(self, name):
+        return getattr(self._dataset, name)
+
+    def __getitem__(self, index):
+        with self._lock:
+            remaining = self._budget.get(int(index), 0)
+            if remaining > 0:
+                self._budget[int(index)] = remaining - 1
+                self.injected += 1
+        if remaining > 0:
+            if self._record is not None:
+                self._record("fault-injected",
+                             f"sample-ioerror: raising for index {index} "
+                             f"({remaining - 1} injections left)")
+            raise OSError(f"injected sample-ioerror for index {index}")
+        return self._dataset[index]
+
+
+class FaultPlan:
+    """The scripted faults of one run, with one hook per injection site.
+
+    The train loop calls :meth:`on_step_start` / :meth:`poison_batch`
+    each step and wires :meth:`after_checkpoint_save` into the
+    checkpointer; :meth:`wrap_dataset` goes around the dataset before
+    the loader sees it.  ``record(kind, detail)`` (optional) receives a
+    ``fault-injected`` note per firing so injected faults are visible in
+    the same ledger their recovery incidents land in.
+    """
+
+    def __init__(self, faults: List[Fault],
+                 record: Optional[Callable[[str, str], None]] = None):
+        self.faults = list(faults)
+        self._record_cb = record
+        self._saves_seen = 0
+        self._torn_ordinals = {f.arg for f in faults
+                               if f.kind == "ckpt-torn"}
+        self._sigterm_steps = {f.arg for f in faults if f.kind == "sigterm"}
+        self._nan_steps = set()
+        for f in faults:
+            if f.kind == "nonfinite-burst":
+                self._nan_steps.update(range(f.arg, f.arg + f.count))
+        self.injected: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
+        self._wrapped: Optional[FaultInjectingDataset] = None
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str],
+                  record: Optional[Callable[[str, str], None]] = None
+                  ) -> "FaultPlan":
+        return cls(parse_fault_spec(spec), record=record)
+
+    def _note(self, detail: str) -> None:
+        if self._record_cb is not None:
+            self._record_cb("fault-injected", detail)
+
+    # -- injection sites -----------------------------------------------------
+
+    def wrap_dataset(self, dataset):
+        """Wrap ``dataset`` so scripted ``sample-ioerror`` faults fire on
+        fetch; a no-op passthrough when the plan holds none."""
+        if not any(f.kind == "sample-ioerror" for f in self.faults):
+            return dataset
+        self._wrapped = FaultInjectingDataset(
+            dataset, self.faults, record=self._record_cb)
+        return self._wrapped
+
+    def on_step_start(self, step: int) -> None:
+        """``sigterm``: raise the real signal in-process at step ``step``
+        (1-based) — the installed preemption handler turns it into the
+        save-and-exit flag, exactly like an external preemption."""
+        if step in self._sigterm_steps:
+            self._sigterm_steps.discard(step)
+            self.injected["sigterm"] += 1
+            self._note(f"sigterm: raising SIGTERM at step {step}")
+            if hasattr(signal, "raise_signal"):
+                signal.raise_signal(signal.SIGTERM)
+            else:  # py<3.8 fallback, same delivery
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    def poisons_step(self, step: int) -> bool:
+        return step in self._nan_steps
+
+    def poison_batch(self, step: int, batch):
+        """``nonfinite-burst``: NaN-poison the ground-truth flow for a
+        scripted step.  Dtype/shape-preserving, so the recompile sentinel
+        must NOT fire — only the nonfinite one.  f32 wire only (int16
+        cannot carry NaN; the caller validates before the loop)."""
+        if step not in self._nan_steps:
+            return batch
+        import jax.numpy as jnp
+
+        self.injected["nonfinite-burst"] += 1
+        self._note(f"nonfinite-burst: poisoning ground-truth flow at "
+                   f"step {step}")
+        batch = dict(batch)
+        batch["flow"] = batch["flow"] * jnp.float32(jnp.nan)
+        return batch
+
+    def after_checkpoint_save(self, path: str) -> None:
+        """``ckpt-torn``: after the K-th completed save's atomic rename,
+        truncate the file to half its bytes — simulating at-rest
+        corruption that the rename protocol cannot prevent and only
+        verify-on-restore can catch."""
+        self._saves_seen += 1
+        if self._saves_seen not in self._torn_ordinals:
+            return
+        self.injected["ckpt-torn"] += 1
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        self._note(f"ckpt-torn: truncated save #{self._saves_seen} "
+                   f"({path}) from {size} to {max(size // 2, 1)} bytes")
+
+    # -- reporting -----------------------------------------------------------
+
+    def summary(self) -> Dict[str, int]:
+        """Injected-fault counters for the ledger's run_end record."""
+        if self._wrapped is not None:
+            self.injected["sample-ioerror"] = self._wrapped.injected
+        return {k: v for k, v in self.injected.items() if v}

@@ -1,0 +1,116 @@
+"""Step-recovery policy: skip poisoned updates, escalate to rollback.
+
+The mechanism is split across the graph/host boundary the same way the
+health sentinels are (obs/health.py):
+
+- **In-graph** (training/step.py, ``skip_nonfinite=True``): when the
+  step's loss or grad-norm is non-finite, every leaf of the output
+  train state is ``where``-selected back to the INPUT state — the
+  optimizer never advances, the PRNG never splits, the poisoned
+  gradients never touch params.  Two scalar compares the step already
+  computes; no host sync, no extra pass.
+- **Host-side** (this class): the metrics-window hook sees each
+  window's per-step host values (the one place per-step scalars are
+  already host-converted), counts *consecutive* skipped steps, latches
+  one ``step-skipped`` incident per burst, and after ``max_skip_steps``
+  consecutive skips raises ``rollback_needed`` — the train loop then
+  restores the newest verified checkpoint and records a ``rollback``
+  incident with the burst length as its recovery latency.
+
+Rollback granularity is the metrics window (``--sum_freq``): the skip
+itself protects state every step, so the only cost of the windowed
+check is rollback latency, never corruption.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+
+class RecoveryPolicy:
+    """Counts skipped updates and decides when skipping is not enough.
+
+    Wire ``on_window`` into the metrics bus
+    (``logger.bus.add_window_hook``); poll ``rollback_needed`` at window
+    boundaries; call ``rolled_back``/``recovered`` when the loop acts.
+    """
+
+    def __init__(self, max_skip_steps: int,
+                 record: Optional[Callable[[str, int, str], None]] = None):
+        if max_skip_steps < 1:
+            raise ValueError(
+                f"max_skip_steps must be >= 1, got {max_skip_steps} "
+                f"(use skip_nonfinite=False to disable recovery)")
+        self.max_skip_steps = max_skip_steps
+        self._record = record
+        self.consecutive = 0
+        self.total_skipped = 0
+        self.bursts = 0
+        self.rollbacks = 0
+        self.rollback_needed = False
+        self._burst_start: Optional[int] = None
+
+    def on_window(self, first_step: int,
+                  per_step: List[Dict[str, float]]) -> None:
+        """MetricsBus window hook: scan the just-converted host values
+        for skipped steps (the in-graph ``skipped`` flag)."""
+        for i, m in enumerate(per_step):
+            step = first_step + i
+            if m.get("skipped", 0.0) > 0.0:
+                self.consecutive += 1
+                self.total_skipped += 1
+                if self.consecutive == 1:
+                    self.bursts += 1
+                    self._burst_start = step
+                    if self._record is not None:
+                        # one incident per burst: a long burst is one
+                        # event, and its length lands in the rollback /
+                        # recovery record, not in N duplicate lines
+                        self._record(
+                            "step-skipped", step,
+                            f"non-finite loss/grad at step {step}: update "
+                            f"discarded in-graph (state passthrough, no "
+                            f"optimizer advance); rollback after "
+                            f"{self.max_skip_steps} consecutive skips")
+                if (self.consecutive >= self.max_skip_steps
+                        and not self.rollback_needed):
+                    self.rollback_needed = True
+            elif self.consecutive:
+                burst, self.consecutive = self.consecutive, 0
+                if self.rollback_needed:
+                    # the burst hit the threshold but ended on its own
+                    # INSIDE this window, before the loop could act at a
+                    # boundary: state never advanced during the burst
+                    # (updates were skipped), so rolling back now would
+                    # discard the good finite steps — stand down
+                    self.rollback_needed = False
+                if self._record is not None:
+                    self._record(
+                        "step-recovered", step,
+                        f"finite again at step {step} after {burst} "
+                        f"skipped step(s) (burst began at step "
+                        f"{self._burst_start})")
+                self._burst_start = None
+
+    def rolled_back(self, step: int, ckpt_path: str, ckpt_step: int) -> None:
+        """The loop restored a verified checkpoint; reset the burst."""
+        self.rollbacks += 1
+        burst = self.consecutive
+        self.consecutive = 0
+        self.rollback_needed = False
+        self._burst_start = None
+        if self._record is not None:
+            self._record(
+                "rollback", step,
+                f"{burst} consecutive skipped steps reached "
+                f"max_skip_steps={self.max_skip_steps}: restored verified "
+                f"checkpoint {ckpt_path} (step {ckpt_step}); recovery "
+                f"latency {burst} steps")
+
+    def summary(self) -> Dict[str, int]:
+        """Counters for the ledger's run_end record."""
+        return {
+            "skipped_steps": self.total_skipped,
+            "skip_bursts": self.bursts,
+            "rollbacks": self.rollbacks,
+        }

@@ -58,6 +58,19 @@ def install_preemption_handler(extra: Optional[Callable] = None) -> None:
 class AsyncCheckpointer:
     """One in-flight background save; subsequent saves wait for it.
 
+    Background-thread failures (full disk, dead mount) are captured and
+    RE-RAISED on the next ``save()``/``wait()`` call — a save error must
+    never die with its thread, or checkpointing silently stops while
+    training marches on.  ``pending_error()`` lets a loop surface the
+    failure at the step boundary where it can act (typed incident,
+    rescue save) without waiting for the next periodic save.
+
+    ``fingerprint`` rides into every save's manifest (training/state.py);
+    ``keep``>0 applies keep-last-k retention after each completed save;
+    ``on_saved(path)`` fires after the atomic rename (and before
+    retention) — the fault-injection hook (``ckpt-torn``) and any
+    save-completion telemetry attach here.
+
     Usage:
         ckpt = AsyncCheckpointer()
         ...
@@ -66,26 +79,49 @@ class AsyncCheckpointer:
         ckpt.wait()              # before process exit
     """
 
-    def __init__(self):
+    def __init__(self, fingerprint: Optional[str] = None,
+                 keep: int = 0, prefix: str = "",
+                 on_saved: Optional[Callable[[str], None]] = None):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self._fingerprint = fingerprint
+        self._keep = keep
+        self._prefix = prefix
+        self._on_saved = on_saved
 
     def save(self, path: str, state: TrainState) -> None:
         import jax
+
+        from raft_tpu.training.state import prune_checkpoints
 
         self.wait()  # serialize in-flight saves; surfaces prior errors
         host_state = jax.device_get(state)
 
         def _write():
             try:
-                tmp = path + ".tmp"
-                save_checkpoint(tmp, host_state)
-                os.replace(tmp, path)  # atomic on POSIX
+                # internally atomic (tmp + rename) and manifest-writing
+                save_checkpoint(path, host_state,
+                                fingerprint=self._fingerprint)
+                if self._on_saved is not None:
+                    self._on_saved(path)
+                if self._keep > 0:
+                    prune_checkpoints(os.path.dirname(path) or ".",
+                                      self._prefix, self._keep)
             except BaseException as e:  # surfaced on next save/wait
                 self._error = e
 
         self._thread = threading.Thread(target=_write, daemon=True)
         self._thread.start()
+
+    def pending_error(self) -> Optional[BaseException]:
+        """The last background save's failure, if it has already died —
+        non-blocking, does not clear the error (``wait()``/``save()``
+        still raise it).  Lets the training loop notice a dead disk at
+        the NEXT step instead of the next val_freq boundary."""
+        if self._thread is not None and not self._thread.is_alive():
+            self._thread.join()
+            self._thread = None
+        return self._error
 
     def wait(self) -> None:
         if self._thread is not None:

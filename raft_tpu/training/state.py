@@ -4,12 +4,25 @@ Superset of the reference's checkpointing (train.py:185-187 saves only the
 model state_dict; optimizer/scheduler/step are lost on resume — SURVEY.md §5).
 Here the full state (params, batch_stats, optimizer state, step, PRNG key)
 is saved, so resume continues the schedule exactly.
+
+Checkpoint integrity (the resilience layer): every save is an atomic
+tmp-write + rename AND ships a sidecar manifest
+(``<ckpt>.manifest.json``: step, config fingerprint, byte size, sha256
+content checksum).  Restore verifies before trusting:
+:func:`verify_checkpoint` catches torn/truncated/at-rest-corrupted
+files, and :func:`restore_latest_verified` walks candidates newest-first
+so a corrupt latest falls back to the newest *verified* checkpoint with
+a typed ``ckpt-corrupt`` incident instead of crashing ``--resume``.
+:func:`prune_checkpoints` implements keep-last-k retention (the final
+un-numbered save is never pruned).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import flax
 import jax
@@ -41,8 +54,48 @@ def create_train_state(model, tx, rng, sample_batch, iters: int = 12):
 # Checkpoint I/O (msgpack via flax serialization; host-side, device-agnostic)
 # ----------------------------------------------------------------------------
 
-def save_checkpoint(path: str, state: TrainState) -> str:
-    """Serialize full train state to ``path`` (msgpack)."""
+MANIFEST_SUFFIX = ".manifest.json"
+MANIFEST_VERSION = 1
+
+
+def config_fingerprint(*configs) -> str:
+    """Stable 16-hex-digit fingerprint of the run's config objects.
+
+    Saved into each checkpoint manifest so a restore can say WHICH
+    config produced the bytes it is about to trust; dataclasses repr
+    deterministically, and anything else falls back to repr too.
+    """
+    blob = "\x1e".join(repr(c) for c in configs)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # atomic on POSIX
+
+
+def manifest_path(path: str) -> str:
+    return path + MANIFEST_SUFFIX
+
+
+def save_checkpoint(path: str, state: TrainState,
+                    fingerprint: Optional[str] = None) -> str:
+    """Serialize full train state to ``path`` (msgpack).
+
+    Atomic: bytes land in ``<path>.tmp`` (fsync'd) and are renamed into
+    place, so a kill mid-write never leaves a half-written file under
+    the checkpoint's name.  A sidecar manifest (step, fingerprint, size,
+    sha256 of the exact bytes just renamed) is written second — also
+    atomically — so :func:`verify_checkpoint` can prove the bytes at
+    rest are the bytes that were saved.  The checkpoint rename happens
+    FIRST: a kill between the two renames leaves a valid checkpoint with
+    no manifest (degrades to legacy parse-verification), never a
+    manifest describing bytes that don't exist.
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     payload = {
         "params": jax.device_get(state.params),
@@ -53,9 +106,56 @@ def save_checkpoint(path: str, state: TrainState) -> str:
     }
     # optax states are NamedTuples; convert to plain dicts for msgpack
     payload = flax.serialization.to_state_dict(payload)
-    with open(path, "wb") as f:
-        f.write(flax.serialization.msgpack_serialize(payload))
+    data = flax.serialization.msgpack_serialize(payload)
+    _atomic_write_bytes(path, data)
+    manifest = {
+        "v": MANIFEST_VERSION,
+        "step": int(jax.device_get(state.step)),
+        "fingerprint": fingerprint,
+        "size": len(data),
+        "sha256": hashlib.sha256(data).hexdigest(),
+    }
+    _atomic_write_bytes(manifest_path(path),
+                        json.dumps(manifest, sort_keys=True).encode("utf-8"))
     return path
+
+
+def verify_checkpoint(path: str) -> Tuple[bool, str]:
+    """Is the checkpoint at ``path`` trustworthy?  Returns (ok, reason).
+
+    With a manifest: the file's size and sha256 must match the bytes the
+    save recorded — catches torn writes, truncation and bit rot without
+    deserializing.  Without one (legacy/pre-manifest saves, or a kill
+    between the two save renames): the msgpack must at least parse.
+    """
+    if not os.path.isfile(path):
+        return False, "missing file"
+    size = os.path.getsize(path)
+    if size == 0:
+        return False, "zero-byte file"
+    mpath = manifest_path(path)
+    if os.path.isfile(mpath):
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return False, f"unreadable manifest ({e})"
+        if manifest.get("size") != size:
+            return False, (f"size mismatch: manifest says "
+                           f"{manifest.get('size')} bytes, file has {size} "
+                           f"— torn or truncated write")
+        with open(path, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        if digest != manifest.get("sha256"):
+            return False, "sha256 mismatch — content corrupted at rest"
+        return True, "manifest verified"
+    # legacy checkpoint: no manifest to check against; parse as proof
+    try:
+        with open(path, "rb") as f:
+            flax.serialization.msgpack_restore(f.read())
+    except Exception as e:  # msgpack raises library-private types
+        return False, f"no manifest and msgpack unparseable ({e})"
+    return True, "no manifest (legacy); msgpack parses"
 
 
 def _migrate_mask_head(node):
@@ -108,14 +208,17 @@ def restore_checkpoint(path: str, state: TrainState,
     )
 
 
-def latest_checkpoint(ckpt_dir: str, prefix: str = "") -> Optional[str]:
-    """Most recently modified checkpoint in a directory (for auto-resume
-    after preemption — the failure-recovery mechanism the reference lacks).
+def checkpoint_candidates(ckpt_dir: str, prefix: str = "") -> List[str]:
+    """Resumable checkpoints in ``ckpt_dir``, newest-first by mtime.
 
     Matches both periodic saves (``{step}_{name}.msgpack``) and the final
-    ``{name}.msgpack``."""
+    ``{name}.msgpack``.  In-progress temp files from the atomic-rename
+    protocol (``*.tmp`` — never ``.msgpack``-suffixed by construction,
+    and excluded again here for belt-and-braces) and zero-byte files
+    (a full disk's calling card) are never candidates.
+    """
     if not os.path.isdir(ckpt_dir):
-        return None
+        return []
 
     def _matches(f: str) -> bool:
         if not f.endswith(".msgpack"):
@@ -128,8 +231,97 @@ def latest_checkpoint(ckpt_dir: str, prefix: str = "") -> Optional[str]:
         return (stem.endswith("_" + prefix)
                 and stem[:-len(prefix) - 1].isdigit())
 
+    def _size(p: str) -> int:
+        # tolerate concurrent pruning (the async checkpointer's
+        # keep-last-k runs on a background thread): a file deleted
+        # between listdir and stat simply stops being a candidate
+        try:
+            return os.path.getsize(p)
+        except OSError:
+            return 0
+
+    def _mtime(p: str) -> float:
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return float("-inf")   # vanished: sort last; verify rejects it
+
     cands = [os.path.join(ckpt_dir, f) for f in os.listdir(ckpt_dir)
              if _matches(f)]
-    if not cands:
-        return None
-    return max(cands, key=os.path.getmtime)
+    cands = [c for c in cands if _size(c) > 0]
+    return sorted(cands, key=_mtime, reverse=True)
+
+
+def latest_checkpoint(ckpt_dir: str, prefix: str = "") -> Optional[str]:
+    """Most recently modified checkpoint in a directory (for auto-resume
+    after preemption — the failure-recovery mechanism the reference
+    lacks).  See :func:`checkpoint_candidates` for what qualifies."""
+    cands = checkpoint_candidates(ckpt_dir, prefix)
+    return cands[0] if cands else None
+
+
+def restore_latest_verified(
+        ckpt_dir: str, state: TrainState, prefix: str = "",
+        on_incident: Optional[Callable[[str, str], None]] = None,
+) -> Tuple[Optional[TrainState], Optional[str]]:
+    """Restore the newest checkpoint that VERIFIES, falling back past
+    torn/corrupt ones.
+
+    Walks :func:`checkpoint_candidates` newest-first; each candidate is
+    integrity-checked (:func:`verify_checkpoint`) and then restored
+    under a catch — a checkpoint whose bytes verify but whose tree no
+    longer matches the model still must not kill ``--resume`` while an
+    older good save exists.  Every rejected candidate produces one
+    ``on_incident("ckpt-corrupt", detail)`` callback, so the fallback is
+    a typed, ledger-visible event, not a silent downgrade.
+
+    Returns ``(restored_state, path)``, or ``(None, None)`` when no
+    candidate survives (the caller decides whether that is fatal).
+    """
+    for path in checkpoint_candidates(ckpt_dir, prefix):
+        ok, reason = verify_checkpoint(path)
+        if not ok:
+            if on_incident is not None:
+                on_incident("ckpt-corrupt",
+                            f"{path}: {reason}; falling back to the next "
+                            f"newest checkpoint")
+            continue
+        try:
+            return restore_checkpoint(path, state), path
+        except Exception as e:  # torn msgpack raises library-private types
+            if on_incident is not None:
+                on_incident("ckpt-corrupt",
+                            f"{path}: verified but restore failed "
+                            f"({type(e).__name__}: {e}); falling back to "
+                            f"the next newest checkpoint")
+    return None, None
+
+
+def prune_checkpoints(ckpt_dir: str, prefix: str, keep: int) -> List[str]:
+    """Keep-last-k retention over step-numbered saves.
+
+    Deletes the oldest ``{step}_{prefix}.msgpack`` files (and their
+    manifests) beyond the ``keep`` most recent BY STEP NUMBER; the final
+    un-numbered ``{prefix}.msgpack`` is never touched, nor is any other
+    experiment's file.  Returns the paths removed.  ``keep < 1`` is a
+    no-op (retention off).
+    """
+    if keep < 1 or not os.path.isdir(ckpt_dir):
+        return []
+    numbered = []
+    for f in os.listdir(ckpt_dir):
+        if not f.endswith(".msgpack"):
+            continue
+        stem = f[:-len(".msgpack")]
+        if prefix and stem.endswith("_" + prefix) \
+                and stem[:-len(prefix) - 1].isdigit():
+            numbered.append((int(stem[:-len(prefix) - 1]),
+                             os.path.join(ckpt_dir, f)))
+    numbered.sort()
+    removed = []
+    for _, path in numbered[:-keep] if len(numbered) > keep else []:
+        for p in (path, manifest_path(path)):
+            if os.path.isfile(p):
+                os.remove(p)
+        removed.append(path)
+    return removed

@@ -23,7 +23,8 @@ from raft_tpu.training.state import TrainState
 def make_train_step(model, iters: int, gamma: float, max_flow: float,
                     freeze_bn: bool = False, add_noise: bool = False,
                     donate: bool = False, accum_steps: int = 1,
-                    compiler_options: Dict[str, str] = None):
+                    compiler_options: Dict[str, str] = None,
+                    skip_nonfinite: bool = False):
     """Build a jit-compiled train step for ``model``.
 
     The optional noise augmentation matches train.py:167-170: N(0, sigma)
@@ -51,6 +52,16 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
     (same class of deviation as data-parallel per-replica BN, which the
     reference has, SURVEY.md §5), and dropout draws an independent mask
     per micro batch.
+
+    skip_nonfinite=True: the step-recovery policy's in-graph half
+    (resilience/recovery.py).  When the nonfinite sentinel fires (loss
+    or grad-norm not finite), every leaf of the output state is
+    ``where``-selected back to the INPUT state — pure passthrough: no
+    optimizer advance, no PRNG split, no batch_stats update, so one
+    poisoned batch cannot contaminate training state.  Costs two scalar
+    compares the step already computes plus a per-leaf select XLA fuses
+    into the update; adds a ``skipped`` metric (the host-side policy
+    counts consecutive skips at the window boundary).
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -163,6 +174,18 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
         # per-step host sync or extra pass over the gradients.
         metrics["nonfinite"] = nonfinite_sentinel(metrics["loss"],
                                                   metrics["grad_norm"])
+        if skip_nonfinite:
+            # Step recovery, in-graph half: discard the poisoned update
+            # entirely — the output state IS the input state when the
+            # sentinel fired.  jnp.where with a scalar predicate keeps
+            # every leaf's dtype (params f32/bf16, step/opt counters
+            # int32, rng uint32) and fuses into the update computation;
+            # no host sync, no extra pass.
+            bad = metrics["nonfinite"] > 0.0
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(bad, old, new),
+                new_state, state)
+            metrics["skipped"] = metrics["nonfinite"]
         return new_state, metrics
 
     if not compiler_options:

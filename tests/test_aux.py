@@ -55,8 +55,15 @@ def test_async_checkpointer_serializes_saves(tmp_path):
     for i in range(3):
         ckpt.save(str(tmp_path / f"{i}.msgpack"), state)
     ckpt.wait()
-    assert sorted(os.listdir(tmp_path)) == [
-        "0.msgpack", "1.msgpack", "2.msgpack"]
+    saved = sorted(f for f in os.listdir(tmp_path)
+                   if f.endswith(".msgpack"))
+    assert saved == ["0.msgpack", "1.msgpack", "2.msgpack"]
+    # every save also shipped its integrity manifest
+    from raft_tpu.training.state import manifest_path, verify_checkpoint
+    for f in saved:
+        assert os.path.exists(manifest_path(str(tmp_path / f)))
+        ok, reason = verify_checkpoint(str(tmp_path / f))
+        assert ok, reason
 
 
 def test_preemption_flag_via_signal():

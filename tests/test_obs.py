@@ -626,6 +626,13 @@ def test_train_dryrun_writes_ledger_and_report_attributes(tmp_path):
     train_cli.main(common + ["--name", "nan", "--inject_nan_step", "10"])
     nan_ledger = tmp_path / "runs" / "nan" / "events.jsonl"
     nan_report = build_report(read_ledger(str(nan_ledger)))
-    (inc,) = nan_report["incidents"]
-    assert inc["kind"] == "nonfinite-loss"
+    # the legacy flag now routes through the fault harness, which also
+    # notes its own firing (fault-injected); the sentinel contract is
+    # unchanged: exactly one nonfinite-loss, at the injected step, and
+    # fatal (no recovery policy was enabled)
+    (inc,) = [i for i in nan_report["incidents"]
+              if i["kind"] == "nonfinite-loss"]
     assert inc["step"] == 10      # exactly the injected (1-based) step
+    assert inc["severity"] == "fatal"
+    assert [i["kind"] for i in nan_report["incidents"]].count(
+        "fault-injected") == 1

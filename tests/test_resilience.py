@@ -1,0 +1,744 @@
+"""Resilience-layer tests: fault injection, checkpoint integrity +
+fallback restore, loader retry/quarantine, step-recovery policy, the
+incident-severity gate — and the flagship kill-and-resume equivalence
+gate (ROADMAP item 3's acceptance: a SIGTERMed-and-resumed run provably
+matches the unkilled loss trajectory).
+
+The fast half runs in tier-1 (no model, no training); the subprocess
+end-to-end gates ride the slow marker like the other acceptance
+dryruns (test_dist_multiprocess, test_obs's dryrun twin).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fault spec grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_fault_spec_grammar():
+    from raft_tpu.resilience import Fault, parse_fault_spec
+
+    assert parse_fault_spec(None) == []
+    assert parse_fault_spec("") == []
+    faults = parse_fault_spec(
+        "sigterm@120, ckpt-torn@2,sample-ioerror@37:3,nonfinite-burst@55:4")
+    assert faults == [
+        Fault("sigterm", 120, 1),
+        Fault("ckpt-torn", 2, 1),
+        Fault("sample-ioerror", 37, 3),
+        Fault("nonfinite-burst", 55, 4),
+    ]
+
+
+@pytest.mark.parametrize("bad", [
+    "bogus@3",            # unknown kind
+    "sigterm",            # no '@'
+    "sigterm@x",          # non-integer arg
+    "sigterm@0",          # steps are 1-based
+    "nonfinite-burst@5:0",  # count must be >= 1
+])
+def test_parse_fault_spec_refuses_malformed(bad):
+    from raft_tpu.resilience import parse_fault_spec
+
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+def test_fault_plan_nonfinite_and_sigterm_schedule():
+    from raft_tpu.resilience import FaultPlan
+
+    plan = FaultPlan.from_spec("nonfinite-burst@3:2")
+    assert [plan.poisons_step(s) for s in range(1, 6)] == [
+        False, False, True, True, False]
+    batch = {"flow": jnp.ones((1, 4, 4, 2), jnp.float32)}
+    out = plan.poison_batch(3, batch)
+    assert not np.isfinite(np.asarray(out["flow"])).any()
+    # shape/dtype preserving: must not trip the recompile sentinel
+    assert out["flow"].shape == batch["flow"].shape
+    assert out["flow"].dtype == batch["flow"].dtype
+    untouched = plan.poison_batch(5, batch)
+    assert np.isfinite(np.asarray(untouched["flow"])).all()
+    assert plan.summary() == {"nonfinite-burst": 1}
+
+
+# ---------------------------------------------------------------------------
+# loader retry / quarantine
+# ---------------------------------------------------------------------------
+
+class _StubDataset:
+    """Deterministic samples; scripted failures per (index -> count)."""
+
+    def __init__(self, n=8, fail=None, forever=()):
+        self.n = n
+        self.fail = dict(fail or {})
+        self.forever = set(forever)
+        self.epoch = 0
+        self.fetches = []
+
+    def __len__(self):
+        return self.n
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __getitem__(self, i):
+        self.fetches.append(int(i))
+        if i in self.forever:
+            raise OSError(f"permanent failure for {i}")
+        if self.fail.get(i, 0) > 0:
+            self.fail[i] -= 1
+            raise OSError(f"transient failure for {i}")
+        return {"x": np.full((2, 2), i, np.float32)}
+
+
+def _collect(loader):
+    return [b["x"][:, 0, 0].astype(int).tolist() for b in loader]
+
+
+def test_loader_retries_transient_failure():
+    from raft_tpu.data.loader import DataLoader
+
+    incidents = []
+    ds = _StubDataset(n=6, fail={2: 1})
+    dl = DataLoader(ds, batch_size=2, shuffle=False, num_workers=2,
+                    retries=2, retry_backoff=0.001,
+                    on_incident=lambda k, d: incidents.append((k, d)))
+    batches = _collect(dl)
+    assert batches == [[0, 1], [2, 3], [4, 5]]     # content intact
+    kinds = [k for k, _ in incidents]
+    assert kinds == ["sample-retried"]
+    assert "index 2" in incidents[0][1] or "sample 2" in incidents[0][1]
+    assert dl.quarantined == {}
+
+
+def test_loader_quarantines_persistent_failure_deterministically():
+    from raft_tpu.data.loader import DataLoader
+
+    def run():
+        incidents = []
+        ds = _StubDataset(n=8, forever={3})
+        dl = DataLoader(ds, batch_size=2, shuffle=False, num_workers=2,
+                        retries=1, retry_backoff=0.001, seed=5,
+                        on_incident=lambda k, d: incidents.append(k))
+        return _collect(dl), incidents, dict(dl.quarantined)
+
+    b1, inc1, q1 = run()
+    b2, inc2, q2 = run()
+    # replayable: the substitute index is a pure function of
+    # (seed, epoch, index), so two identical runs see identical batches
+    assert b1 == b2
+    assert 3 in q1 and q1.keys() == q2.keys()
+    assert "sample-quarantined" in inc1
+    # the quarantined sample was substituted, not dropped: batch shapes hold
+    assert all(len(b) == 2 for b in b1)
+    flat = [i for b in b1 for i in b]
+    assert 3 not in flat
+
+
+def test_loader_gives_up_loudly_when_substitutes_fail():
+    from raft_tpu.data.loader import DataLoader
+
+    ds = _StubDataset(n=4, forever={0, 1, 2, 3})
+    dl = DataLoader(ds, batch_size=2, shuffle=False, num_workers=1,
+                    retries=0, retry_backoff=0.001)
+    with pytest.raises(RuntimeError, match="refusing to fabricate"):
+        list(dl)
+
+
+def test_fault_injecting_dataset_drives_loader_quarantine():
+    """The e2e wiring: --inject sample-ioerror@IDX:N below the loader."""
+    from raft_tpu.data.loader import DataLoader
+    from raft_tpu.resilience import FaultPlan
+
+    plan = FaultPlan.from_spec("sample-ioerror@2:3")
+    ds = plan.wrap_dataset(_StubDataset(n=6))
+    incidents = []
+    dl = DataLoader(ds, batch_size=2, shuffle=False, num_workers=2,
+                    retries=1, retry_backoff=0.001,
+                    on_incident=lambda k, d: incidents.append(k))
+    batches = _collect(dl)
+    assert len(batches) == 3
+    assert "sample-quarantined" in incidents
+    assert plan.summary()["sample-ioerror"] == 2  # retries + first attempt
+
+
+def test_loader_iter_from_skips_batches_without_decoding():
+    from raft_tpu.data.loader import DataLoader
+
+    ds = _StubDataset(n=8)
+    dl = DataLoader(ds, batch_size=2, shuffle=False, num_workers=1)
+    full = _collect(dl)
+    ds.fetches.clear()
+    tail = [b["x"][:, 0, 0].astype(int).tolist() for b in dl.iter_from(2)]
+    assert tail == full[2:]
+    # the skipped batches' samples were never fetched
+    assert set(ds.fetches) == {4, 5, 6, 7}
+
+
+def test_loader_epochs_skip_applies_to_first_epoch_only():
+    from raft_tpu.data.loader import DataLoader
+
+    dl = DataLoader(_StubDataset(n=4), batch_size=2, shuffle=False,
+                    num_workers=1)
+    stream = dl.epochs(start_epoch=0, skip_batches=1)
+    got = [next(stream)["x"][0, 0, 0] for _ in range(3)]
+    # epoch 0 batch 1, then epoch 1 batches 0 and 1
+    assert [int(g) for g in got] == [2, 0, 2]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: manifest, verify, fallback, retention
+# ---------------------------------------------------------------------------
+
+def _mini_state(step=0, scale=0.0):
+    import optax
+
+    from raft_tpu.training.state import TrainState
+
+    tx = optax.adam(1e-3)
+    params = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3) + scale}
+    return TrainState.create(apply_fn=None, params=params, tx=tx,
+                             batch_stats={}, rng=jax.random.PRNGKey(0)
+                             ).replace(step=jnp.asarray(step))
+
+
+def test_save_checkpoint_writes_verifiable_manifest(tmp_path):
+    from raft_tpu.training.state import (manifest_path, save_checkpoint,
+                                         verify_checkpoint)
+
+    path = str(tmp_path / "10_exp.msgpack")
+    save_checkpoint(path, _mini_state(step=10), fingerprint="cafe")
+    ok, reason = verify_checkpoint(path)
+    assert ok, reason
+    manifest = json.loads(open(manifest_path(path)).read())
+    assert manifest["step"] == 10
+    assert manifest["fingerprint"] == "cafe"
+    assert manifest["size"] == os.path.getsize(path)
+    assert not os.path.exists(path + ".tmp")   # atomic rename happened
+
+
+@pytest.mark.parametrize("tamper", ["truncate", "bitflip", "zero"])
+def test_verify_checkpoint_catches_corruption(tmp_path, tamper):
+    from raft_tpu.training.state import save_checkpoint, verify_checkpoint
+
+    path = str(tmp_path / "10_exp.msgpack")
+    save_checkpoint(path, _mini_state())
+    if tamper == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+    elif tamper == "bitflip":
+        data = bytearray(open(path, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+    else:
+        open(path, "wb").close()
+    ok, reason = verify_checkpoint(path)
+    assert not ok
+    assert reason
+
+
+def test_verify_checkpoint_legacy_without_manifest(tmp_path):
+    """Pre-manifest checkpoints degrade to parse-verification."""
+    import flax
+
+    from raft_tpu.training.state import (manifest_path, save_checkpoint,
+                                         verify_checkpoint)
+
+    path = str(tmp_path / "legacy.msgpack")
+    save_checkpoint(path, _mini_state())
+    os.remove(manifest_path(path))
+    ok, reason = verify_checkpoint(path)
+    assert ok and "legacy" in reason
+    open(path, "wb").write(b"not msgpack at all")
+    ok, reason = verify_checkpoint(path)
+    assert not ok
+
+
+def test_latest_checkpoint_never_selects_tmp_or_zero_byte(tmp_path):
+    """Satellite: in-progress temp files from the atomic-rename protocol
+    and zero-byte files (full disk) must never be selected."""
+    from raft_tpu.training.state import latest_checkpoint, save_checkpoint
+
+    good = str(tmp_path / "100_exp.msgpack")
+    save_checkpoint(good, _mini_state(step=100))
+    time.sleep(0.01)
+    # newer distractors: an in-flight tmp and a zero-byte casualty
+    (tmp_path / "200_exp.msgpack.tmp").write_bytes(b"partial write")
+    (tmp_path / "300_exp.msgpack").write_bytes(b"")
+    assert latest_checkpoint(str(tmp_path), prefix="exp") == good
+    # a dir full of ONLY distractors yields None, not a crash
+    for f in ("100_exp.msgpack", "100_exp.msgpack.manifest.json"):
+        os.remove(tmp_path / f)
+    assert latest_checkpoint(str(tmp_path), prefix="exp") is None
+
+
+def test_restore_latest_verified_falls_back_past_torn_latest(tmp_path):
+    """Satellite + tentpole: corrupt latest -> typed ckpt-corrupt
+    incident -> restore from the newest VERIFIED checkpoint."""
+    from raft_tpu.training.state import (restore_latest_verified,
+                                         save_checkpoint)
+
+    old = str(tmp_path / "10_exp.msgpack")
+    save_checkpoint(old, _mini_state(step=10, scale=1.0))
+    time.sleep(0.01)
+    newest = str(tmp_path / "20_exp.msgpack")
+    save_checkpoint(newest, _mini_state(step=20, scale=2.0))
+    with open(newest, "r+b") as f:               # tear the newest
+        f.truncate(os.path.getsize(newest) // 2)
+
+    incidents = []
+    restored, path = restore_latest_verified(
+        str(tmp_path), _mini_state(), prefix="exp",
+        on_incident=lambda k, d: incidents.append((k, d)))
+    assert path == old
+    assert int(restored.step) == 10
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["w"]),
+        np.asarray(_mini_state(scale=1.0).params["w"]))
+    assert [k for k, _ in incidents] == ["ckpt-corrupt"]
+    assert "falling back" in incidents[0][1]
+
+
+def test_restore_latest_verified_none_when_all_corrupt(tmp_path):
+    from raft_tpu.training.state import (restore_latest_verified,
+                                         save_checkpoint)
+
+    path = str(tmp_path / "10_exp.msgpack")
+    save_checkpoint(path, _mini_state())
+    open(path, "wb").close()
+    restored, got = restore_latest_verified(str(tmp_path), _mini_state(),
+                                            prefix="exp")
+    assert restored is None and got is None
+
+
+def test_prune_checkpoints_keeps_last_k_and_final(tmp_path):
+    from raft_tpu.training.state import (manifest_path, prune_checkpoints,
+                                         save_checkpoint)
+
+    for s in (10, 20, 30, 40):
+        save_checkpoint(str(tmp_path / f"{s}_exp.msgpack"),
+                        _mini_state(step=s))
+    save_checkpoint(str(tmp_path / "exp.msgpack"), _mini_state())
+    save_checkpoint(str(tmp_path / "10_other.msgpack"), _mini_state())
+    removed = prune_checkpoints(str(tmp_path), "exp", keep=2)
+    assert sorted(os.path.basename(r) for r in removed) == \
+        ["10_exp.msgpack", "20_exp.msgpack"]
+    left = sorted(f for f in os.listdir(tmp_path) if f.endswith(".msgpack"))
+    # last-2 numbered survive; the final save and other experiments
+    # are untouchable; manifests were pruned alongside
+    assert left == ["10_other.msgpack", "30_exp.msgpack",
+                    "40_exp.msgpack", "exp.msgpack"]
+    assert not os.path.exists(manifest_path(str(tmp_path
+                                                / "10_exp.msgpack")))
+    assert prune_checkpoints(str(tmp_path), "exp", keep=0) == []
+
+
+def test_ckpt_torn_fault_is_caught_by_verify(tmp_path):
+    """FaultPlan.after_checkpoint_save -> verify_checkpoint: the
+    injected tear is exactly the corruption the manifest catches."""
+    from raft_tpu.resilience import FaultPlan
+    from raft_tpu.training.state import save_checkpoint, verify_checkpoint
+
+    plan = FaultPlan.from_spec("ckpt-torn@2")
+    p1 = str(tmp_path / "1.msgpack")
+    p2 = str(tmp_path / "2.msgpack")
+    save_checkpoint(p1, _mini_state())
+    plan.after_checkpoint_save(p1)          # ordinal 1: untouched
+    save_checkpoint(p2, _mini_state())
+    plan.after_checkpoint_save(p2)          # ordinal 2: torn
+    assert verify_checkpoint(p1)[0]
+    ok, reason = verify_checkpoint(p2)
+    assert not ok and "mismatch" in reason
+    assert plan.summary()["ckpt-torn"] == 1
+
+
+def test_config_fingerprint_tracks_config_changes():
+    from raft_tpu.training.state import config_fingerprint
+
+    a = config_fingerprint({"lr": 1e-4}, (368, 496))
+    assert a == config_fingerprint({"lr": 1e-4}, (368, 496))
+    assert a != config_fingerprint({"lr": 2e-4}, (368, 496))
+    assert len(a) == 16
+
+
+# ---------------------------------------------------------------------------
+# AsyncCheckpointer error propagation (satellite)
+# ---------------------------------------------------------------------------
+
+def test_async_checkpointer_reraises_background_failure(tmp_path):
+    """A background save failure (full disk, dead mount) must surface on
+    the next save()/wait() — never die with its thread."""
+    from raft_tpu.training.checkpoint_async import AsyncCheckpointer
+
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_bytes(b"file where a directory is needed")
+    ckpt = AsyncCheckpointer()
+    state = _mini_state()
+    # parent path is a FILE -> os.makedirs/open in the worker raises
+    ckpt.save(str(blocker / "ckpt.msgpack"), state)
+    for _ in range(200):                       # let the worker die
+        if ckpt.pending_error() is not None:
+            break
+        time.sleep(0.01)
+    assert ckpt.pending_error() is not None    # non-blocking probe sees it
+    with pytest.raises(OSError):
+        ckpt.save(str(tmp_path / "ok.msgpack"), state)
+    # the error is cleared once raised; checkpointing can continue
+    ckpt.save(str(tmp_path / "ok.msgpack"), state)
+    ckpt.wait()
+    assert os.path.exists(tmp_path / "ok.msgpack")
+
+
+def test_async_checkpointer_applies_retention_and_hook(tmp_path):
+    from raft_tpu.training.checkpoint_async import AsyncCheckpointer
+
+    saved = []
+    ckpt = AsyncCheckpointer(fingerprint="fp", keep=2, prefix="exp",
+                             on_saved=saved.append)
+    state = _mini_state()
+    for s in (10, 20, 30):
+        ckpt.save(str(tmp_path / f"{s}_exp.msgpack"), state)
+        time.sleep(0.01)
+    ckpt.wait()
+    assert len(saved) == 3
+    left = sorted(f for f in os.listdir(tmp_path) if f.endswith(".msgpack"))
+    assert left == ["20_exp.msgpack", "30_exp.msgpack"]
+
+
+# ---------------------------------------------------------------------------
+# recovery policy state machine
+# ---------------------------------------------------------------------------
+
+def test_recovery_policy_counts_consecutive_and_escalates():
+    from raft_tpu.resilience import RecoveryPolicy
+
+    incidents = []
+    pol = RecoveryPolicy(3, record=lambda k, s, d: incidents.append((k, s)))
+    # burst of 2 then clean: recovered without rollback
+    pol.on_window(1, [{"skipped": 1.0}, {"skipped": 1.0}, {"skipped": 0.0}])
+    assert not pol.rollback_needed
+    assert [k for k, _ in incidents] == ["step-skipped", "step-recovered"]
+    assert incidents[0][1] == 1 and incidents[1][1] == 3
+    # burst of 3 (split across windows): escalates
+    pol.on_window(4, [{"skipped": 1.0}, {"skipped": 1.0}])
+    assert not pol.rollback_needed
+    pol.on_window(6, [{"skipped": 1.0}])
+    assert pol.rollback_needed
+    pol.rolled_back(6, "/ck/10_x.msgpack", 10)
+    assert not pol.rollback_needed and pol.consecutive == 0
+    assert incidents[-1][0] == "rollback"
+    assert pol.summary() == {"skipped_steps": 5, "skip_bursts": 2,
+                             "rollbacks": 1}
+
+
+def test_recovery_policy_stands_down_when_burst_ends_in_same_window():
+    """A burst that reaches the threshold but ends INSIDE the same
+    metrics window must not roll back: state never advanced during the
+    burst (updates were skipped), so rolling back at the boundary would
+    discard the good finite steps that followed."""
+    from raft_tpu.resilience import RecoveryPolicy
+
+    incidents = []
+    pol = RecoveryPolicy(2, record=lambda k, s, d: incidents.append((k, s)))
+    pol.on_window(1, [{"skipped": 1.0}, {"skipped": 1.0},
+                      {"skipped": 0.0}, {"skipped": 0.0}])
+    assert not pol.rollback_needed
+    assert [k for k, _ in incidents] == ["step-skipped", "step-recovered"]
+    assert pol.summary()["rollbacks"] == 0
+
+
+def test_recovery_policy_rejects_nonpositive_threshold():
+    from raft_tpu.resilience import RecoveryPolicy
+
+    with pytest.raises(ValueError):
+        RecoveryPolicy(0)
+
+
+# ---------------------------------------------------------------------------
+# in-graph skip (slow: compiles the real train step)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_skip_nonfinite_passes_state_through_unchanged():
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models import RAFT
+    from raft_tpu.training import create_train_state, make_optimizer
+    from raft_tpu.training.step import make_train_step
+
+    rng = np.random.default_rng(3)
+    B, H, W = 1, 64, 64
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)),
+                              jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)),
+                              jnp.float32),
+        "flow": jnp.zeros((B, H, W, 2), jnp.float32),
+        "valid": jnp.ones((B, H, W), jnp.float32),
+    }
+    model = RAFT(RAFTConfig(small=True))
+    tx, _ = make_optimizer(lr=1e-4, num_steps=50, wdecay=1e-5)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), batch,
+                               iters=2)
+    step = make_train_step(model, iters=2, gamma=0.8, max_flow=400.0,
+                           skip_nonfinite=True)  # no donation: we diff
+
+    poisoned = dict(batch)
+    poisoned["flow"] = batch["flow"] * jnp.float32(jnp.nan)
+    skipped_state, m_bad = step(state, poisoned)
+    assert float(m_bad["skipped"]) == 1.0
+    assert float(m_bad["nonfinite"]) == 1.0
+    # pure passthrough: params, optimizer state, step, rng all unchanged
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(skipped_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    applied_state, m_ok = step(state, batch)
+    assert float(m_ok["skipped"]) == 0.0
+    assert int(applied_state.step) == int(state.step) + 1
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(state.params),
+                        jax.tree.leaves(applied_state.params)))
+    assert changed
+
+
+# ---------------------------------------------------------------------------
+# severity split in the ledger / report / CLI gate
+# ---------------------------------------------------------------------------
+
+def _ledger_with(tmp_path, incidents, summary=None):
+    from raft_tpu.obs.events import RunLedger
+
+    path = str(tmp_path / "events.jsonl")
+    led = RunLedger(path, meta={"entry": "test"})
+    for kind, step, detail, sev in incidents:
+        led.incident(kind, step, detail, severity=sev)
+    led.close(summary=summary or {})
+    return path
+
+
+def test_incident_severity_stamped_and_defaulted(tmp_path):
+    from raft_tpu.obs.events import (incident_severity, read_ledger)
+
+    path = _ledger_with(tmp_path, [
+        ("nonfinite-loss", 3, "poisoned", None),        # default fatal
+        ("nonfinite-loss", 4, "skipped", "recovered"),  # explicit override
+        ("sample-quarantined", 5, "bad file", None),    # default recovered
+        ("mystery-kind", 6, "??", None),                # unknown -> warn
+    ])
+    recs = [r for r in read_ledger(path) if r.get("kind") == "incident"]
+    assert [incident_severity(r) for r in recs] == [
+        "fatal", "recovered", "recovered", "warn"]
+    # legacy record without the field classifies by taxonomy
+    assert incident_severity({"incident": "rollback"}) == "recovered"
+    assert incident_severity({"incident": "ckpt-save-failed"}) == "fatal"
+
+
+def test_ledger_rejects_unknown_severity(tmp_path):
+    from raft_tpu.obs.events import RunLedger
+
+    led = RunLedger(str(tmp_path / "e.jsonl"), meta={})
+    with pytest.raises(ValueError, match="severity"):
+        led.incident("rollback", 1, "x", severity="catastrophic")
+    led.close()
+
+
+def test_report_resilience_section_and_severity_split(tmp_path):
+    from raft_tpu.obs.events import read_ledger
+    from raft_tpu.obs.report import build_report, render_report
+
+    path = _ledger_with(
+        tmp_path,
+        [("step-skipped", 3, "burst", None),
+         ("rollback", 5, "restored", None),
+         ("ckpt-save-failed", 7, "disk full", None)],
+        summary={"faults": {"nonfinite-burst": 3},
+                 "recovery": {"skipped_steps": 4, "skip_bursts": 2,
+                              "rollbacks": 1}})
+    report = build_report(read_ledger(path))
+    res = report["resilience"]
+    assert res["incidents_by_severity"] == {"recovered": 2, "fatal": 1}
+    assert res["unrecovered"] == 1
+    assert res["faults_injected"] == {"nonfinite-burst": 3}
+    assert res["mean_recovery_latency_steps"] == 2.0
+    rendered = render_report(report)
+    assert "resilience:" in rendered
+    assert "UNRECOVERED" in rendered
+    assert "[rollback/recovered]" in rendered
+
+
+def test_fail_on_incident_severity_gate(tmp_path):
+    """Satellite: chaos runs gate on 'no UNRECOVERED incidents' — the
+    'fatal' mode passes recovered faults and trips on fatal ones."""
+    from raft_tpu.obs.__main__ import main
+
+    recovered_only = _ledger_with(tmp_path, [
+        ("sample-quarantined", 2, "bad file", None),
+        ("rollback", 9, "restored", None)])
+    assert main(["report", recovered_only]) == 0
+    assert main(["report", recovered_only, "--fail-on-incident"]) == 1
+    assert main(["report", recovered_only,
+                 "--fail-on-incident", "any"]) == 1
+    assert main(["report", recovered_only,
+                 "--fail-on-incident", "fatal"]) == 0
+
+    with_fatal = _ledger_with(tmp_path, [
+        ("rollback", 4, "restored", None),
+        ("rollback-failed", 9, "no verified ckpt", None)])
+    assert main(["report", with_fatal, "--fail-on-incident", "fatal"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI contract bits
+# ---------------------------------------------------------------------------
+
+def test_cli_refuses_resume_plus_restore_ckpt():
+    from raft_tpu.cli import train as train_cli
+
+    args = train_cli.parse_args(
+        ["--stage", "synthetic", "--resume", "--restore_ckpt", "x.msgpack"])
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        train_cli.train(args)
+
+
+def test_cli_refuses_nonfinite_inject_on_int16_wire():
+    from raft_tpu.cli import train as train_cli
+
+    args = train_cli.parse_args(
+        ["--stage", "synthetic", "--wire_int16",
+         "--inject", "nonfinite-burst@3"])
+    with pytest.raises(SystemExit, match="int16"):
+        train_cli.train(args)
+
+
+def test_cli_refuses_malformed_inject_spec():
+    from raft_tpu.cli import train as train_cli
+
+    args = train_cli.parse_args(
+        ["--stage", "synthetic", "--inject", "meteor-strike@9"])
+    with pytest.raises(SystemExit, match="--inject"):
+        train_cli.train(args)
+
+
+# ---------------------------------------------------------------------------
+# flagship: kill-and-resume equivalence (slow, subprocess twins)
+# ---------------------------------------------------------------------------
+
+def _twin_env():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_twin(workdir, name, extra, steps, env):
+    cmd = [sys.executable, "-m", "raft_tpu.cli.train",
+           "--stage", "synthetic", "--small", "--iters", "2",
+           "--batch_size", "1", "--image_size", "64", "64",
+           "--num_steps", str(steps), "--sum_freq", "1",
+           "--val_freq", "1000000", "--no_tensorboard",
+           "--seed", "11", "--name", "twin",
+           "--checkpoint_dir", os.path.join(workdir, name, "ckpts"),
+           "--log_dir", os.path.join(workdir, name, "runs"),
+           "--obs_ledger", os.path.join(workdir, name, f"{name}.jsonl"),
+           ] + extra
+    proc = subprocess.run(cmd, cwd=REPO, env=env, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    return proc.stdout
+
+
+def _losses_by_step(ledger_path, run_index=-1):
+    from raft_tpu.obs.events import read_ledger
+
+    records = read_ledger(ledger_path)
+    run_ids = [r["run"] for r in records if r["kind"] == "run_start"]
+    picked = run_ids[run_index]
+    return {r["step"]: r["means"]["loss"] for r in records
+            if r.get("kind") == "metrics" and r["run"] == picked}
+
+
+@pytest.mark.slow
+def test_kill_and_resume_matches_unkilled_loss_trajectory(tmp_path):
+    """THE resilience acceptance gate (ROADMAP item 3): SIGTERM a
+    synthetic run at step K, auto-resume with --resume, and the merged
+    loss trajectory must match the unkilled twin — exactly for the
+    pre-kill prefix (same process-fresh computation), and within a
+    pinned 1e-6 relative tolerance after the resume (the checkpoint
+    roundtrip is bitwise for f32, so this is slack for XLA CPU
+    rescheduling only).
+
+    sum_freq=1 makes every step a metrics window, so the ledger IS the
+    per-step loss trajectory; sigterm@4 is injected by the
+    deterministic fault harness, so both twins are fully replayable.
+    """
+    env = _twin_env()
+    N, K = 8, 4
+    workdir = str(tmp_path)
+
+    _run_twin(workdir, "unkilled", [], N, env)
+    out = _run_twin(workdir, "killed", ["--inject", f"sigterm@{K}"], N, env)
+    assert "preempted: saved" in out
+
+    # the killed twin stopped at K with a rescue checkpoint
+    killed_ledger = os.path.join(workdir, "killed", "killed.jsonl")
+    first_half = _losses_by_step(killed_ledger, run_index=0)
+    assert sorted(first_half) == list(range(1, K + 1))
+
+    out = _run_twin(workdir, "killed", ["--resume"], N, env)
+    assert f"at step {K}" in out                 # resumed from the kill point
+
+    second_half = _losses_by_step(killed_ledger, run_index=-1)
+    assert sorted(second_half) == list(range(K + 1, N + 1))
+
+    unkilled = _losses_by_step(
+        os.path.join(workdir, "unkilled", "unkilled.jsonl"))
+    assert sorted(unkilled) == list(range(1, N + 1))
+
+    merged = dict(first_half)
+    merged.update(second_half)
+    # pre-kill prefix: identical fresh computation -> exact
+    for s in range(1, K + 1):
+        assert merged[s] == unkilled[s], (s, merged[s], unkilled[s])
+    # post-resume: pinned tolerance (exact where determinism allows)
+    post = np.asarray([merged[s] for s in range(K + 1, N + 1)])
+    ref = np.asarray([unkilled[s] for s in range(K + 1, N + 1)])
+    np.testing.assert_allclose(post, ref, rtol=1e-6, atol=0,
+                               err_msg="resumed trajectory diverged from "
+                                       "the unkilled twin")
+    # the preemption left a typed trail
+    from raft_tpu.obs.events import read_ledger
+    kinds = [r.get("incident") for r in read_ledger(killed_ledger)
+             if r.get("kind") == "incident"]
+    assert "preempted" in kinds
+
+
+@pytest.mark.slow
+def test_chaos_dryrun_fault_matrix_subset(tmp_path):
+    """Chaos smoke subset: one recovery scenario and the fatal-gate
+    scenario from scripts/chaos_dryrun.py (the full matrix is the
+    script's default invocation)."""
+    env = _twin_env()
+    for scenario in ("sample-quarantine", "nonfinite-fatal"):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "chaos_dryrun.py"),
+             "--only", scenario, "--steps", "4",
+             "--workdir", str(tmp_path / scenario)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=900)
+        assert proc.returncode == 0, f"{scenario}:\n{proc.stdout[-3000:]}"
+        assert "chaos_dryrun: OK" in proc.stdout
