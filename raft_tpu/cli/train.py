@@ -186,6 +186,24 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "checkpoints (manifests pruned alongside; the "
                         "final un-numbered save is never pruned).  "
                         "0 (default) keeps everything")
+    # pod-scale elasticity (raft_tpu/parallel/elastic.py)
+    p.add_argument("--collective_timeout", type=float, default=0.0,
+                   help="collective watchdog (multi-process only): if "
+                        "the local step loop makes no progress for this "
+                        "many seconds — it is wedged in a collective "
+                        "whose peer is lost — every survivor records a "
+                        "typed host-lost incident and exits nonzero "
+                        "instead of hanging forever.  Must exceed the "
+                        "slowest legitimate step (incl. any validation "
+                        "pass).  0 (default) disables the watchdog")
+    p.add_argument("--shard_ckpts", action="store_true",
+                   help="force sharded checkpoints (each process saves "
+                        "only its slice of the state plus a per-shard "
+                        "manifest; restore re-shards elastically into "
+                        "any process count).  Default: automatic — "
+                        "sharded under multi-process, single-file "
+                        "otherwise.  Forcing it single-process writes "
+                        "a 1-shard set a later pod resume can grow from")
     return p.parse_args(argv)
 
 
@@ -279,9 +297,11 @@ def train(args) -> str:
     from raft_tpu.data.loader import prefetch_to_device
     from raft_tpu.models import RAFT
     from raft_tpu.parallel import make_mesh, shard_batch
+    from raft_tpu.parallel.elastic import (AgreementTimeout,
+                                           CollectiveWatchdog, PodChannel)
     from raft_tpu.parallel.step import (make_parallel_train_step,
                                         replicate_state)
-    from raft_tpu.resilience import FaultPlan, RecoveryPolicy
+    from raft_tpu.resilience import FaultPlan, InjectedFatal, RecoveryPolicy
     from raft_tpu.training import create_train_state, make_optimizer
     from raft_tpu.training.checkpoint_async import (
         AsyncCheckpointer, install_preemption_handler, preempted)
@@ -290,7 +310,10 @@ def train(args) -> str:
                                          config_fingerprint,
                                          restore_checkpoint,
                                          restore_latest_verified,
-                                         save_checkpoint)
+                                         save_checkpoint,
+                                         save_checkpoint_sharded,
+                                         shard_set_size,
+                                         sharded_checkpoint_candidates)
     from raft_tpu.training.step import make_train_step
 
     # --resume restores the FULL state (optimizer, schedule, PRNG) from
@@ -424,7 +447,14 @@ def train(args) -> str:
             "multi-host training needs a device mesh: set "
             "--data_parallel/--spatial_parallel to cover all "
             f"{jax.device_count()} global devices")
-    with mesh_ctx:
+    # Under multi-host the init batch is this process's LOCAL slice —
+    # the model's internal batch-axis sharding hints cannot bind to it
+    # (1 local sample does not divide the global 'data' axis), so init
+    # runs mesh-free (constrain no-ops) exactly like the proven
+    # two-process worker in tests/test_dist_multiprocess.py; parameters
+    # are batch-independent and replicate_state places them globally.
+    init_ctx = mesh_ctx if jax.process_count() == 1 else set_mesh(None)
+    with init_ctx:
         state = create_train_state(model, tx,
                                    jax.random.PRNGKey(train_cfg.seed),
                                    init_batch, iters=train_cfg.iters)
@@ -445,8 +475,27 @@ def train(args) -> str:
             state = restored
             start_step = int(state.step)
             print(f"resumed from {ckpt} at step {start_step}")
+            # the restore was sharded iff the returned path is a shard
+            # set's BASE (which never exists as a file itself) — stale
+            # shard files beside a restored single-file checkpoint must
+            # not fake a re-shard incident
+            writer_count = (shard_set_size(ckpt)
+                            if not os.path.isfile(ckpt) else None)
+            if writer_count is not None \
+                    and writer_count != jax.process_count():
+                # elastic restart: the set was written by a different
+                # pod size — restorable by construction (the shard
+                # count lives in the manifests), but worth a typed
+                # trail in the ledger
+                record_incident(
+                    "ckpt-reshard",
+                    f"elastic restart: restored a {writer_count}-shard "
+                    f"checkpoint set into {jax.process_count()} "
+                    f"process(es) at step {start_step}", step=0)
         elif checkpoint_candidates(train_cfg.checkpoint_dir,
-                                   prefix=train_cfg.name):
+                                   prefix=train_cfg.name) \
+                or sharded_checkpoint_candidates(train_cfg.checkpoint_dir,
+                                                 prefix=train_cfg.name):
             # checkpoints exist but NONE verified: restarting from
             # scratch here would silently discard the run's progress
             raise SystemExit(
@@ -489,6 +538,8 @@ def train(args) -> str:
             "devices": jax.device_count(),
             "params": n_params,
             "mesh": dict(mesh.shape) if mesh is not None else None,
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
         })
         spans = SpanRecorder(ledger=ledger)
         # with the skip policy active a non-finite step's update is
@@ -560,11 +611,27 @@ def train(args) -> str:
         logger.bus.add_window_hook(recovery.on_window)
     os.makedirs(train_cfg.checkpoint_dir, exist_ok=True)
     fingerprint = config_fingerprint(model_cfg, data_cfg, train_cfg)
+    # Pod elasticity (parallel/elastic.py): sharded saves + agreement
+    # channel + watchdog under multi-process; all None/off single-host,
+    # so the fast path is byte-identical to the single-process story.
+    pod = PodChannel.from_env()
+    shard = ((jax.process_index(), jax.process_count())
+             if (args.shard_ckpts or jax.process_count() > 1) else None)
     checkpointer = AsyncCheckpointer(
         fingerprint=fingerprint,
         keep=args.keep_ckpts, prefix=train_cfg.name,
-        on_saved=plan.after_checkpoint_save)
+        on_saved=plan.after_checkpoint_save,
+        shard=shard)
     install_preemption_handler()
+
+    def save_state_now(path) -> str:
+        """Synchronous (rescue/final) save, sharded when the run is."""
+        host_state = jax.device_get(state)
+        if shard is not None:
+            return save_checkpoint_sharded(path, host_state, shard[0],
+                                           shard[1],
+                                           fingerprint=fingerprint)
+        return save_checkpoint(path, host_state, fingerprint=fingerprint)
 
     def run_summary(extra=None):
         s = health.summary() | {"steps": total_steps}
@@ -576,12 +643,63 @@ def train(args) -> str:
 
     def fatal(kind: str, detail: str) -> SystemExit:
         """Typed-incident termination: ledger says why, exit is nonzero
-        — the chaos contract's 'cleanly terminated' leg."""
+        — the chaos contract's 'cleanly terminated' leg.  Under a pod
+        the fatal is ANNOUNCED first (the divergent-decision fence):
+        every peer's watchdog sees it and terminates too, so one host's
+        fatal can never leave survivors hanging in a collective or
+        silently diverging.  Process 0 owns the coordination service;
+        it lingers briefly so peers observe the fence and exit typed
+        BEFORE the service teardown can SIGABRT them."""
+        if pod is not None:
+            pod.announce_fatal(kind, detail)
+        if watchdog is not None:
+            watchdog.stop()
         record_incident(kind, detail, severity="fatal")
         logger.close()
         if ledger is not None:
             ledger.close(summary=run_summary({"fatal": kind}))
+        if pod is not None:
+            # everything is flushed; exit WITHOUT python teardown —
+            # jax's atexit distributed-shutdown handshake races the
+            # peers' (and especially the service owner's) departure
+            # into an untypeable SIGABRT
+            print(f"fatal [{kind}]: {detail}", file=sys.stderr)
+            if pod.process_index == 0:
+                import time as _time
+
+                _time.sleep((watchdog.interval if watchdog is not None
+                             else 5.0) * 2)
+            os._exit(1)
         return SystemExit(f"fatal [{kind}]: {detail}")
+
+    # Collective watchdog: converts a wedged/lost host into a typed
+    # host-lost incident + loud exit on every survivor, and polls the
+    # pod's fatal fence.  Always on under a pod (the fence must work
+    # even without a wedge timeout); stall detection arms only when
+    # --collective_timeout > 0.  Trips only from its own thread (the
+    # main thread is stuck in native collective code when it matters),
+    # so its flush path closes the ledger directly.
+    watchdog = None
+    if pod is not None:
+        def _watchdog_flush(kind):
+            try:
+                logger.close()
+            finally:
+                if ledger is not None:
+                    # kind is the trip's actual verdict (host-lost on a
+                    # stall, peer-fatal through the fence)
+                    ledger.close(summary=run_summary({"fatal": kind}))
+
+        watchdog = CollectiveWatchdog(
+            pod, args.collective_timeout or None,
+            on_incident=lambda kind, detail:
+                record_incident(kind, detail, severity="fatal"),
+            on_trip=_watchdog_flush)
+        watchdog.start()
+        if args.collective_timeout > 0:
+            print(f"collective watchdog armed: timeout "
+                  f"{args.collective_timeout:.0f}s over "
+                  f"{jax.process_count()} processes")
 
     total_steps = start_step
     num_steps = train_cfg.num_steps
@@ -607,6 +725,26 @@ def train(args) -> str:
     # Batch waits charge to the 'data' phase (h2d nests inside it via
     # prefetch_to_device; exclusive attribution keeps them distinct).
     stream = iter_with_span(stream, spans, "data")
+
+    def stream_or_fatal(it):
+        """Loader quarantine exhaustion (a typed RuntimeError from
+        data/loader.py) becomes a typed data-unreadable FATAL: ledger
+        incident, pod-wide fence, nonzero exit — under a pod the
+        survivors must terminate too, not wedge in the next
+        collective."""
+        it = iter(it)
+        while True:
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            except RuntimeError as e:
+                if "refusing to fabricate" in str(e):
+                    raise fatal("data-unreadable", str(e))
+                raise
+            yield item
+
+    stream = stream_or_fatal(stream)
     # Optional profiling window: trace a few steady-state steps (past
     # compile + warmup) so the capture shows real step composition.
     from raft_tpu.training.profiler import sync as device_sync
@@ -623,8 +761,13 @@ def train(args) -> str:
         # real signal (the preemption handler turns it into save-and-
         # exit below); nonfinite-burst NaN-poisons the ground truth
         # (dtype/shape-preserving — must NOT trip the recompile
-        # sentinel, only the nonfinite one).
-        plan.on_step_start(total_steps + 1)
+        # sentinel, only the nonfinite one); host-fatal routes through
+        # the typed-fatal path (and its pod-wide fence); stall wedges
+        # this thread for the watchdog to convert.
+        try:
+            plan.on_step_start(total_steps + 1)
+        except InjectedFatal as e:
+            raise fatal("injected-fatal", str(e))
         # Recompile sentinel: a batch signature never seen before means
         # the jitted step just retraced (ledger 'recompile' incident).
         # total_steps + 1 is the CURRENT step's 1-based index — the same
@@ -640,6 +783,9 @@ def train(args) -> str:
         total_steps += 1
         loop_step["n"] = total_steps
         spans.step_boundary()
+        if watchdog is not None:
+            # lock-free progress mark; its thread publishes to the pod
+            watchdog.notify_step(total_steps)
         if window is not None:
             # window boundary: the one cadence where host-side telemetry
             # does real work (span record + HBM watermark sample +
@@ -655,7 +801,15 @@ def train(args) -> str:
                     "ckpt-save-failed",
                     f"async checkpoint save failed at step "
                     f"{total_steps}: {type(err).__name__}: {err}")
-            if recovery is not None and recovery.rollback_needed:
+            try:
+                do_rollback = (recovery is not None
+                               and recovery.agree_rollback(
+                                   pod, total_steps,
+                                   timeout_s=args.collective_timeout
+                                   or 60.0))
+            except AgreementTimeout as e:
+                raise fatal("host-lost", str(e))
+            if do_rollback:
                 restored, ckpt = restore_latest_verified(
                     train_cfg.checkpoint_dir, state,
                     prefix=train_cfg.name,
@@ -667,10 +821,30 @@ def train(args) -> str:
                         f"{recovery.consecutive} consecutive non-finite "
                         f"steps at step {total_steps} and no verified "
                         f"checkpoint to roll back to")
+                ckpt_step = int(jax.device_get(restored.step))
+                if pod is not None:
+                    # divergence fence: every process must have restored
+                    # the SAME step — per-host disk corruption could
+                    # have sent a survivor to an older fallback, and
+                    # training on from mixed steps would silently
+                    # diverge the pod
+                    try:
+                        votes = pod.gather(f"rolledback@{total_steps}",
+                                           str(ckpt_step),
+                                           timeout_s=args.collective_timeout
+                                           or 60.0)
+                    except AgreementTimeout as e:
+                        raise fatal("host-lost", str(e))
+                    if len(set(votes.values())) != 1:
+                        raise fatal(
+                            "rollback-failed",
+                            f"pod diverged on the rollback target at "
+                            f"step {total_steps}: per-process restored "
+                            f"steps {votes} — terminating every process "
+                            f"rather than training on mixed state")
                 state = (replicate_state(restored, mesh)
                          if mesh is not None else restored)
-                recovery.rolled_back(total_steps, ckpt,
-                                     int(jax.device_get(restored.step)))
+                recovery.rolled_back(total_steps, ckpt, ckpt_step)
                 print(f"rollback: restored {ckpt} after "
                       f"{args.max_skip_steps} consecutive skipped steps")
         if tracing and total_steps >= profile_at + args.profile_steps:
@@ -680,9 +854,32 @@ def train(args) -> str:
             profile_at = None
             print(f"profile trace written to {args.profile_dir}")
 
-        if preempted():
+        # Preemption: single-process rescues immediately; under a pod
+        # the decision is a barrier AGREEMENT at the window boundary —
+        # a signaled process exiting unilaterally would wedge every
+        # peer in the next collective, and a non-blocking poll races
+        # the announcement (the peer can check a microsecond before it
+        # lands and sail on).  Every process posts its local flag for
+        # THIS boundary and the pod rescues iff any process was
+        # signaled — the same step everywhere, so the shard set is
+        # consistent.
+        do_rescue = False
+        if pod is None:
+            do_rescue = preempted()
+        elif window is not None:
+            try:
+                do_rescue = pod.agree_any(
+                    f"preempt@{total_steps}", preempted(),
+                    timeout_s=args.collective_timeout or 60.0)
+            except AgreementTimeout as e:
+                raise fatal("host-lost", str(e))
+        if do_rescue:
             # SIGTERM/SIGINT: synchronous final save, then bail; --resume
             # picks up from here (the recovery path the reference lacks).
+            if watchdog is not None:
+                # the pod is deliberately dispersing: heartbeat RPCs
+                # must not race the peers' teardown
+                watchdog.stop()
             if tracing:
                 device_sync(metrics)  # flush in-flight traced steps
                 jax.profiler.stop_trace()
@@ -702,20 +899,21 @@ def train(args) -> str:
                     f"pending async save failed during preemption "
                     f"rescue ({type(e).__name__}: {e}); synchronous "
                     f"rescue save proceeding", severity="warn")
-            save_checkpoint(path, jax.device_get(state),
-                            fingerprint=fingerprint)
-            plan.after_checkpoint_save(path)
+            saved = save_state_now(path)
+            plan.after_checkpoint_save(saved)
             record_incident(
                 "preempted",
                 f"SIGTERM/SIGINT at step {total_steps}: full state "
-                f"saved to {path}; --resume continues from here")
-            print(f"preempted: saved {path}")
+                f"saved to {saved}"
+                + (f" (shard {shard[0]} of {shard[1]})" if shard else "")
+                + "; --resume continues from here")
+            print(f"preempted: saved {saved}")
             logger.close()       # flushes the partial metrics window
             if ledger is not None:
                 spans.flush(total_steps)
                 health.sample_memory(total_steps)
                 ledger.close(summary=run_summary({"preempted": True}))
-            return path
+            return saved
 
         if total_steps % train_cfg.val_freq == train_cfg.val_freq - 1:
             path = os.path.join(train_cfg.checkpoint_dir,
@@ -749,6 +947,8 @@ def train(args) -> str:
         if total_steps >= num_steps:
             break
 
+    if watchdog is not None:
+        watchdog.stop()    # the pod is dispersing normally from here
     if tracing:  # run ended inside the profiling window
         device_sync(state.params)  # flush in-flight traced steps first
         jax.profiler.stop_trace()
@@ -772,8 +972,8 @@ def train(args) -> str:
             f"pending async save failed at run end "
             f"({type(e).__name__}: {e}); synchronous final save "
             f"proceeding", severity="warn")
-    save_checkpoint(final, jax.device_get(state), fingerprint=fingerprint)
-    plan.after_checkpoint_save(final)
+    saved = save_state_now(final)
+    plan.after_checkpoint_save(saved)
     logger.close()               # flushes the partial metrics window
     if ledger is not None:
         spans.flush(total_steps)
@@ -781,8 +981,8 @@ def train(args) -> str:
         ledger.close(summary=run_summary())
         print(f"run ledger: {ledger.path} "
               f"(render: python -m raft_tpu.obs report {ledger.path})")
-    print(f"saved final checkpoint {final}")
-    return final
+    print(f"saved final checkpoint {saved}")
+    return saved
 
 
 def main(argv=None):

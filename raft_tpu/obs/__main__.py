@@ -23,6 +23,20 @@ import sys
 from typing import Optional
 
 
+def _gate(incidents, fail_on_incident: Optional[str]) -> int:
+    if fail_on_incident == "any" and incidents:
+        return 1
+    if fail_on_incident == "fatal":
+        # the chaos-run gate: recovered faults are the system WORKING;
+        # only unrecovered (fatal) incidents fail the run
+        fatal = [i for i in incidents if i.get("severity") == "fatal"]
+        if fatal:
+            print(f"obs report: {len(fatal)} unrecovered (fatal) "
+                  f"incident(s)", file=sys.stderr)
+            return 1
+    return 0
+
+
 def run_report(path: str, as_json: bool,
                fail_on_incident: Optional[str]) -> int:
     from raft_tpu.obs.events import read_ledger, sanitize_json
@@ -44,18 +58,44 @@ def run_report(path: str, as_json: bool,
                          allow_nan=False))
     else:
         print(render_report(report))
-    if fail_on_incident == "any" and report["incidents"]:
-        return 1
-    if fail_on_incident == "fatal":
-        # the chaos-run gate: recovered faults are the system WORKING;
-        # only unrecovered (fatal) incidents fail the run
-        fatal = [i for i in report["incidents"]
-                 if i.get("severity") == "fatal"]
-        if fatal:
-            print(f"obs report: {len(fatal)} unrecovered (fatal) "
-                  f"incident(s)", file=sys.stderr)
-            return 1
-    return 0
+    return _gate(report["incidents"], fail_on_incident)
+
+
+def run_merged_report(path: str, as_json: bool,
+                      fail_on_incident: Optional[str]) -> int:
+    """Pod report: merge the per-process suffixed ledgers
+    (``<name>.jsonl.p<N>``) a multihost run writes into one view with
+    per-process incident attribution; the severity gate spans ALL
+    processes (one host's fatal fails the pod)."""
+    from raft_tpu.obs.events import read_ledger, sanitize_json
+    from raft_tpu.obs.report import (build_pod_report,
+                                     find_process_ledgers,
+                                     render_pod_report)
+
+    try:
+        ledgers = find_process_ledgers(path)
+    except ValueError as e:
+        print(f"obs report --merge: {e}", file=sys.stderr)
+        return 2
+    if not ledgers:
+        print(f"obs report --merge: no per-process ledgers "
+              f"(*.jsonl.p<N>) under {path}", file=sys.stderr)
+        return 2
+    per_process = {}
+    for pid, lpath in ledgers.items():
+        try:
+            per_process[pid] = read_ledger(lpath)
+        except (OSError, ValueError) as e:
+            print(f"obs report --merge: cannot read {lpath}: {e}",
+                  file=sys.stderr)
+            return 2
+    report = build_pod_report(per_process)
+    if as_json:
+        print(json.dumps(sanitize_json(report), indent=2, default=str,
+                         allow_nan=False))
+    else:
+        print(render_pod_report(report))
+    return _gate(report["incidents"], fail_on_incident)
 
 
 def run_selfcheck() -> int:
@@ -171,7 +211,16 @@ def main(argv=None) -> int:
                         "a synthetic run and exit 0/1")
     sub = p.add_subparsers(dest="cmd")
     rp = sub.add_parser("report", help="render a run ledger")
-    rp.add_argument("ledger", help="path to an events.jsonl run ledger")
+    rp.add_argument("ledger", help="path to an events.jsonl run ledger "
+                                   "(with --merge: a multihost run's "
+                                   "log dir or any one per-process "
+                                   "ledger)")
+    rp.add_argument("--merge", action="store_true",
+                    help="pod report: merge the per-process suffixed "
+                         "ledgers (<name>.jsonl.p<N>) a multihost run "
+                         "writes, with per-process incident "
+                         "attribution; --fail-on-incident gates across "
+                         "ALL processes")
     rp.add_argument("--json", action="store_true",
                     help="machine-readable report")
     rp.add_argument("--fail-on-incident", nargs="?", const="any",
@@ -188,6 +237,9 @@ def main(argv=None) -> int:
     if args.selfcheck:
         return run_selfcheck()
     if args.cmd == "report":
+        if args.merge:
+            return run_merged_report(args.ledger, args.json,
+                                     args.fail_on_incident)
         return run_report(args.ledger, args.json, args.fail_on_incident)
     p.print_help()
     return 2

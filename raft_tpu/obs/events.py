@@ -70,6 +70,20 @@ incident                severity  meaning
                                   rescue, run end)
 ``rollback-failed``     fatal     rollback wanted but no verified
                                   checkpoint exists
+``ckpt-reshard``        recovered an elastic restart restored a shard
+                                  set written by a DIFFERENT process
+                                  count (pod grew or shrank)
+``host-lost``           fatal     the collective watchdog declared a
+                                  wedged/lost host: no step progress
+                                  within ``--collective_timeout``;
+                                  every survivor exits nonzero
+``peer-fatal``          fatal     a peer process terminated fatally;
+                                  this process exits too (the pod-wide
+                                  fence against silent divergence)
+``injected-fatal``      fatal     the scripted ``host-fatal`` chaos
+                                  fault fired on this host
+``data-unreadable``     fatal     loader retry + quarantine exhausted:
+                                  the dataset itself is unreadable
 ======================  ========  =====================================
 
 Append-only by construction: the file is opened in append mode and
@@ -102,6 +116,11 @@ DEFAULT_INCIDENT_SEVERITY = {
     "nonfinite-loss": "fatal",
     "ckpt-save-failed": "fatal",
     "rollback-failed": "fatal",
+    "host-lost": "fatal",
+    "peer-fatal": "fatal",
+    "injected-fatal": "fatal",
+    "data-unreadable": "fatal",
+    "ckpt-reshard": "recovered",
     "recompile": "warn",
     "input-bound": "warn",
     "fault-injected": "warn",

@@ -203,6 +203,139 @@ def build_report(records: List[Dict]) -> Dict:
     }
 
 
+def find_process_ledgers(path: str) -> Dict[int, str]:
+    """Per-process ledgers of ONE multihost run: ``{pid: path}``.
+
+    Multihost runs write ``<ledger>.p<N>`` per process (obs/events.py
+    suffixing).  ``path`` may be the run's log directory or any one of
+    the suffixed files; siblings are discovered by the ``.p<int>``
+    suffix AND the shared stem.  A suffix-less ``events.jsonl`` alone
+    is NOT a pod run — callers fall back to the single-ledger report
+    for that.  A directory holding several runs' suffixed ledgers is
+    ambiguous: silently merging unrelated runs into one "pod" would
+    gate and attribute a chimera, so that raises ``ValueError`` unless
+    ``path`` itself named one of the files (its stem disambiguates).
+    """
+    import os
+    import re
+
+    d = path if os.path.isdir(path) else os.path.dirname(path) or "."
+    pat = re.compile(r"^(?P<stem>.+\.jsonl)\.p(?P<pid>\d+)$")
+    if not os.path.isdir(d):
+        return {}
+    by_stem: Dict[str, Dict[int, str]] = {}
+    for f in sorted(os.listdir(d)):
+        m = pat.match(f)
+        if m:
+            by_stem.setdefault(m.group("stem"), {})[
+                int(m.group("pid"))] = os.path.join(d, f)
+    if not by_stem:
+        return {}
+    if not os.path.isdir(path):
+        m = pat.match(os.path.basename(path))
+        want = m.group("stem") if m else os.path.basename(path)
+        return by_stem.get(want, {})
+    if len(by_stem) > 1:
+        raise ValueError(
+            f"{path} holds per-process ledgers from {len(by_stem)} "
+            f"different runs ({', '.join(sorted(by_stem))}); pass one "
+            f"of the files (its stem picks the run) instead of the "
+            f"directory")
+    return next(iter(by_stem.values()))
+
+
+def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
+    """Merge per-process ledgers into one pod report.
+
+    Each process's records go through :func:`build_report` unchanged;
+    the pod view adds per-process incident ATTRIBUTION (every incident
+    row carries its ``process``), pod-wide severity counts, and merged
+    fault/recovery counters — the inputs ``--fail-on-incident fatal``
+    needs to gate across the whole pod instead of one host.
+    """
+    processes = {pid: build_report(recs)
+                 for pid, recs in sorted(per_process_records.items())}
+    incidents: List[Dict] = []
+    by_severity: Dict[str, int] = {}
+    faults: Dict[str, int] = {}
+    recovery: Dict[str, int] = {}
+    for pid, rep in processes.items():
+        for row in rep["incidents"]:
+            incidents.append(dict(row, process=pid))
+            sev = row.get("severity", "warn")
+            by_severity[sev] = by_severity.get(sev, 0) + 1
+        res = rep.get("resilience", {})
+        for k, v in (res.get("faults_injected") or {}).items():
+            faults[k] = faults.get(k, 0) + v
+        for k, v in (res.get("recovery") or {}).items():
+            recovery[k] = recovery.get(k, 0) + v
+    incidents.sort(key=lambda r: (r.get("step") or 0, r["process"]))
+    return {
+        "processes": processes,
+        "process_count": len(processes),
+        "steps": max((r["steps"] for r in processes.values()), default=0),
+        "incidents": incidents,
+        "resilience": {
+            "faults_injected": faults,
+            "incidents_by_severity": by_severity,
+            "unrecovered": by_severity.get("fatal", 0),
+            "recovery": recovery,
+        },
+    }
+
+
+def render_pod_report(report: Dict) -> str:
+    """Human-readable pod report: one summary line per process, then
+    the merged incident table with per-process attribution."""
+    lines: List[str] = []
+    lines.append(f"== raft_tpu pod report: {report['process_count']} "
+                 f"process(es), {report['steps']} steps ==")
+    for pid, rep in report["processes"].items():
+        meta = rep["meta"]
+        pct = rep["throughput"]["step_seconds"]
+        sev: Dict[str, int] = {}
+        for row in rep["incidents"]:
+            s = row.get("severity", "warn")
+            sev[s] = sev.get(s, 0) + 1
+        inc = ("  ".join(f"{k}={v}" for k, v in sorted(sev.items()))
+               or "clean")
+        lines.append(
+            f"  p{pid}: steps {rep['steps']}  wall "
+            f"{rep['wall_seconds']:.2f}s  step p50 {_fmt_ms(pct['p50'])}"
+            f"  incidents: {inc}"
+            + (f"  [{meta.get('entry', '?')}]" if meta else ""))
+    lines.append("")
+    incidents = report["incidents"]
+    if incidents:
+        lines.append(f"pod incidents: {len(incidents)}")
+        for row in incidents:
+            lines.append(
+                f"  [p{row['process']}] [{row['kind']}/"
+                f"{row.get('severity', 'warn')}] step {row['step']}: "
+                f"{row['detail']}")
+    else:
+        lines.append("pod incidents: none")
+    res = report["resilience"]
+    lines.append("")
+    lines.append("pod resilience:")
+    if res["faults_injected"]:
+        lines.append("  faults injected: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(res["faults_injected"].items())))
+    sev = res["incidents_by_severity"]
+    lines.append(f"  incidents: {sev.get('recovered', 0)} recovered  "
+                 f"{sev.get('fatal', 0)} fatal  {sev.get('warn', 0)} warn")
+    if res["recovery"]:
+        rec = res["recovery"]
+        lines.append(
+            f"  recovery: {rec.get('skipped_steps', 0)} skipped step(s) "
+            f"in {rec.get('skip_bursts', 0)} burst(s), "
+            f"{rec.get('rollbacks', 0)} rollback(s)")
+    if res["unrecovered"]:
+        lines.append(f"  UNRECOVERED fatal incidents: "
+                     f"{res['unrecovered']}")
+    return "\n".join(lines)
+
+
 def _fmt_bytes(n: int) -> str:
     if n < 0:
         return "n/a"

@@ -6,7 +6,10 @@ from raft_tpu.parallel.mesh import (
     constrain,
 )
 from raft_tpu.parallel.step import make_parallel_train_step
-from raft_tpu.parallel.dist import initialize_distributed
+from raft_tpu.parallel.dist import (CoordinatorConnectError,
+                                    initialize_distributed)
+from raft_tpu.parallel.elastic import (AgreementTimeout,
+                                       CollectiveWatchdog, PodChannel)
 from raft_tpu.parallel.ring import (
     ring_all_pairs_correlation,
     ring_corr_pyramid,
@@ -20,6 +23,10 @@ __all__ = [
     "constrain",
     "make_parallel_train_step",
     "initialize_distributed",
+    "CoordinatorConnectError",
+    "AgreementTimeout",
+    "CollectiveWatchdog",
+    "PodChannel",
     "ring_all_pairs_correlation",
     "ring_corr_pyramid",
 ]

@@ -9,19 +9,46 @@ driving 1 chip or a pod.
 
 from __future__ import annotations
 
+import logging
 import os
+import time
 from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class CoordinatorConnectError(RuntimeError):
+    """Typed fatal: the coordinator never became reachable within the
+    retry budget.  Carries the address so the operator knows WHICH
+    endpoint to look at (the raw jax timeout names nothing)."""
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None,
-                           force: bool = False) -> None:
+                           force: bool = False,
+                           connect_retries: Optional[int] = None,
+                           connect_timeout_s: Optional[float] = None,
+                           connect_backoff_s: float = 2.0) -> None:
     """Initialize jax.distributed when running multi-host.
 
     No-ops on single-host (the common dev path).  On TPU pods the runtime
     autodetects everything; explicit args support CPU/GPU fleets (and the
     2-process localhost test in tests/test_dist_multiprocess.py).
+
+    Coordinator connect is guarded by a bounded exponential-backoff
+    TCP probe (``connect_retries`` windows of ``connect_timeout_s``
+    each — defaults 3 x 100 s, env-overridable via
+    ``RAFT_COORD_CONNECT_RETRIES`` / ``RAFT_COORD_CONNECT_TIMEOUT``):
+    a slow-starting coordinator (process 0 still booting) must not
+    kill the pod, but a genuinely absent one must fail with a typed
+    :class:`CoordinatorConnectError` NAMING the address, not a bare
+    deadline.  The probe runs BEFORE jax's own connect because this
+    jaxlib's ``client.connect()`` CHECK-aborts the process on a
+    registration deadline (xla client.h:80) — there is nothing to
+    catch after the fact, so the retry budget must be spent where the
+    failure is still a plain refused socket.  Non-process-0 only:
+    process 0 hosts the service itself.
 
     Must run before any other jax call in the process:
     ``jax.distributed.initialize`` refuses to run once a backend exists,
@@ -50,9 +77,67 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         # else single host — nothing to do
         return
     _enable_cpu_collectives(jax)
+    if connect_retries is None:
+        connect_retries = int(os.environ.get(
+            "RAFT_COORD_CONNECT_RETRIES", "3"))
+    if connect_timeout_s is None:
+        connect_timeout_s = float(os.environ.get(
+            "RAFT_COORD_CONNECT_TIMEOUT", "100"))
+    if process_id != 0 and coordinator_address is not None:
+        _wait_for_coordinator(coordinator_address, process_id,
+                              num_processes,
+                              retries=max(int(connect_retries), 1),
+                              timeout_s=float(connect_timeout_s),
+                              backoff_s=connect_backoff_s)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+
+
+def _wait_for_coordinator(address: str, process_id, num_processes,
+                          retries: int, timeout_s: float,
+                          backoff_s: float) -> None:
+    """Block until ``address`` accepts TCP, with exponential backoff,
+    for at most ``retries * timeout_s`` seconds; then raise the typed
+    :class:`CoordinatorConnectError`."""
+    import socket
+
+    host, _, port_s = address.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise CoordinatorConnectError(
+            f"coordinator address {address!r} is not host:port")
+    deadline = time.monotonic() + retries * timeout_s
+    delay = backoff_s
+    attempts = 0
+    last_err: Optional[BaseException] = None
+    while True:
+        attempts += 1
+        try:
+            with socket.create_connection((host or "127.0.0.1", port),
+                                          timeout=min(timeout_s, 10.0)):
+                if attempts > 1:
+                    logger.info("coordinator %s reachable after %d "
+                                "probe(s)", address, attempts)
+                return
+        except OSError as e:
+            last_err = e
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise CoordinatorConnectError(
+                f"cannot reach distributed coordinator at {address!r} "
+                f"as process {process_id}/{num_processes}: {attempts} "
+                f"probe(s) over {retries} x {timeout_s:.0f}s all "
+                f"failed (last: {type(last_err).__name__}: {last_err})."
+                f"  Check that process 0 is up at that address and the "
+                f"port is reachable from this host."
+            ) from last_err
+        logger.warning(
+            "coordinator %s not reachable yet (probe %d: %s); retrying "
+            "in %.1fs", address, attempts, last_err, delay)
+        time.sleep(min(delay, remaining))
+        delay = min(delay * 2, 30.0)
 
 
 def _enable_cpu_collectives(jax) -> None:

@@ -1,0 +1,375 @@
+"""Pod-scale elasticity: out-of-graph agreement + collective watchdog.
+
+PR 6 made the single process recover-or-terminate-loudly; this module
+extends the contract across the process boundary.  Two pieces:
+
+- :class:`PodChannel` — a tiny agreement protocol over the
+  jax.distributed coordination service's key-value store (the "dist
+  channel").  Everything here is host-side gRPC: no in-graph
+  collective is ever added, so the engine-3 HLO budget ledger (ring
+  must ppermute, no new all-gathers) is untouched by design.  Three
+  primitives cover the pod decisions the train loop needs:
+
+  * ``gather``/``agree_any`` — barrier-style agreement at a step
+    boundary (every process posts its local verdict under a one-shot
+    per-step key, then reads all peers).  Both preemption and
+    skip-burst rollback are such agreements: a SIGTERMed process must
+    not exit unilaterally (that wedges every peer in the next
+    collective) and a non-blocking poll of an announcement provably
+    races it, so every process posts its local flag each boundary and
+    the pod rescues/rolls back iff any flag was set; the restored
+    checkpoint step is then fenced so survivors can never silently
+    diverge;
+  * ``announce_fatal``/``peer_fatal`` — the divergent-decision fence: a
+    per-host fatal (loader quarantine exhaustion, checkpoint
+    corruption, rollback divergence) is broadcast so every survivor
+    terminates with a typed incident instead of hanging or training on
+    diverged state.  This one IS poll-based — the watchdog thread
+    polls it — because it needs no step alignment, only eventual
+    delivery before the next collective wedges forever.
+
+- :class:`CollectiveWatchdog` — a heartbeat thread that converts a
+  wedged or lost host into a typed ``host-lost`` incident and a loud
+  nonzero exit on every survivor, instead of an infinite collective
+  hang.  Each process publishes its step progress to the channel; when
+  the local main thread has not advanced for ``timeout_s`` seconds (it
+  is stuck inside a collective whose peer vanished), the watchdog names
+  the least-advanced peers, writes the incident, flushes, and
+  ``os._exit``\\ s — the only way out of a thread whose main line is
+  blocked in native code.
+
+Single-process runs never construct either class (``from_env`` returns
+None), so the fast path is byte-for-byte the PR 6 behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# Exit status for watchdog terminations: distinct from argparse (2) and
+# generic failure (1) so the chaos matrix can assert the DEATH was the
+# watchdog's typed verdict, not a crash that happened to race it.
+WATCHDOG_EXIT_CODE = 13
+
+# Pre-first-step stall bound, as a multiple of the collective timeout:
+# compilation may legitimately exceed one step-time bound many times
+# over, but not this — a host lost during startup must still kill the
+# pod loudly within a configured window instead of hanging it forever.
+STARTUP_TIMEOUT_FACTOR = 10
+
+
+class AgreementTimeout(RuntimeError):
+    """A peer never posted its verdict within the timeout — the pod
+    cannot reach the decision; callers escalate to host-lost."""
+
+
+def _kv_client():
+    """The coordination-service KV client, or None outside
+    jax.distributed (single-process runs)."""
+    from jax._src import distributed
+
+    return distributed.global_state.client
+
+
+class PodChannel:
+    """Out-of-graph pod agreement over the jax.distributed KV store.
+
+    Keys live under ``{namespace}/...`` and come in two flavors:
+    one-shot (``post``: insert-only, duplicate posts are idempotent)
+    and mutable (``put``: delete-then-set — the store refuses plain
+    overwrites).  ``poll`` is non-blocking (``key_value_dir_get``);
+    ``gather`` blocks until every peer posts or ``timeout_s`` elapses.
+    """
+
+    def __init__(self, client, process_index: int, process_count: int,
+                 namespace: str = "elastic"):
+        self._client = client
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.namespace = namespace
+
+    @classmethod
+    def from_env(cls, namespace: str = "elastic") -> Optional["PodChannel"]:
+        """The pod channel for this process, or None when the run is
+        single-process (no agreement needed, no client available)."""
+        import jax
+
+        if jax.process_count() < 2:
+            return None
+        client = _kv_client()
+        if client is None:
+            return None
+        return cls(client, jax.process_index(), jax.process_count(),
+                   namespace=namespace)
+
+    # -- key plumbing --------------------------------------------------------
+
+    def _key(self, topic: str, pid: Optional[int] = None) -> str:
+        pid = self.process_index if pid is None else pid
+        return f"{self.namespace}/{topic}/p{pid}"
+
+    def post(self, topic: str, value: str) -> None:
+        """One-shot write of this process's value for ``topic``.
+        Idempotent: re-posting the same topic is a no-op (the store
+        keeps the first value)."""
+        try:
+            self._client.key_value_set(self._key(topic), str(value))
+        except Exception as e:
+            if "ALREADY_EXISTS" not in str(e):
+                raise
+            logger.debug("pod channel: duplicate post for %s ignored",
+                         topic)
+
+    def put(self, topic: str, value: str) -> None:
+        """Mutable write (heartbeats): delete-then-set, single writer
+        per key so the gap cannot lose another process's value."""
+        try:
+            self._client.key_value_delete(self._key(topic))
+        except Exception:  # first write: nothing to delete
+            logger.debug("pod channel: first put for %s", topic)
+        self._client.key_value_set(self._key(topic), str(value))
+
+    def poll(self, topic: str) -> Dict[int, str]:
+        """Non-blocking read of every process's value for ``topic``
+        (missing processes simply absent)."""
+        out: Dict[int, str] = {}
+        prefix = f"{self.namespace}/{topic}/"
+        for key, value in self._client.key_value_dir_get(prefix):
+            tail = key.rsplit("/", 1)[-1]
+            if tail.startswith("p") and tail[1:].isdigit():
+                out[int(tail[1:])] = value
+        return out
+
+    # -- agreement -----------------------------------------------------------
+
+    def gather(self, topic: str, value: str,
+               timeout_s: float = 60.0) -> Dict[int, str]:
+        """Post this process's ``value`` for ``topic`` and block until
+        every process has posted; returns {pid: value}.  Topics must be
+        unique per decision point (callers key them by step), so the
+        one-shot keys double as the barrier.
+        """
+        self.post(topic, value)
+        out = {self.process_index: str(value)}
+        timeout_ms = max(int(timeout_s * 1000), 1)
+        for pid in range(self.process_count):
+            if pid == self.process_index:
+                continue
+            try:
+                out[pid] = self._client.blocking_key_value_get(
+                    self._key(topic, pid), timeout_ms)
+            except Exception as e:
+                raise AgreementTimeout(
+                    f"pod agreement {topic!r}: process {pid} posted no "
+                    f"verdict within {timeout_s:.0f}s "
+                    f"({type(e).__name__}) — host lost or wedged"
+                ) from e
+        return out
+
+    def agree_any(self, topic: str, flag: bool,
+                  timeout_s: float = 60.0) -> bool:
+        """True iff ANY process posted a truthy flag for ``topic``."""
+        votes = self.gather(topic, "1" if flag else "0", timeout_s)
+        return any(v == "1" for v in votes.values())
+
+    # -- fatal fence ---------------------------------------------------------
+
+    def announce_fatal(self, kind: str, detail: str) -> None:
+        """Broadcast this process's fatal termination so survivors die
+        loudly too (the divergent-decision fence).  Best-effort: the
+        local process is exiting either way."""
+        try:
+            self.post("fatal", json.dumps({"kind": kind,
+                                           "detail": detail}))
+        except Exception as e:
+            logger.warning("pod channel: fatal announce failed: %s", e)
+
+    def peer_fatal(self) -> Optional[Tuple[int, str, str]]:
+        """(pid, kind, detail) of a peer's announced fatal, or None."""
+        for pid, value in sorted(self.poll("fatal").items()):
+            if pid == self.process_index:
+                continue
+            try:
+                rec = json.loads(value)
+                return pid, rec.get("kind", "unknown"), \
+                    rec.get("detail", value)
+            except (ValueError, AttributeError):
+                return pid, "unknown", value
+        return None
+
+
+class CollectiveWatchdog:
+    """Heartbeat thread: a wedged/lost host becomes a typed
+    ``host-lost`` incident and a loud exit, never an infinite hang.
+
+    The main loop calls :meth:`notify_step` once per step (lock-free).
+    The thread publishes this process's progress to the channel every
+    ``interval`` seconds, polls the fatal fence, and — once ARMED by
+    the first completed step — trips when the local step counter has
+    not advanced for ``timeout_s`` seconds: the main thread is stuck
+    in a collective whose peer is gone.  Before the first step the
+    stall bound is ``STARTUP_TIMEOUT_FACTOR x timeout_s`` instead:
+    compilation legitimately stalls for minutes (every peer compiles
+    in lockstep, so a tight pre-step bound would false-trip), but a
+    host lost DURING startup must still terminate the pod within a
+    configured bound, not hang it forever.  Tripping writes the
+    incident through ``on_incident``, runs ``on_trip(kind)`` (ledger
+    flush), and ``os._exit(WATCHDOG_EXIT_CODE)`` — a thread cannot
+    unwind a main line that is blocked inside native collective code.
+
+    ``timeout_s`` must exceed the slowest legitimate step (it gates
+    wall time between step boundaries); it is configurable per run
+    (``--collective_timeout``) precisely because "slow" is a property
+    of the config, not the framework.  ``timeout_s=None`` disables
+    STALL detection but keeps the thread polling the fatal fence and
+    publishing heartbeats — the divergence fence works even when the
+    operator opted out of the wedge timeout.
+
+    Exit choreography: a trip first POSTS the fence (so peers learn the
+    typed reason), then writes its own incident and flushes.  Process 0
+    owns the coordination service — its exit tears the service down and
+    jax's coordination agent ABORTS any peer still attached (SIGABRT,
+    no incident, the exact silent death this class exists to prevent) —
+    so the owner delays its exit by a grace period (2 poll intervals)
+    long enough for every peer's next fence poll to observe the verdict
+    and exit typed first.
+    """
+
+    def __init__(self, channel: PodChannel, timeout_s: Optional[float],
+                 on_incident: Callable[[str, str], None],
+                 on_trip: Optional[Callable[[str], None]] = None,
+                 interval: Optional[float] = None,
+                 exit_fn: Callable[[int], None] = os._exit):
+        if timeout_s is not None and timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0 or None, "
+                             f"got {timeout_s}")
+        self.channel = channel
+        self.timeout_s = float(timeout_s) if timeout_s else None
+        base = self.timeout_s if self.timeout_s is not None else 20.0
+        self.interval = (max(0.2, min(5.0, base / 4.0))
+                         if interval is None else float(interval))
+        self._on_incident = on_incident
+        self._on_trip = on_trip
+        self._exit = exit_fn
+        self._progress: Tuple[int, float] = (0, time.monotonic())
+        self._armed = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._kv_failures = 0
+        self.tripped: Optional[str] = None
+
+    def start(self) -> None:
+        self._progress = (0, time.monotonic())
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="collective-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Disarm and join — call BEFORE leaving the step loop (final
+        saves and peer shutdowns must not race heartbeat RPCs)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 4)
+            self._thread = None
+
+    def notify_step(self, step: int) -> None:
+        """Main loop: step ``step`` completed (tuple assignment —
+        atomic under the GIL, no lock on the hot path)."""
+        self._progress = (int(step), time.monotonic())
+        self._armed = True
+
+    # -- thread body ---------------------------------------------------------
+
+    def _trip(self, kind: str, detail: str,
+              announce: bool = True) -> None:
+        self.tripped = kind
+        try:
+            if announce:
+                # fence first: peers must learn the typed reason BEFORE
+                # any teardown can SIGABRT them
+                self.channel.announce_fatal(kind, detail)
+            self._on_incident(kind, detail)
+            if self._on_trip is not None:
+                self._on_trip(kind)   # flush hook; kind names the verdict
+        finally:
+            if self.channel.process_index == 0:
+                # the coordination-service owner: give every peer's
+                # next fence poll the chance to exit typed first
+                time.sleep(self.interval * 2)
+            self._exit(WATCHDOG_EXIT_CODE)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            step, at = self._progress
+            try:
+                self.channel.put("hb", f"{step}:{time.time():.3f}")
+                fatal = self.channel.peer_fatal()
+                peers = self.channel.poll("hb")
+                self._kv_failures = 0
+            except Exception as e:
+                # the coordination service itself is gone (its owner
+                # host died): that IS a lost host, but tolerate brief
+                # blips before declaring it
+                self._kv_failures += 1
+                if self._kv_failures >= 3:
+                    self._trip(
+                        "host-lost",
+                        f"coordination service unreachable from process "
+                        f"{self.channel.process_index} "
+                        f"({self._kv_failures} consecutive failures, "
+                        f"last: {type(e).__name__}: {e}) — coordinator "
+                        f"host lost; exiting instead of hanging",
+                        announce=False)
+                    return
+                continue
+            if fatal is not None:
+                pid, kind, detail = fatal
+                self._trip(
+                    "peer-fatal",
+                    f"process {pid} terminated fatally [{kind}]: "
+                    f"{detail} — pod-wide fence: exiting to prevent "
+                    f"divergence",
+                    announce=False)  # the original fence already stands
+                return
+            if self.timeout_s is None:
+                continue
+            bound = (self.timeout_s if self._armed
+                     else self.timeout_s * STARTUP_TIMEOUT_FACTOR)
+            stalled = time.monotonic() - at
+            if stalled <= bound:
+                continue
+            if not self._armed:
+                self._trip(
+                    "host-lost",
+                    f"no first step within {stalled:.0f}s (> "
+                    f"{STARTUP_TIMEOUT_FACTOR}x collective timeout "
+                    f"{self.timeout_s:.0f}s) — a host was lost during "
+                    f"startup/compile, or the first collective wedged; "
+                    f"terminating instead of hanging")
+                return
+            suspects = []
+            for pid in range(self.channel.process_count):
+                if pid == self.channel.process_index:
+                    continue
+                v = peers.get(pid)
+                p_step = int(v.split(":", 1)[0]) if v else None
+                if p_step is None or p_step <= step:
+                    suspects.append(f"p{pid}@" + (f"step {p_step}"
+                                                  if p_step is not None
+                                                  else "no heartbeat"))
+            named = (", ".join(suspects)
+                     or "none behind — collective wedged at this step")
+            self._trip(
+                "host-lost",
+                f"no local step progress for {stalled:.0f}s (> "
+                f"collective timeout {self.timeout_s:.0f}s) at step "
+                f"{step}; least-advanced peers: {named} — terminating "
+                f"all survivors loudly instead of hanging")
+            return

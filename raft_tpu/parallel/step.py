@@ -23,9 +23,27 @@ from raft_tpu.parallel.mesh import batch_spec, set_mesh
 
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
-    """Place every state leaf replicated across the mesh."""
+    """Place every state leaf replicated across the mesh.
+
+    Single-process: a plain ``device_put``.  Under multi-host the mesh
+    spans non-addressable devices, which ``device_put`` refuses on this
+    jax (0.4.x) — each process instead assembles the global replicated
+    array from its host copy via ``make_array_from_callback`` (every
+    process holds identical values by construction: same seed, same
+    batch-independent init, or the same restored checkpoint bytes)."""
     sharding = NamedSharding(mesh, P())
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+    import numpy as np
+
+    local = {d.id for d in jax.local_devices()}
+    if all(d.id in local for d in mesh.devices.flat):
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+
+    def put(x):
+        arr = np.asarray(jax.device_get(x))
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx])
+
+    return jax.tree.map(put, state)
 
 
 def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,

@@ -19,13 +19,15 @@ nothing corrupts silently:
 """
 
 from raft_tpu.resilience.faults import (Fault, FaultInjectingDataset,
-                                        FaultPlan, parse_fault_spec)
+                                        FaultPlan, InjectedFatal,
+                                        parse_fault_spec)
 from raft_tpu.resilience.recovery import RecoveryPolicy
 
 __all__ = [
     "Fault",
     "FaultInjectingDataset",
     "FaultPlan",
+    "InjectedFatal",
     "RecoveryPolicy",
     "parse_fault_spec",
 ]

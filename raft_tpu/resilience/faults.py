@@ -28,6 +28,18 @@ spec item                       effect
                                 (when N reaches ``max_skip_steps``) the
                                 rollback escalation.  Generalizes the
                                 older ``--inject_nan_step``
+``stall@S``                     wedge the main thread at the start of
+                                step S (sleep forever) — simulates a
+                                lost/hung host; under multi-process the
+                                collective watchdog must convert the
+                                peers' resulting hang into typed
+                                ``host-lost`` terminations
+``host-fatal@S``                raise :class:`InjectedFatal` at the
+                                start of step S — a per-host fatal
+                                decision (the loop routes it through
+                                its typed-fatal path); under
+                                multi-process the fatal FENCE must
+                                terminate every peer too
 ==============================  ==========================================
 
 Everything is deterministic: the plan is pure state derived from the
@@ -42,9 +54,22 @@ import dataclasses
 import os
 import signal
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
-FAULT_KINDS = ("sigterm", "ckpt-torn", "sample-ioerror", "nonfinite-burst")
+FAULT_KINDS = ("sigterm", "ckpt-torn", "sample-ioerror", "nonfinite-burst",
+               "stall", "host-fatal")
+
+
+class InjectedFatal(RuntimeError):
+    """The scripted ``host-fatal`` fault: a per-host fatal condition the
+    train loop must route through its typed-fatal termination path."""
+
+    def __init__(self, step: int):
+        super().__init__(
+            f"injected host-fatal at step {step}: scripted per-host "
+            f"fatal condition (chaos harness)")
+        self.step = step
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,6 +183,9 @@ class FaultPlan:
         self._torn_ordinals = {f.arg for f in faults
                                if f.kind == "ckpt-torn"}
         self._sigterm_steps = {f.arg for f in faults if f.kind == "sigterm"}
+        self._stall_steps = {f.arg for f in faults if f.kind == "stall"}
+        self._fatal_steps = {f.arg for f in faults
+                             if f.kind == "host-fatal"}
         self._nan_steps = set()
         for f in faults:
             if f.kind == "nonfinite-burst":
@@ -189,7 +217,10 @@ class FaultPlan:
     def on_step_start(self, step: int) -> None:
         """``sigterm``: raise the real signal in-process at step ``step``
         (1-based) — the installed preemption handler turns it into the
-        save-and-exit flag, exactly like an external preemption."""
+        save-and-exit flag, exactly like an external preemption.
+        ``stall``: wedge this thread forever (a lost host, as its pod
+        peers experience it).  ``host-fatal``: raise
+        :class:`InjectedFatal` for the loop's typed-fatal path."""
         if step in self._sigterm_steps:
             self._sigterm_steps.discard(step)
             self.injected["sigterm"] += 1
@@ -198,6 +229,19 @@ class FaultPlan:
                 signal.raise_signal(signal.SIGTERM)
             else:  # py<3.8 fallback, same delivery
                 os.kill(os.getpid(), signal.SIGTERM)
+        if step in self._fatal_steps:
+            self._fatal_steps.discard(step)
+            self.injected["host-fatal"] += 1
+            self._note(f"host-fatal: raising InjectedFatal at step {step}")
+            raise InjectedFatal(step)
+        if step in self._stall_steps:
+            self._stall_steps.discard(step)
+            self.injected["stall"] += 1
+            self._note(f"stall: wedging the main thread at step {step} "
+                       f"(simulated lost host; only a watchdog or an "
+                       f"external kill ends this process now)")
+            while True:  # the fault IS the hang — no exit path
+                time.sleep(3600)
 
     def poisons_step(self, step: int) -> bool:
         return step in self._nan_steps

@@ -92,6 +92,34 @@ class RecoveryPolicy:
                         f"{self._burst_start})")
                 self._burst_start = None
 
+    def agree_rollback(self, channel, step: int,
+                       timeout_s: float = 60.0) -> bool:
+        """Pod-wide rollback decision at a window boundary.
+
+        Single-process (``channel`` is None): the local verdict.  Under
+        a pod, every process posts its local ``rollback_needed`` for
+        this boundary and the decision is the OR — the nonfinite
+        sentinel is replicated so the locals normally agree, but the
+        agreement makes divergence (a host that missed a window, a
+        future per-host skip source) impossible to act on silently: if
+        ANY process wants the rollback, all perform it.  A process
+        whose local flag was false adopts the pod's verdict before
+        returning, so the subsequent restore runs everywhere.
+        """
+        if channel is None:
+            return self.rollback_needed
+        agreed = channel.agree_any(f"rollback@{step}",
+                                   self.rollback_needed, timeout_s)
+        if agreed and not self.rollback_needed:
+            self.rollback_needed = True
+            if self._record is not None:
+                self._record(
+                    "step-skipped", step,
+                    f"pod agreement at step {step}: a peer reached "
+                    f"max_skip_steps={self.max_skip_steps}; adopting "
+                    f"the pod-wide rollback decision")
+        return agreed
+
     def rolled_back(self, step: int, ckpt_path: str, ckpt_step: int) -> None:
         """The loop restored a verified checkpoint; reset the burst."""
         self.rollbacks += 1

@@ -71,6 +71,15 @@ class AsyncCheckpointer:
     retention) — the fault-injection hook (``ckpt-torn``) and any
     save-completion telemetry attach here.
 
+    ``shard=(index, count)`` switches every save to the pod-sharded
+    path (training/state.py save_checkpoint_sharded): this process
+    writes only ITS shard + per-shard manifest, and retention prunes
+    only files this shard index owns (prune_checkpoints' shard_index
+    scoping), so N concurrent per-host checkpointers never race each
+    other's deletes.  ``(0, 1)`` is valid — a single process writing
+    the sharded FORMAT (``--shard_ckpts``), so a later multi-host
+    resume re-shards from it.
+
     Usage:
         ckpt = AsyncCheckpointer()
         ...
@@ -81,32 +90,46 @@ class AsyncCheckpointer:
 
     def __init__(self, fingerprint: Optional[str] = None,
                  keep: int = 0, prefix: str = "",
-                 on_saved: Optional[Callable[[str], None]] = None):
+                 on_saved: Optional[Callable[[str], None]] = None,
+                 shard: Optional[tuple] = None):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._fingerprint = fingerprint
         self._keep = keep
         self._prefix = prefix
         self._on_saved = on_saved
+        # (0, 1) is a real request (--shard_ckpts single-process), so
+        # only None disables sharding
+        self._shard = tuple(shard) if shard is not None else None
 
     def save(self, path: str, state: TrainState) -> None:
         import jax
 
-        from raft_tpu.training.state import prune_checkpoints
+        from raft_tpu.training.state import (prune_checkpoints,
+                                             save_checkpoint_sharded)
 
         self.wait()  # serialize in-flight saves; surfaces prior errors
         host_state = jax.device_get(state)
+        shard = self._shard
 
         def _write():
             try:
                 # internally atomic (tmp + rename) and manifest-writing
-                save_checkpoint(path, host_state,
-                                fingerprint=self._fingerprint)
+                if shard is not None:
+                    saved = save_checkpoint_sharded(
+                        path, host_state, shard[0], shard[1],
+                        fingerprint=self._fingerprint)
+                else:
+                    saved = save_checkpoint(path, host_state,
+                                            fingerprint=self._fingerprint)
                 if self._on_saved is not None:
-                    self._on_saved(path)
+                    self._on_saved(saved)
                 if self._keep > 0:
-                    prune_checkpoints(os.path.dirname(path) or ".",
-                                      self._prefix, self._keep)
+                    prune_checkpoints(
+                        os.path.dirname(path) or ".",
+                        self._prefix, self._keep,
+                        shard_index=shard[0] if shard else None,
+                        shard_count=shard[1] if shard else 1)
             except BaseException as e:  # surfaced on next save/wait
                 self._error = e
 

@@ -15,6 +15,21 @@ so a corrupt latest falls back to the newest *verified* checkpoint with
 a typed ``ckpt-corrupt`` incident instead of crashing ``--resume``.
 :func:`prune_checkpoints` implements keep-last-k retention (the final
 un-numbered save is never pruned).
+
+Sharded checkpoints (the pod-scale elasticity layer): under multi-host
+each process saves only ITS deterministic slice of the state tree
+(:func:`save_checkpoint_sharded` — ``<base>.shard{i}of{n}.msgpack`` +
+a per-shard manifest extending the single-file format with ``shard`` /
+``shards``), so an N-host pod writes N files concurrently instead of N
+identical full copies.  Restore (:func:`restore_checkpoint_sharded`)
+reads the shard COUNT from the manifests, not from the caller — a
+2-shard set restores into 1 process and a 1-shard set into 2
+(re-shard/elastic restart after losing a host).
+:func:`verify_shard_set` demands a quorum: every shard present, every
+manifest agreeing on (step, shards, fingerprint), every shard's bytes
+sha256-verified; one torn shard rejects the SET, and
+:func:`restore_latest_verified` falls back to the next-newest verified
+set or single file with the same typed ``ckpt-corrupt`` incident.
 """
 
 from __future__ import annotations
@@ -22,6 +37,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import flax
@@ -56,6 +72,27 @@ def create_train_state(model, tx, rng, sample_batch, iters: int = 12):
 
 MANIFEST_SUFFIX = ".manifest.json"
 MANIFEST_VERSION = 1
+
+
+def _numbered_step(stem: str, prefix: str) -> Optional[int]:
+    """The step number of a ``{step}_{prefix}`` checkpoint stem, else
+    None.  THE experiment-scoping rule — "300_small_raft" must not
+    match prefix "raft" in a shared checkpoint dir — shared by
+    candidate discovery (single-file and shard-set) and retention, so
+    the three sites can never desynchronize."""
+    if prefix and stem.endswith("_" + prefix) \
+            and stem[:-len(prefix) - 1].isdigit():
+        return int(stem[:-len(prefix) - 1])
+    return None
+
+
+def _stem_matches(stem: str, prefix: str) -> bool:
+    """Does a checkpoint stem belong to experiment ``prefix``?  The
+    final un-numbered ``{prefix}`` save and any ``{step}_{prefix}``
+    save; everything qualifies when no prefix scopes the search."""
+    if not prefix or stem == prefix:
+        return True
+    return _numbered_step(stem, prefix) is not None
 
 
 def config_fingerprint(*configs) -> str:
@@ -97,16 +134,9 @@ def save_checkpoint(path: str, state: TrainState,
     manifest describing bytes that don't exist.
     """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    payload = {
-        "params": jax.device_get(state.params),
-        "batch_stats": jax.device_get(state.batch_stats),
-        "opt_state": jax.device_get(state.opt_state),
-        "step": jax.device_get(state.step),
-        "rng": jax.device_get(state.rng),
-    }
-    # optax states are NamedTuples; convert to plain dicts for msgpack
-    payload = flax.serialization.to_state_dict(payload)
-    data = flax.serialization.msgpack_serialize(payload)
+    # optax states are NamedTuples; _state_payload converts to plain
+    # dicts for msgpack
+    data = flax.serialization.msgpack_serialize(_state_payload(state))
     _atomic_write_bytes(path, data)
     manifest = {
         "v": MANIFEST_VERSION,
@@ -180,16 +210,10 @@ def _migrate_mask_head(node):
     return node
 
 
-def restore_checkpoint(path: str, state: TrainState,
-                       params_only: bool = False) -> TrainState:
-    """Restore a checkpoint.
-
-    ``params_only=True`` mirrors the reference's strict=False stage-transfer
-    restore (train.py:141-142): take params (+ batch_stats) but keep the
-    fresh optimizer/schedule state.
-    """
-    with open(path, "rb") as f:
-        payload = flax.serialization.msgpack_restore(f.read())
+def _payload_to_state(payload: Dict, state: TrainState,
+                      params_only: bool = False) -> TrainState:
+    """Fold a deserialized checkpoint payload into ``state`` (shared by
+    the single-file and sharded restore paths)."""
     payload = _migrate_mask_head(payload)
 
     params = flax.serialization.from_state_dict(state.params, payload["params"])
@@ -208,6 +232,260 @@ def restore_checkpoint(path: str, state: TrainState,
     )
 
 
+def restore_checkpoint(path: str, state: TrainState,
+                       params_only: bool = False) -> TrainState:
+    """Restore a checkpoint.
+
+    ``params_only=True`` mirrors the reference's strict=False stage-transfer
+    restore (train.py:141-142): take params (+ batch_stats) but keep the
+    fresh optimizer/schedule state.
+    """
+    with open(path, "rb") as f:
+        payload = flax.serialization.msgpack_restore(f.read())
+    return _payload_to_state(payload, state, params_only=params_only)
+
+
+# ----------------------------------------------------------------------------
+# Sharded checkpoints (pod-scale: one shard per process, elastic restore)
+# ----------------------------------------------------------------------------
+
+SHARD_MANIFEST_VERSION = 2
+
+# <base>.shard{i}of{n}.msgpack — base keeps the .msgpack-style stem
+# ({step}_{name} / {name}), so shard files are invisible to the legacy
+# single-file candidate matching (their stem ends in .shardXofY, which
+# matches neither "{prefix}" nor "{digits}_{prefix}").
+_SHARD_RE = re.compile(r"^(?P<base>.+)\.shard(?P<i>\d+)of(?P<n>\d+)"
+                       r"\.msgpack$")
+
+
+def shard_path(base_path: str, shard_index: int, shard_count: int) -> str:
+    """Shard file name for ``base_path`` (a ``*.msgpack`` checkpoint
+    path): ``<stem>.shard{i}of{n}.msgpack``."""
+    stem = base_path[:-len(".msgpack")] \
+        if base_path.endswith(".msgpack") else base_path
+    return f"{stem}.shard{shard_index}of{shard_count}.msgpack"
+
+
+def _state_payload(state: TrainState) -> Dict:
+    """Host-side state dict of the full train state (plain nested dicts;
+    optax NamedTuples converted for msgpack)."""
+    payload = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+        "opt_state": jax.device_get(state.opt_state),
+        "step": jax.device_get(state.step),
+        "rng": jax.device_get(state.rng),
+    }
+    return flax.serialization.to_state_dict(payload)
+
+
+def _shard_keys(flat_keys, shard_index: int, shard_count: int) -> List[str]:
+    """Deterministic leaf partition: leaf j (sorted key order) lands in
+    shard ``j % shard_count``.  Pure function of the key set, so writers
+    and (re-shard) readers never need to communicate the layout — the
+    shard files themselves carry their keys."""
+    return [k for j, k in enumerate(sorted(flat_keys))
+            if j % shard_count == shard_index]
+
+
+def save_checkpoint_sharded(base_path: str, state: TrainState,
+                            shard_index: int, shard_count: int,
+                            fingerprint: Optional[str] = None) -> str:
+    """Save THIS process's shard of the train state.
+
+    Each process calls this with its (process_index, process_count);
+    the union of the ``shard_count`` files is the full state.  Every
+    shard is written with the same atomicity discipline as
+    :func:`save_checkpoint` (fsync'd tmp + rename, checkpoint before
+    manifest) and ships a per-shard manifest extending the single-file
+    format: step, config fingerprint, byte size, sha256 — plus
+    ``shard`` (this file's index) and ``shards`` (the writer's process
+    count, which a restore reads back for elastic re-sharding).
+
+    Leaves are partitioned round-robin over the sorted flattened key
+    order — balanced by leaf COUNT, not bytes, which spreads the
+    parallel param/mu/nu trees evenly across shards in practice.
+    """
+    if not 0 <= shard_index < shard_count:
+        raise ValueError(f"shard_index {shard_index} out of range for "
+                         f"shard_count {shard_count}")
+    os.makedirs(os.path.dirname(base_path) or ".", exist_ok=True)
+    from flax import traverse_util
+
+    # keep_empty_nodes: optax EmptyState / empty batch_stats are real
+    # STRUCTURE (from_state_dict restores positionally); the sentinel
+    # rides the wire as an empty dict, which no array leaf can be
+    flat = traverse_util.flatten_dict(_state_payload(state),
+                                      keep_empty_nodes=True, sep="/")
+    keys = _shard_keys(flat.keys(), shard_index, shard_count)
+    data = flax.serialization.msgpack_serialize(
+        {k: ({} if flat[k] is traverse_util.empty_node else flat[k])
+         for k in keys})
+    path = shard_path(base_path, shard_index, shard_count)
+    _atomic_write_bytes(path, data)
+    manifest = {
+        "v": SHARD_MANIFEST_VERSION,
+        "step": int(jax.device_get(state.step)),
+        "fingerprint": fingerprint,
+        "size": len(data),
+        "sha256": hashlib.sha256(data).hexdigest(),
+        "shard": shard_index,
+        "shards": shard_count,
+    }
+    _atomic_write_bytes(manifest_path(path),
+                        json.dumps(manifest, sort_keys=True).encode("utf-8"))
+    return path
+
+
+def _shard_files(base_path: str) -> Dict[int, Tuple[str, int]]:
+    """{shard_index: (path, declared_count)} for the NEWEST generation
+    of on-disk shards at ``base_path``.
+
+    Elastic restarts legitimately leave multiple GENERATIONS at the
+    same base (a 1-proc run's ``name.shard0of1`` next to a later pod's
+    ``name.shard{0,1}of2`` — the un-numbered final save is never
+    pruned), so shards are grouped by their declared count and the
+    generation whose newest file has the latest mtime wins; a stale
+    older generation must never mix into (and fail) the current set's
+    quorum."""
+    stem = os.path.basename(base_path)
+    stem = stem[:-len(".msgpack")] if stem.endswith(".msgpack") else stem
+    d = os.path.dirname(base_path) or "."
+    if not os.path.isdir(d):
+        return {}
+    gens: Dict[int, Dict[int, str]] = {}
+    newest: Dict[int, float] = {}
+    for f in os.listdir(d):
+        m = _SHARD_RE.match(f)
+        if not m or m.group("base") != stem:
+            continue
+        n = int(m.group("n"))
+        path = os.path.join(d, f)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:        # concurrent prune; no longer a candidate
+            continue
+        gens.setdefault(n, {})[int(m.group("i"))] = path
+        newest[n] = max(newest.get(n, float("-inf")), mtime)
+    if not gens:
+        return {}
+    pick = max(newest, key=newest.get)
+    return {i: (p, pick) for i, p in gens[pick].items()}
+
+
+def verify_shard_set(base_path: str) -> Tuple[bool, str, Dict]:
+    """Is the shard set for ``base_path`` restorable?  Returns
+    ``(ok, reason, meta)`` with ``meta`` the agreed manifest fields.
+
+    Quorum rule: every declared shard must be present, every manifest
+    must agree on (step, shards, fingerprint), and every shard's bytes
+    must match its manifest's size + sha256.  A single torn/missing/
+    disagreeing shard rejects the whole set — a partial restore would
+    silently mix steps, the exact corruption this layer exists to stop.
+    """
+    files = _shard_files(base_path)
+    if not files:
+        return False, "no shard files", {}
+    # _shard_files already scoped us to ONE generation (one count)
+    n = next(iter(files.values()))[1]
+    missing = sorted(set(range(n)) - set(files))
+    if missing:
+        return False, (f"missing shard(s) {missing} of {n} — incomplete "
+                       f"set (writer died mid-save or file lost)"), {}
+    agreed: Dict = {}
+    for i in range(n):
+        path, _ = files[i]
+        ok, reason = verify_checkpoint(path)
+        if not ok:
+            return False, f"shard {i}/{n} ({path}): {reason}", {}
+        try:
+            with open(manifest_path(path), encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return False, f"shard {i}/{n}: unreadable manifest ({e})", {}
+        if manifest.get("shard") != i or manifest.get("shards") != n:
+            return False, (f"shard {i}/{n}: manifest identifies as shard "
+                           f"{manifest.get('shard')} of "
+                           f"{manifest.get('shards')} — misplaced file"), {}
+        fields = {k: manifest.get(k) for k in ("step", "fingerprint",
+                                               "shards")}
+        if not agreed:
+            agreed = fields
+        elif fields != agreed:
+            return False, (f"shard {i}/{n}: manifest disagrees with the "
+                           f"set ({fields} != {agreed}) — mixed steps or "
+                           f"configs"), {}
+    return True, f"all {n} shard manifests verified and agree", agreed
+
+
+def restore_checkpoint_sharded(base_path: str, state: TrainState,
+                               params_only: bool = False) -> TrainState:
+    """Restore a sharded checkpoint, whatever its writer's process count.
+
+    The shard count comes from the on-disk files, NOT the caller — this
+    is the elastic-restart path: a set written by 2 processes restores
+    into 1 (each process merges all shards; the state is replicated, so
+    every restorer needs the full tree) and a single-shard set restores
+    into any number of processes.  Callers should
+    :func:`verify_shard_set` first; this function trusts the bytes.
+    """
+    from flax import traverse_util
+
+    files = _shard_files(base_path)
+    if not files:
+        raise FileNotFoundError(f"no shard files for {base_path}")
+    flat: Dict[str, Any] = {}
+    for i in sorted(files):
+        path, _ = files[i]
+        with open(path, "rb") as f:
+            part = flax.serialization.msgpack_restore(f.read())
+        overlap = flat.keys() & part.keys()
+        if overlap:
+            raise ValueError(
+                f"shard {i} ({path}) repeats {len(overlap)} key(s) "
+                f"already restored (e.g. {sorted(overlap)[0]!r}) — "
+                f"overlapping shards, refusing to guess which is right")
+        flat.update(part)
+    # empty-dict wire values are the empty-structure sentinel (see save)
+    flat = {k: (traverse_util.empty_node
+                if isinstance(v, dict) and not v else v)
+            for k, v in flat.items()}
+    payload = traverse_util.unflatten_dict(flat, sep="/")
+    return _payload_to_state(payload, state, params_only=params_only)
+
+
+def sharded_checkpoint_candidates(ckpt_dir: str,
+                                  prefix: str = "") -> List[str]:
+    """Base paths of on-disk shard SETS in ``ckpt_dir``, newest-first
+    (by the newest shard's mtime).  Matching mirrors
+    :func:`checkpoint_candidates`: ``{step}_{prefix}`` and bare
+    ``{prefix}`` stems qualify; sets may be incomplete or torn —
+    :func:`verify_shard_set` arbitrates at restore time."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    newest: Dict[str, float] = {}
+    for f in os.listdir(ckpt_dir):
+        m = _SHARD_RE.match(f)
+        if not m or not _stem_matches(m.group("base"), prefix):
+            continue
+        try:
+            mtime = os.path.getmtime(os.path.join(ckpt_dir, f))
+        except OSError:       # concurrent prune; verify rejects later
+            continue
+        base = os.path.join(ckpt_dir, m.group("base") + ".msgpack")
+        newest[base] = max(newest.get(base, float("-inf")), mtime)
+    return sorted(newest, key=newest.get, reverse=True)
+
+
+def shard_set_size(base_path: str) -> Optional[int]:
+    """The number of shard files on disk for ``base_path``, or None for
+    a plain single-file checkpoint — how an elastic resume learns the
+    WRITER's process count differed from its own (``ckpt-reshard``)."""
+    files = _shard_files(base_path)
+    return len(files) or None
+
+
 def checkpoint_candidates(ckpt_dir: str, prefix: str = "") -> List[str]:
     """Resumable checkpoints in ``ckpt_dir``, newest-first by mtime.
 
@@ -223,13 +501,7 @@ def checkpoint_candidates(ckpt_dir: str, prefix: str = "") -> List[str]:
     def _matches(f: str) -> bool:
         if not f.endswith(".msgpack"):
             return False
-        stem = f[:-len(".msgpack")]
-        if not prefix or stem == prefix:
-            return True
-        # step-numbered saves only — "300_small_raft" must not match
-        # prefix "raft" (shared checkpoint dirs across experiments)
-        return (stem.endswith("_" + prefix)
-                and stem[:-len(prefix) - 1].isdigit())
+        return _stem_matches(f[:-len(".msgpack")], prefix)
 
     def _size(p: str) -> int:
         # tolerate concurrent pruning (the async checkpointer's
@@ -260,6 +532,26 @@ def latest_checkpoint(ckpt_dir: str, prefix: str = "") -> Optional[str]:
     return cands[0] if cands else None
 
 
+def _all_candidates(ckpt_dir: str, prefix: str = "") -> List[Tuple[str, bool]]:
+    """Single-file and shard-set candidates merged newest-first:
+    ``(path, is_sharded)`` — ``path`` is the base path for shard sets."""
+    def _mtime(p: str, sharded: bool) -> float:
+        paths = ([f for f, _ in _shard_files(p).values()] if sharded
+                 else [p])
+        times = []
+        for q in paths:
+            try:
+                times.append(os.path.getmtime(q))
+            except OSError:
+                pass
+        return max(times) if times else float("-inf")
+
+    cands = [(p, False) for p in checkpoint_candidates(ckpt_dir, prefix)]
+    cands += [(p, True)
+              for p in sharded_checkpoint_candidates(ckpt_dir, prefix)]
+    return sorted(cands, key=lambda c: _mtime(*c), reverse=True)
+
+
 def restore_latest_verified(
         ckpt_dir: str, state: TrainState, prefix: str = "",
         on_incident: Optional[Callable[[str, str], None]] = None,
@@ -267,19 +559,25 @@ def restore_latest_verified(
     """Restore the newest checkpoint that VERIFIES, falling back past
     torn/corrupt ones.
 
-    Walks :func:`checkpoint_candidates` newest-first; each candidate is
-    integrity-checked (:func:`verify_checkpoint`) and then restored
-    under a catch — a checkpoint whose bytes verify but whose tree no
-    longer matches the model still must not kill ``--resume`` while an
-    older good save exists.  Every rejected candidate produces one
+    Walks single-file candidates AND shard sets merged newest-first;
+    each is integrity-checked (:func:`verify_checkpoint` /
+    :func:`verify_shard_set`) and then restored under a catch — a
+    checkpoint whose bytes verify but whose tree no longer matches the
+    model still must not kill ``--resume`` while an older good save
+    exists.  Every rejected candidate produces one
     ``on_incident("ckpt-corrupt", detail)`` callback, so the fallback is
-    a typed, ledger-visible event, not a silent downgrade.
+    a typed, ledger-visible event, not a silent downgrade.  Shard sets
+    restore regardless of the writer's process count (elastic restart);
+    the caller never says which kind it expects.
 
     Returns ``(restored_state, path)``, or ``(None, None)`` when no
     candidate survives (the caller decides whether that is fatal).
     """
-    for path in checkpoint_candidates(ckpt_dir, prefix):
-        ok, reason = verify_checkpoint(path)
+    for path, sharded in _all_candidates(ckpt_dir, prefix):
+        if sharded:
+            ok, reason, _ = verify_shard_set(path)
+        else:
+            ok, reason = verify_checkpoint(path)
         if not ok:
             if on_incident is not None:
                 on_incident("ckpt-corrupt",
@@ -287,6 +585,8 @@ def restore_latest_verified(
                             f"newest checkpoint")
             continue
         try:
+            if sharded:
+                return restore_checkpoint_sharded(path, state), path
             return restore_checkpoint(path, state), path
         except Exception as e:  # torn msgpack raises library-private types
             if on_incident is not None:
@@ -297,31 +597,126 @@ def restore_latest_verified(
     return None, None
 
 
-def prune_checkpoints(ckpt_dir: str, prefix: str, keep: int) -> List[str]:
-    """Keep-last-k retention over step-numbered saves.
+def prune_checkpoints(ckpt_dir: str, prefix: str, keep: int,
+                      shard_index: Optional[int] = None,
+                      shard_count: int = 1) -> List[str]:
+    """Keep-last-k retention over step-numbered saves, shard-aware.
 
-    Deletes the oldest ``{step}_{prefix}.msgpack`` files (and their
-    manifests) beyond the ``keep`` most recent BY STEP NUMBER; the final
-    un-numbered ``{prefix}.msgpack`` is never touched, nor is any other
-    experiment's file.  Returns the paths removed.  ``keep < 1`` is a
-    no-op (retention off).
+    Retention counts STEPS, not files: all shards of one step are one
+    retention unit, so keep-last-k never splits a set — a shard another
+    process's manifest still references is only deleted when its WHOLE
+    step ages out for every process (the grouping rule is a pure
+    function of the directory listing, so concurrent pruners reach the
+    same verdict).  A step only counts toward ``keep`` when it looks
+    restorable — present with manifest-consistent sizes (single file,
+    or a complete shard set; cheap probe, not the sha256 quorum — see
+    ``_manifest_plausible``); an incomplete newer set — a peer
+    mid-save — is left alone but does not burn a retention slot.  The final un-numbered ``{prefix}`` save is
+    never touched, nor is any other experiment's file.
+
+    ``shard_index`` scopes a multi-process pruner to the files it may
+    delete without racing its peers: shard files of that index, plus
+    (index 0 only) legacy single files and orphan shards whose index is
+    ``>= shard_count`` — files with no living writer after an elastic
+    shrink.  ``None`` (the single-process default) deletes everything
+    in an aged-out step.  Returns the paths removed.  ``keep < 1`` is a
+    no-op.
     """
     if keep < 1 or not os.path.isdir(ckpt_dir):
         return []
-    numbered = []
+    # step -> [(path, kind, shard_idx)]; kind in {"file", "shard"}
+    groups: Dict[int, List[Tuple[str, str, Optional[int]]]] = {}
     for f in os.listdir(ckpt_dir):
         if not f.endswith(".msgpack"):
             continue
         stem = f[:-len(".msgpack")]
-        if prefix and stem.endswith("_" + prefix) \
-                and stem[:-len(prefix) - 1].isdigit():
-            numbered.append((int(stem[:-len(prefix) - 1]),
-                             os.path.join(ckpt_dir, f)))
-    numbered.sort()
+        idx: Optional[int] = None
+        kind = "file"
+        m = _SHARD_RE.match(f)
+        if m:
+            stem = m.group("base")
+            idx = int(m.group("i"))
+            kind = "shard"
+        step = _numbered_step(stem, prefix)
+        if step is not None:
+            groups.setdefault(step, []).append(
+                (os.path.join(ckpt_dir, f), kind, idx))
+
+    def _manifest_plausible(path: str) -> bool:
+        """Cheap restorability probe for retention slot-counting: file
+        present + size matching its manifest (legacy: just nonzero).
+        Deliberately NOT the sha256 quorum — prune runs after every
+        periodic save on the checkpointer's background thread, and
+        re-hashing k full checkpoints there would compete with the
+        host data pipeline; torn-at-rest content is caught where it
+        matters, at restore time."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return False
+        if size == 0:
+            return False
+        mpath = manifest_path(path)
+        if not os.path.isfile(mpath):
+            return True                      # legacy: nonzero is our best
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                return json.load(f).get("size") == size
+        except (OSError, json.JSONDecodeError):
+            return False
+
+    def _restorable(step: int) -> bool:
+        """May this step burn a keep-slot?  A torn/truncated save must
+        not (deleting an older GOOD step in its favor would leave
+        rollback nothing to restore)."""
+        shard_paths = {}
+        for path, kind, idx in groups[step]:
+            if kind == "file":
+                if _manifest_plausible(path):
+                    return True
+            else:
+                shard_paths[idx] = path
+        if not shard_paths:
+            return False
+        base = os.path.join(ckpt_dir, f"{step}_{prefix}.msgpack")
+        files = _shard_files(base)           # newest generation only
+        return bool(files) \
+            and set(files) == set(range(next(iter(files.values()))[1])) \
+            and all(_manifest_plausible(p) for p, _ in files.values())
+
+    steps = sorted(groups)
+    kept = 0
+    protected = set()
+    for step in reversed(steps):
+        if kept < keep and _restorable(step):
+            kept += 1
+            protected.add(step)
+        elif kept < keep:
+            # newer-but-incomplete (peer mid-save) or torn: never delete
+            # bytes a slower writer is still completing, and don't let
+            # it eat a retention slot either
+            protected.add(step)
     removed = []
-    for _, path in numbered[:-keep] if len(numbered) > keep else []:
-        for p in (path, manifest_path(path)):
-            if os.path.isfile(p):
-                os.remove(p)
-        removed.append(path)
+    for step in steps:
+        if step in protected:
+            continue
+        for path, kind, idx in groups[step]:
+            if shard_index is not None:
+                mine = (kind == "shard" and idx == shard_index)
+                # index 0 also sweeps what no living writer owns:
+                # legacy single files and (after an elastic shrink)
+                # shards whose index has no current-pod writer
+                if shard_index == 0 and (
+                        kind == "file"
+                        or (kind == "shard" and idx >= shard_count)):
+                    mine = True
+                if not mine:
+                    continue
+            for p in (path, manifest_path(path)):
+                if os.path.isfile(p):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass  # concurrent pruner won the race
+            removed.append(path)
     return removed

@@ -28,20 +28,49 @@ Scenarios (the fault taxonomy, obs/events.py):
                         the severity gate must trip (the
                         no-silent-corruption leg)
 
+``--dist`` switches to the POD matrix: every scenario is a real
+2-process gloo run of the training CLI (multi-host data plane, sharded
+checkpoints, agreement channel), gated through
+``obs report --merge --fail-on-incident fatal``:
+
+- ``dist-kill-one-resume``  SIGTERM one process -> coordinated rescue
+                            (BOTH processes save their shards at the
+                            same boundary, exit 0) -> elastic resume
+                            as ONE process (re-shard restore 2->1)
+                            completes the schedule
+- ``dist-torn-shard``       one shard of the newest set torn at rest
+                            -> resume rejects the SET with a typed
+                            ckpt-corrupt and falls back to the older
+                            verified set
+- ``dist-host-lost``        one host wedges (scripted collective
+                            stall) -> the watchdog terminates EVERY
+                            process nonzero with a typed host-lost /
+                            peer-fatal incident within
+                            --collective_timeout — no hang
+- ``dist-fence``            one host hits a scripted per-host fatal ->
+                            the pod-wide fence terminates the peer too
+                            (typed peer-fatal), with NO watchdog
+                            timeout configured
+
 This is the scripted, runnable form of the resilience acceptance
-criterion; tests/test_resilience.py runs the cheap unit half in tier-1
-and the full matrix under the slow marker.
+criteria; tests/test_resilience.py runs the cheap unit half in tier-1,
+tests/test_elastic.py runs the channel fast subset in tier-1 and the
+flagship/wedge pod gates under the slow marker.
 """
 
 import argparse
 import json
 import os
+import socket
 import subprocess
 import sys
 import tempfile
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+
+WATCHDOG_EXIT_CODE = 13     # parallel/elastic.py (import-free: workers
+                            # must not drag jax into this driver)
 
 
 def read_incident_kinds(ledger_path):
@@ -83,12 +112,204 @@ def gate(ledger_path, env):
     return proc.returncode
 
 
+# ---------------------------------------------------------------------------
+# --dist: the pod matrix (2-process gloo runs of the real CLI)
+# ---------------------------------------------------------------------------
+
+def pod_gate(run_dir, env):
+    """Exit code of ``obs report --merge --fail-on-incident fatal``
+    over a pod run's per-process ledgers."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "report", "--merge",
+         run_dir, "--fail-on-incident", "fatal"],
+        cwd=ROOT, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, timeout=120)
+    return proc.returncode
+
+
+def pod_cli(workdir, name, steps, extra):
+    return [sys.executable, "-m", "raft_tpu.cli.train",
+            "--stage", "synthetic", "--small", "--iters", "2",
+            "--batch_size", "2", "--image_size", "64", "64",
+            "--num_steps", str(steps), "--sum_freq", "1",
+            "--val_freq", "1000000", "--no_tensorboard",
+            "--seed", "7", "--name", "chaos", "--data_parallel", "2",
+            "--checkpoint_dir", os.path.join(workdir, name, "ckpts"),
+            "--log_dir", os.path.join(workdir, name, "runs")] + extra
+
+
+def run_pod(workdir, name, steps, extra_per_proc, env_base, timeout=700):
+    """One 2-process gloo run; returns ([rc0, rc1], [tail0, tail1])."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=1",
+                   COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   NUM_PROCESSES="2", PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            pod_cli(workdir, name, steps,
+                    ["--multihost"] + extra_per_proc[pid]),
+            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    rcs, tails = [], []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            # a hang IS a scenario failure (the exact bug the watchdog
+            # exists to kill) — reap everything and report it as a
+            # verdict, never leak wedged gloo children holding the port
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            out, _ = p.communicate()
+            out = (out or "") + f"\n[chaos] TIMEOUT after {timeout}s — " \
+                                f"process hung; killed"
+        rcs.append(p.returncode)
+        tails.append((out or "")[-4000:])
+    return rcs, tails
+
+
+def run_single_resume(workdir, name, steps, extra, env_base, timeout=700):
+    """The elastic-restart phase: ONE process, 2 virtual devices, same
+    global mesh — restores the pod's 2-shard set (re-shard 2->1)."""
+    env = dict(env_base, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    env.pop("COORDINATOR_ADDRESS", None)
+    env.pop("NUM_PROCESSES", None)
+    env.pop("PROCESS_ID", None)
+    proc = subprocess.run(pod_cli(workdir, name, steps, extra),
+                          cwd=ROOT, env=env, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          timeout=timeout)
+    return proc.returncode, proc.stdout[-4000:]
+
+
+def pod_incident_kinds(workdir, name):
+    """Union of incident kinds over every ledger (per-process + any
+    suffix-less elastic-resume ledger) of one scenario."""
+    run_dir = os.path.join(workdir, name, "runs", "chaos")
+    kinds = set()
+    if not os.path.isdir(run_dir):
+        return kinds
+    for f in os.listdir(run_dir):
+        if ".jsonl" in f:
+            try:
+                ks, _ = read_incident_kinds(os.path.join(run_dir, f))
+                kinds.update(ks)
+            except (OSError, ValueError):
+                pass  # a torn ledger from a hard-killed run
+    return kinds
+
+
+def dist_main(args, env, workdir):
+    """The pod fault matrix.  Each row: recover or terminate loudly —
+    now with 'loudly' meaning EVERY process, typed, nonzero."""
+    S = args.steps + 2      # pod runs want a save boundary before faults
+
+    # scenario: (name, phases, required kinds, expect_fatal_gate)
+    # pod phase: ("pod", [extra_p0, extra_p1], [want_rc0, want_rc1])
+    # single phase: ("single", extra, want_rc)
+    scenarios = [
+        ("dist-kill-one-resume",
+         [("pod", [["--inject", f"sigterm@{S // 2}"], []], [0, 0]),
+          ("single", ["--resume"], 0)],
+         {"preempted", "ckpt-reshard"}, False),
+        ("dist-torn-shard",
+         # p0 saves S//2 periodic shards (val_freq 2) plus the final
+         # one; tearing ordinal S//2+1 = p0's FINAL shard makes the
+         # newest SET fail quorum on resume and fall back to the
+         # newest verified periodic set
+         [("pod", [["--inject", f"ckpt-torn@{S // 2 + 1}",
+                    "--val_freq", "2"],
+                   ["--val_freq", "2"]], [0, 0]),
+          ("single", ["--resume"], 0)],
+         {"ckpt-corrupt"}, False),
+        ("dist-host-lost",
+         [("pod", [["--inject", "stall@2", "--collective_timeout", "15"],
+                   ["--collective_timeout", "15"]],
+           [WATCHDOG_EXIT_CODE, WATCHDOG_EXIT_CODE])],
+         {"host-lost"}, True),
+        ("dist-fence",
+         # NO --collective_timeout: the fence must work without the
+         # wedge watchdog armed
+         [("pod", [[], ["--inject", "host-fatal@2"]],
+           [WATCHDOG_EXIT_CODE, 1])],
+         {"injected-fatal", "peer-fatal"}, True),
+    ]
+    if args.only:
+        scenarios = [s for s in scenarios if s[0] == args.only]
+        if not scenarios:
+            print(f"unknown dist scenario {args.only!r}")
+            return 2
+
+    rows = []
+    failures = 0
+    for name, phases, want_kinds, expect_fatal in scenarios:
+        fail = None
+        for i, phase in enumerate(phases):
+            if phase[0] == "pod":
+                _, extras, want_rcs = phase
+                rcs, tails = run_pod(workdir, name, S, extras, env)
+                if rcs != want_rcs:
+                    fail = (f"pod phase {i} rcs {rcs} != {want_rcs}\n"
+                            f"--- p0 ---\n{tails[0]}\n--- p1 ---\n"
+                            f"{tails[1]}")
+                    break
+            else:
+                _, extra, want_rc = phase
+                try:
+                    rc, tail = run_single_resume(workdir, name, S + 2,
+                                                 extra, env)
+                except subprocess.TimeoutExpired:
+                    # subprocess.run killed the child; record a verdict
+                    fail = f"resume phase {i} TIMEOUT (hang)"
+                    break
+                if rc != want_rc:
+                    fail = f"resume phase {i} exit {rc} != {want_rc}\n{tail}"
+                    break
+        seen = pod_incident_kinds(workdir, name)
+        gate_rc = pod_gate(os.path.join(workdir, name, "runs", "chaos"),
+                           env)
+        if fail is None:
+            missing = want_kinds - seen
+            if missing:
+                fail = f"missing typed incident(s): {sorted(missing)}"
+            elif expect_fatal and gate_rc == 0:
+                fail = "pod fatal gate did NOT trip"
+            elif not expect_fatal and gate_rc != 0:
+                fail = "pod fatal gate tripped on a recovered scenario"
+        verdict = "FAIL" if fail else (
+            "terminated+gated" if expect_fatal else "recovered")
+        rows.append((name, sorted(seen), verdict, fail))
+        failures += bool(fail)
+
+    print("\nchaos dist (pod) fault matrix:")
+    for name, kinds, verdict, fail in rows:
+        print(f"  {name:<22} {verdict:<16} "
+              f"incidents={','.join(kinds) or '-'}")
+        if fail:
+            print(f"    FAILURE: {fail}")
+    print(f"\nchaos_dryrun --dist: "
+          f"{'OK' if not failures else f'{failures} FAILED'} "
+          f"(workdir: {workdir})")
+    return 1 if failures else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser("chaos_dryrun")
     ap.add_argument("--only", default=None,
                     help="run a single scenario by name")
     ap.add_argument("--steps", type=int, default=6,
                     help="baseline step count per run (scenarios scale it)")
+    ap.add_argument("--dist", action="store_true",
+                    help="run the POD matrix instead: 2-process gloo "
+                         "runs of the real CLI (sharded checkpoints, "
+                         "agreement channel, watchdog), gated via "
+                         "obs report --merge")
     ap.add_argument("--workdir", default=None)
     args = ap.parse_args(argv)
 
@@ -96,6 +317,8 @@ def main(argv=None):
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_")
+    if args.dist:
+        return dist_main(args, env, workdir)
     S = args.steps
 
     # sample-ioerror targets a DATASET INDEX; the loader shuffles, so

@@ -1,0 +1,873 @@
+"""Pod-scale elasticity tests: sharded checkpoints (manifest
+round-trip, quorum verify, re-shard restore, shard-aware retention),
+the out-of-graph agreement channel, the collective watchdog, the pod
+report merge — and the slow multiprocess gates: the elastic
+kill-one-host-and-resume flagship and the wedged-host watchdog
+termination.
+
+The fast half is CPU-only and subprocess-free (tier-1); the
+2-process gloo channel test is fast but real-RPC (tier-1, like
+test_dist_multiprocess's collective test); the CLI-driving pod gates
+ride the slow marker.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tests.test_dist_multiprocess import requires_cpu_multiprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mini_state(step=0, scale=0.0):
+    import optax
+
+    from raft_tpu.training.state import TrainState
+
+    tx = optax.adam(1e-3)
+    params = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3) + scale,
+              "inner": {"b": jnp.ones(4) * (scale + 1.0)}}
+    return TrainState.create(apply_fn=None, params=params, tx=tx,
+                             batch_stats={}, rng=jax.random.PRNGKey(0)
+                             ).replace(step=jnp.asarray(step))
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpoints: manifest round-trip, quorum, re-shard, retention
+# ---------------------------------------------------------------------------
+
+def test_shard_manifest_roundtrip_on_mesh(tmp_path):
+    """Satellite: save a mesh-replicated state as 2 shards; every
+    per-shard manifest carries (step, shard, shards, sha256,
+    fingerprint) and the merged restore is bit-identical."""
+    from raft_tpu.parallel.mesh import virtual_device_mesh
+    from raft_tpu.parallel.step import replicate_state
+    from raft_tpu.training.state import (manifest_path,
+                                         restore_checkpoint_sharded,
+                                         save_checkpoint_sharded,
+                                         shard_path, verify_shard_set)
+
+    state = _mini_state(step=7, scale=2.0)
+    mesh = virtual_device_mesh()
+    if mesh is not None:  # CPU-only tier-1 has the 8 virtual devices
+        state = replicate_state(state, mesh)
+    base = str(tmp_path / "7_exp.msgpack")
+    for i in range(2):
+        p = save_checkpoint_sharded(base, state, i, 2, fingerprint="beef")
+        assert p == shard_path(base, i, 2)
+        manifest = json.loads(open(manifest_path(p)).read())
+        assert manifest["step"] == 7
+        assert manifest["shard"] == i and manifest["shards"] == 2
+        assert manifest["fingerprint"] == "beef"
+        assert manifest["size"] == os.path.getsize(p)
+        assert len(manifest["sha256"]) == 64
+    ok, reason, meta = verify_shard_set(base)
+    assert ok, reason
+    assert meta == {"step": 7, "fingerprint": "beef", "shards": 2}
+    restored = restore_checkpoint_sharded(base, _mini_state())
+    assert int(restored.step) == 7
+    _leaves_equal(restored, _mini_state(step=7, scale=2.0))
+
+
+def test_shards_partition_without_overlap(tmp_path):
+    """The two shard files hold DISJOINT key sets whose union is the
+    full state — each process really writes only its slice."""
+    import flax
+
+    from raft_tpu.training.state import (save_checkpoint,
+                                         save_checkpoint_sharded,
+                                         shard_path)
+
+    state = _mini_state(step=3)
+    base = str(tmp_path / "3_exp.msgpack")
+    for i in range(2):
+        save_checkpoint_sharded(base, state, i, 2)
+    parts = []
+    for i in range(2):
+        with open(shard_path(base, i, 2), "rb") as f:
+            parts.append(set(flax.serialization.msgpack_restore(
+                f.read()).keys()))
+    assert not (parts[0] & parts[1])
+    assert parts[0] and parts[1]
+    # the union covers every leaf a single-file save writes
+    single = str(tmp_path / "single.msgpack")
+    save_checkpoint(single, state)
+    total = os.path.getsize(shard_path(base, 0, 2)) \
+        + os.path.getsize(shard_path(base, 1, 2))
+    # same leaves, same bytes modulo per-file msgpack framing
+    assert abs(total - os.path.getsize(single)) < 4096
+
+
+def test_quorum_verify_rejects_torn_and_missing_shards(tmp_path):
+    from raft_tpu.training.state import (save_checkpoint_sharded,
+                                         shard_path, verify_shard_set)
+
+    state = _mini_state(step=5)
+    base = str(tmp_path / "5_exp.msgpack")
+    for i in range(2):
+        save_checkpoint_sharded(base, state, i, 2)
+    ok, _, _ = verify_shard_set(base)
+    assert ok
+    # torn shard: sha256/size mismatch rejects the WHOLE set
+    p1 = shard_path(base, 1, 2)
+    with open(p1, "r+b") as f:
+        f.truncate(os.path.getsize(p1) // 2)
+    ok, reason, _ = verify_shard_set(base)
+    assert not ok and "shard 1/2" in reason
+    # missing shard: incomplete set
+    os.remove(p1)
+    os.remove(p1 + ".manifest.json")
+    ok, reason, _ = verify_shard_set(base)
+    assert not ok and "missing shard" in reason
+    # no shards at all
+    ok, reason, _ = verify_shard_set(str(tmp_path / "nope.msgpack"))
+    assert not ok
+
+
+def test_quorum_verify_rejects_manifest_disagreement(tmp_path):
+    """Shards whose manifests disagree on step/fingerprint are mixed
+    generations — restoring them would silently blend two saves."""
+    from raft_tpu.training.state import (manifest_path,
+                                         save_checkpoint_sharded,
+                                         shard_path, verify_shard_set)
+
+    base = str(tmp_path / "9_exp.msgpack")
+    save_checkpoint_sharded(base, _mini_state(step=9), 0, 2)
+    save_checkpoint_sharded(base, _mini_state(step=9), 1, 2)
+    mpath = manifest_path(shard_path(base, 1, 2))
+    manifest = json.loads(open(mpath).read())
+    manifest["step"] = 8
+    open(mpath, "w").write(json.dumps(manifest))
+    ok, reason, _ = verify_shard_set(base)
+    assert not ok and "disagrees" in reason
+
+
+def test_reshard_restore_2to1_and_1to2(tmp_path):
+    """Satellite: elastic restart — the shard count is read from disk,
+    so a 2-writer set restores into 1 process and a 1-writer set into
+    2 (every restorer merges the full replicated tree)."""
+    from raft_tpu.parallel.mesh import virtual_device_mesh
+    from raft_tpu.parallel.step import replicate_state
+    from raft_tpu.training.state import (restore_checkpoint_sharded,
+                                         restore_latest_verified,
+                                         save_checkpoint_sharded)
+
+    mesh = virtual_device_mesh()
+    truth = _mini_state(step=12, scale=4.0)
+    saver = replicate_state(truth, mesh) if mesh is not None else truth
+
+    # 2 -> 1: two "processes" wrote; one restorer merges both shards
+    base2 = str(tmp_path / "12_exp.msgpack")
+    for i in range(2):
+        save_checkpoint_sharded(base2, saver, i, 2)
+    restored = restore_checkpoint_sharded(base2, _mini_state())
+    assert int(restored.step) == 12
+    _leaves_equal(restored, truth)
+
+    # 1 -> 2: one process wrote; each of two restorers reads the same
+    # single shard and gets the full tree (restore is per-process)
+    base1 = str(tmp_path / "20_exp.msgpack")
+    one = _mini_state(step=20, scale=6.0)
+    save_checkpoint_sharded(base1, one, 0, 1)
+    for _ in range(2):   # both "processes" of the grown pod
+        r = restore_checkpoint_sharded(base1, _mini_state())
+        assert int(r.step) == 20
+        _leaves_equal(r, one)
+
+    # restore_latest_verified picks the newest set transparently
+    r, path = restore_latest_verified(str(tmp_path), _mini_state(),
+                                      prefix="exp")
+    assert int(r.step) == 20 and "20_exp" in path
+
+
+def test_shard_generations_at_same_base_newest_wins(tmp_path):
+    """Elastic restarts leave multiple GENERATIONS at the un-numbered
+    final base (name.shard0of1 beside a later pod's name.shardXof2);
+    verify/restore must scope to the newest generation, not reject the
+    valid set over the stale one."""
+    from raft_tpu.training.state import (restore_checkpoint_sharded,
+                                         save_checkpoint_sharded,
+                                         shard_set_size,
+                                         verify_shard_set)
+
+    base = str(tmp_path / "exp.msgpack")
+    save_checkpoint_sharded(base, _mini_state(step=30, scale=1.0), 0, 1)
+    time.sleep(0.01)
+    newer = _mini_state(step=40, scale=9.0)
+    for i in range(2):
+        save_checkpoint_sharded(base, newer, i, 2)
+    ok, reason, meta = verify_shard_set(base)
+    assert ok, reason
+    assert meta["step"] == 40 and meta["shards"] == 2
+    assert shard_set_size(base) == 2
+    restored = restore_checkpoint_sharded(base, _mini_state())
+    assert int(restored.step) == 40
+    _leaves_equal(restored, newer)
+
+
+def test_restore_latest_verified_falls_back_past_torn_shard_set(tmp_path):
+    """Tentpole: one torn shard rejects the newest SET with a typed
+    ckpt-corrupt incident and falls back to the older verified one —
+    the PR 6 fallback semantics, now over sets."""
+    from raft_tpu.training.state import (restore_latest_verified,
+                                         save_checkpoint_sharded,
+                                         shard_path)
+
+    old = str(tmp_path / "10_exp.msgpack")
+    for i in range(2):
+        save_checkpoint_sharded(old, _mini_state(step=10, scale=1.0), i, 2)
+    time.sleep(0.01)
+    new = str(tmp_path / "20_exp.msgpack")
+    for i in range(2):
+        save_checkpoint_sharded(new, _mini_state(step=20, scale=2.0), i, 2)
+    p = shard_path(new, 0, 2)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+
+    incidents = []
+    restored, path = restore_latest_verified(
+        str(tmp_path), _mini_state(), prefix="exp",
+        on_incident=lambda k, d: incidents.append((k, d)))
+    assert path == old and int(restored.step) == 10
+    assert [k for k, _ in incidents] == ["ckpt-corrupt"]
+    assert "shard 0/2" in incidents[0][1]
+
+
+def test_prune_checkpoints_shard_aware(tmp_path):
+    """Tentpole: retention counts restorable STEPS, never splits a
+    set, protects an incomplete newest set (a peer mid-save), and
+    per-shard-index pruners delete disjoint file sets."""
+    from raft_tpu.training.state import (prune_checkpoints,
+                                         save_checkpoint_sharded,
+                                         verify_shard_set)
+
+    for s in (10, 20, 30):
+        base = str(tmp_path / f"{s}_exp.msgpack")
+        for i in range(2):
+            save_checkpoint_sharded(base, _mini_state(step=s), i, 2)
+        time.sleep(0.01)
+    # newest step 40 is INCOMPLETE: only shard 0 landed (peer mid-save)
+    save_checkpoint_sharded(str(tmp_path / "40_exp.msgpack"),
+                            _mini_state(step=40), 0, 2)
+
+    # concurrent per-index pruning, keep 2 restorable steps (20, 30)
+    r0 = prune_checkpoints(str(tmp_path), "exp", keep=2,
+                           shard_index=0, shard_count=2)
+    r1 = prune_checkpoints(str(tmp_path), "exp", keep=2,
+                           shard_index=1, shard_count=2)
+    assert not (set(r0) & set(r1))           # disjoint deletes
+    left = sorted(f for f in os.listdir(tmp_path) if f.endswith(".msgpack"))
+    # step 10 fully gone; 20, 30 intact sets; incomplete 40 untouched
+    assert left == ["20_exp.shard0of2.msgpack", "20_exp.shard1of2.msgpack",
+                    "30_exp.shard0of2.msgpack", "30_exp.shard1of2.msgpack",
+                    "40_exp.shard0of2.msgpack"]
+    for s in (20, 30):
+        assert verify_shard_set(str(tmp_path / f"{s}_exp.msgpack"))[0]
+
+
+def test_prune_torn_single_file_does_not_burn_keep_slot(tmp_path):
+    """A torn-at-rest single-file save (size disagrees with its
+    manifest) must not count toward keep — deleting an older GOOD step
+    in its favor would leave rollback nothing to restore."""
+    from raft_tpu.training.state import (prune_checkpoints,
+                                         save_checkpoint)
+
+    good = str(tmp_path / "10_exp.msgpack")
+    save_checkpoint(good, _mini_state(step=10))
+    time.sleep(0.01)
+    torn = str(tmp_path / "20_exp.msgpack")
+    save_checkpoint(torn, _mini_state(step=20))
+    with open(torn, "r+b") as f:
+        f.truncate(os.path.getsize(torn) // 2)
+    removed = prune_checkpoints(str(tmp_path), "exp", keep=1)
+    # the torn newest is protected (newest) but slotless; 10 survives
+    assert removed == []
+    assert os.path.isfile(good)
+
+
+def test_prune_sweeps_orphan_shards_after_elastic_shrink(tmp_path):
+    """After a 2->1 restart, old shard-1 files have no living writer;
+    the index-0 pruner sweeps them once their step ages out."""
+    from raft_tpu.training.state import (prune_checkpoints,
+                                         save_checkpoint_sharded)
+
+    for s in (10, 20):
+        base = str(tmp_path / f"{s}_exp.msgpack")
+        for i in range(2):
+            save_checkpoint_sharded(base, _mini_state(step=s), i, 2)
+        time.sleep(0.01)
+    # the shrunk pod (1 process) writes new 1-shard saves
+    for s in (30, 40):
+        save_checkpoint_sharded(str(tmp_path / f"{s}_exp.msgpack"),
+                                _mini_state(step=s), 0, 1)
+        time.sleep(0.01)
+    prune_checkpoints(str(tmp_path), "exp", keep=2,
+                      shard_index=0, shard_count=1)
+    left = sorted(f for f in os.listdir(tmp_path) if f.endswith(".msgpack"))
+    assert left == ["30_exp.shard0of1.msgpack", "40_exp.shard0of1.msgpack"]
+
+
+# ---------------------------------------------------------------------------
+# fault kinds: stall / host-fatal
+# ---------------------------------------------------------------------------
+
+def test_parse_fault_spec_accepts_dist_kinds():
+    from raft_tpu.resilience import Fault, parse_fault_spec
+
+    assert parse_fault_spec("stall@3,host-fatal@5") == [
+        Fault("stall", 3, 1), Fault("host-fatal", 5, 1)]
+
+
+def test_host_fatal_fault_raises_typed_exception():
+    from raft_tpu.resilience import FaultPlan, InjectedFatal
+
+    plan = FaultPlan.from_spec("host-fatal@2")
+    plan.on_step_start(1)                        # not yet
+    with pytest.raises(InjectedFatal, match="step 2"):
+        plan.on_step_start(2)
+    assert plan.summary() == {"host-fatal": 1}
+
+
+# ---------------------------------------------------------------------------
+# collective watchdog (fake channel: pure unit, no RPC)
+# ---------------------------------------------------------------------------
+
+class _FakeChannel:
+    def __init__(self, process_index=1, process_count=2):
+        self.process_index = process_index
+        self.process_count = process_count
+        self.kv = {}
+        self.fatal = None          # (pid, kind, detail) or None
+        self.announced = []
+
+    def put(self, topic, value):
+        self.kv[f"{topic}/p{self.process_index}"] = value
+
+    def poll(self, topic):
+        out = {}
+        for k, v in self.kv.items():
+            if k.startswith(topic + "/p"):
+                out[int(k.rsplit("p", 1)[1])] = v
+        return out
+
+    def peer_fatal(self):
+        return self.fatal
+
+    def announce_fatal(self, kind, detail):
+        self.announced.append((kind, detail))
+
+
+def _watchdog(channel, timeout, **kw):
+    from raft_tpu.parallel.elastic import CollectiveWatchdog
+
+    incidents, exits = [], []
+    wd = CollectiveWatchdog(
+        channel, timeout,
+        on_incident=lambda k, d: incidents.append((k, d)),
+        exit_fn=exits.append, interval=0.05, **kw)
+    return wd, incidents, exits
+
+
+def _wait_for(cond, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_watchdog_trips_host_lost_on_stall():
+    from raft_tpu.parallel.elastic import WATCHDOG_EXIT_CODE
+
+    ch = _FakeChannel()
+    ch.kv["hb/p0"] = "1:0.0"                    # peer stuck at step 1
+    wd, incidents, exits = _watchdog(ch, timeout=0.2)
+    wd.start()
+    try:
+        wd.notify_step(2)                       # arms, then stalls
+        assert _wait_for(lambda: exits)
+    finally:
+        wd.stop()
+    assert exits == [WATCHDOG_EXIT_CODE]
+    assert incidents and incidents[0][0] == "host-lost"
+    assert "p0@step 1" in incidents[0][1]       # names the suspect
+    assert ch.announced and ch.announced[0][0] == "host-lost"
+
+
+def test_watchdog_does_not_trip_before_first_step_or_while_advancing():
+    ch = _FakeChannel()
+    wd, incidents, exits = _watchdog(ch, timeout=0.15)
+    wd.start()
+    try:
+        time.sleep(0.5)     # < 10x timeout: compile grace, no trip yet
+        assert not exits
+        for s in range(1, 8):                   # advancing: no stall
+            wd.notify_step(s)
+            time.sleep(0.05)
+        assert not exits
+        assert _wait_for(lambda: ch.poll("hb")) # heartbeats published
+    finally:
+        wd.stop()
+    assert not incidents
+
+
+def test_watchdog_startup_stall_still_trips_at_10x_timeout():
+    """A host lost DURING startup (no process ever completes step 1 —
+    e.g. stall@1 or a peer dying inside the first collective) must
+    still terminate the pod within the coarser 10x bound, never hang
+    it forever."""
+    from raft_tpu.parallel.elastic import (STARTUP_TIMEOUT_FACTOR,
+                                           WATCHDOG_EXIT_CODE)
+
+    ch = _FakeChannel()
+    wd, incidents, exits = _watchdog(ch, timeout=0.06)
+    wd.start()
+    try:
+        # never notify_step: unarmed forever
+        assert _wait_for(lambda: exits,
+                         timeout=0.06 * STARTUP_TIMEOUT_FACTOR + 3.0)
+    finally:
+        wd.stop()
+    assert exits == [WATCHDOG_EXIT_CODE]
+    assert incidents[0][0] == "host-lost"
+    assert "startup" in incidents[0][1]
+
+
+def test_watchdog_fence_trips_on_peer_fatal_without_timeout():
+    """The divergence fence works with stall detection OFF
+    (timeout None): a peer's announced fatal still terminates us."""
+    from raft_tpu.parallel.elastic import WATCHDOG_EXIT_CODE
+
+    ch = _FakeChannel()
+    wd, incidents, exits = _watchdog(ch, timeout=None)
+    wd.start()
+    try:
+        time.sleep(0.2)
+        assert not exits                        # no stall trip ever
+        ch.fatal = (0, "rollback-failed", "no verified ckpt")
+        assert _wait_for(lambda: exits)
+    finally:
+        wd.stop()
+    assert exits == [WATCHDOG_EXIT_CODE]
+    assert incidents[0][0] == "peer-fatal"
+    assert "rollback-failed" in incidents[0][1]
+    assert not ch.announced                     # original fence stands
+
+
+def test_watchdog_owner_delays_exit_for_peer_polls():
+    """Process 0 owns the coordination service: its trip must linger
+    ~2 intervals so peers observe the fence before teardown."""
+    ch = _FakeChannel(process_index=0)
+    wd, incidents, exits = _watchdog(ch, timeout=0.1)
+    wd.start()
+    try:
+        wd.notify_step(1)
+        t0 = time.monotonic()
+        assert _wait_for(lambda: exits)
+        dt = time.monotonic() - t0
+    finally:
+        wd.stop()
+    assert dt >= wd.interval * 2                # grace honored
+
+
+def test_pod_channel_from_env_is_none_single_process():
+    from raft_tpu.parallel.elastic import PodChannel
+
+    assert PodChannel.from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# pod report merge (satellite)
+# ---------------------------------------------------------------------------
+
+def _proc_ledger(tmp_path, pid, incidents):
+    from raft_tpu.obs.events import RunLedger
+
+    path = str(tmp_path / f"events.jsonl.p{pid}")
+    led = RunLedger(path, meta={"entry": "train", "process_index": pid,
+                                "process_count": 2})
+    for kind, step, sev in incidents:
+        led.incident(kind, step, f"{kind} on p{pid}", severity=sev)
+    led.close(summary={})
+    return path
+
+
+def test_pod_report_merges_with_process_attribution(tmp_path):
+    from raft_tpu.obs.events import read_ledger
+    from raft_tpu.obs.report import (build_pod_report,
+                                     find_process_ledgers,
+                                     render_pod_report)
+
+    _proc_ledger(tmp_path, 0, [("peer-fatal", 3, None)])
+    _proc_ledger(tmp_path, 1, [("fault-injected", 3, None),
+                               ("injected-fatal", 3, None)])
+    ledgers = find_process_ledgers(str(tmp_path))
+    assert sorted(ledgers) == [0, 1]
+    report = build_pod_report({pid: read_ledger(p)
+                               for pid, p in ledgers.items()})
+    assert report["process_count"] == 2
+    assert [(r["process"], r["kind"]) for r in report["incidents"]] == [
+        (0, "peer-fatal"), (1, "fault-injected"), (1, "injected-fatal")]
+    assert report["resilience"]["unrecovered"] == 2
+    rendered = render_pod_report(report)
+    assert "[p1] [injected-fatal/fatal]" in rendered
+    assert "UNRECOVERED" in rendered
+
+
+def test_pod_report_cli_gates_across_processes(tmp_path):
+    """--merge + --fail-on-incident fatal: one host's fatal fails the
+    pod; all-recovered pods pass."""
+    from raft_tpu.obs.__main__ import main
+
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    _proc_ledger(clean, 0, [("sample-quarantined", 2, None)])
+    _proc_ledger(clean, 1, [])
+    assert main(["report", "--merge", str(clean),
+                 "--fail-on-incident", "fatal"]) == 0
+
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    _proc_ledger(bad, 0, [])
+    _proc_ledger(bad, 1, [("host-lost", 5, None)])
+    assert main(["report", "--merge", str(bad),
+                 "--fail-on-incident", "fatal"]) == 1
+    # no per-process ledgers -> usage error, not a silent pass
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["report", "--merge", str(empty)]) == 2
+    # two runs' ledgers in one dir -> ambiguous, refuse; naming a file
+    # disambiguates by its stem
+    from raft_tpu.obs.events import RunLedger
+    from raft_tpu.obs.report import find_process_ledgers
+
+    mixed = tmp_path / "mixed"
+    mixed.mkdir()
+    for stem in ("runA.jsonl", "runB.jsonl"):
+        for pid in range(2):
+            RunLedger(str(mixed / f"{stem}.p{pid}"), meta={}).close({})
+    assert main(["report", "--merge", str(mixed)]) == 2
+    picked = find_process_ledgers(str(mixed / "runA.jsonl.p0"))
+    assert sorted(picked) == [0, 1]
+    assert all("runA.jsonl" in p for p in picked.values())
+
+
+# ---------------------------------------------------------------------------
+# coordinator connect retry (satellite; subprocess: jax.distributed
+# state is process-global)
+# ---------------------------------------------------------------------------
+
+RETRY_WORKER = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.environ["RAFT_REPO"])
+    from raft_tpu.parallel.dist import (CoordinatorConnectError,
+                                        initialize_distributed)
+    t0 = time.time()
+    try:
+        initialize_distributed(
+            coordinator_address=os.environ["COORD"],
+            num_processes=2, process_id=1,
+            connect_retries=2, connect_timeout_s=2,
+            connect_backoff_s=0.2)
+    except CoordinatorConnectError as e:
+        print("TYPED", os.environ["COORD"] in str(e),
+              "probe" in str(e), f"{time.time()-t0:.1f}s",
+              flush=True)
+        sys.exit(0)
+    print("NO ERROR", flush=True)
+    sys.exit(1)
+""")
+
+
+@pytest.mark.slow
+def test_initialize_distributed_retries_then_typed_error(tmp_path):
+    """Satellite: a dead coordinator fails after bounded retries with a
+    typed error NAMING the address — not a bare gRPC deadline.
+    (Subprocess + deliberate 4s retry budget: slow lane; tier-1 keeps
+    the suite under its wall-clock budget.)"""
+    with socket.socket() as s:           # a port nobody listens on
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "retry.py"
+    script.write_text(RETRY_WORKER)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update(RAFT_REPO=REPO, COORD=f"127.0.0.1:{port}")
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "TYPED True True" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# 2-process channel semantics (fast: RPC only, no XLA compute)
+# ---------------------------------------------------------------------------
+
+CHANNEL_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, os.environ["RAFT_REPO"])
+    from raft_tpu.parallel import initialize_distributed
+    initialize_distributed(coordinator_address=os.environ["COORD"],
+                           num_processes=2,
+                           process_id=int(os.environ["PID"]))
+    from jax._src import distributed
+    from raft_tpu.parallel.elastic import PodChannel
+    pid = int(os.environ["PID"])
+    ch = PodChannel(distributed.global_state.client, pid, 2)
+
+    # agreement (the preempt/rollback shape): p1's flag is set, p0's
+    # is not -> the pod's verdict is yes on both
+    agreed = ch.agree_any("preempt@4", pid == 1, timeout_s=30)
+    assert agreed, agreed
+    # gather with per-process values (the rolled-back-step fence shape)
+    votes = ch.gather("ckstep@4", str(100 + pid), timeout_s=30)
+    assert votes == {0: "100", 1: "101"}, votes
+    # fatal fence: p1 announces; p0 sees it, p1 does not see itself
+    if pid == 1:
+        ch.announce_fatal("injected-fatal", "scripted")
+    ch.gather("sync2", "x", timeout_s=30)
+    peer = ch.peer_fatal()
+    if pid == 0:
+        assert peer is not None and peer[0] == 1 \\
+            and peer[1] == "injected-fatal", peer
+    else:
+        assert peer is None, peer
+    # heartbeats are mutable (delete+set)
+    ch.put("hb", "1:1.0"); ch.put("hb", "2:2.0")
+    assert ch.poll("hb")[pid] == "2:2.0"
+    print(f"proc {pid} CHANNEL OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+@requires_cpu_multiprocess
+def test_pod_channel_two_process_agreement(tmp_path):
+    """Agreement, preemption coordination and the fatal fence over a
+    real 2-process coordination service (no XLA compute, ~7 s — slow
+    lane purely for tier-1 wall-clock budget; the watchdog/fence state
+    machine rides tier-1 through the fake-channel unit tests above)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "channel.py"
+    script.write_text(CHANNEL_WORKER)
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env_base.update(RAFT_REPO=REPO, COORD=f"127.0.0.1:{port}")
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, PID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    assert all("CHANNEL OK" in o for o in outs)
+
+
+# ---------------------------------------------------------------------------
+# slow pod gates: the flagship and the wedge (real CLI, gloo)
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _pod_env(port, devcount=1):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count="
+                         f"{devcount}",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    if port is not None:
+        env.update(COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   NUM_PROCESSES="2")
+    return env
+
+
+def _twin_cli(workdir, name, steps, extra):
+    return [sys.executable, "-m", "raft_tpu.cli.train",
+            "--stage", "synthetic", "--small", "--iters", "2",
+            "--batch_size", "2", "--image_size", "64", "64",
+            "--num_steps", str(steps), "--sum_freq", "1",
+            "--val_freq", "1000000", "--no_tensorboard",
+            "--seed", "11", "--name", "twin", "--data_parallel", "2",
+            "--checkpoint_dir", os.path.join(workdir, name, "ckpts"),
+            "--log_dir", os.path.join(workdir, name, "runs")] + extra
+
+
+def _run_pod_twin(workdir, name, steps, extra_per_proc,
+                  want_rc=(0, 0), timeout=600):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(_pod_env(port), PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            _twin_cli(workdir, name, steps,
+                      ["--multihost"] + extra_per_proc[pid]),
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=timeout)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == want_rc[i], \
+            f"proc {i} rc {p.returncode} != {want_rc[i]}:\n{out[-3000:]}"
+    return outs
+
+
+def _losses_by_step(ledger_path, run_index=-1):
+    from raft_tpu.obs.events import read_ledger
+
+    records = read_ledger(ledger_path)
+    run_ids = [r["run"] for r in records if r["kind"] == "run_start"]
+    picked = run_ids[run_index]
+    return {r["step"]: r["means"]["loss"] for r in records
+            if r.get("kind") == "metrics" and r["run"] == picked}
+
+
+@pytest.mark.slow
+@requires_cpu_multiprocess
+def test_elastic_kill_one_host_and_resume_matches_unkilled(tmp_path):
+    """THE pod resilience flagship gate: 2 gloo processes on the
+    synthetic stage, process 0 SIGTERM-killed at step K via --inject;
+    the pod COORDINATES the rescue (both processes save their
+    checkpoint shards at the same boundary and exit 0), then the run
+    elastically resumes as ONE process with 2 virtual devices
+    (re-shard restore 2->1).  The merged loss trajectory must match
+    the unkilled twin exactly pre-kill and within 1e-6 rtol
+    post-resume."""
+    workdir = str(tmp_path)
+    N, K = 6, 3
+
+    _run_pod_twin(workdir, "unkilled", N, [[], []])
+    outs = _run_pod_twin(workdir, "killed", N,
+                         [["--inject", f"sigterm@{K}"], []])
+    # BOTH processes rescued (coordinated preemption): a full shard set
+    assert all("preempted: saved" in o for o in outs), outs[0][-2000:]
+    ckpts = sorted(os.listdir(os.path.join(workdir, "killed", "ckpts")))
+    assert f"{K}_twin.shard0of2.msgpack" in ckpts
+    assert f"{K}_twin.shard1of2.msgpack" in ckpts
+
+    # elastic resume: ONE process, 2 virtual devices, same global mesh
+    proc = subprocess.run(
+        _twin_cli(workdir, "killed", N, ["--resume"]),
+        cwd=REPO, env=_pod_env(None, devcount=2), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert f"at step {K}" in proc.stdout       # resumed at the kill point
+
+    killed_dir = os.path.join(workdir, "killed", "runs", "twin")
+    pre = _losses_by_step(os.path.join(killed_dir, "events.jsonl.p0"))
+    post = _losses_by_step(os.path.join(killed_dir, "events.jsonl"))
+    unkilled = _losses_by_step(os.path.join(
+        workdir, "unkilled", "runs", "twin", "events.jsonl.p0"))
+    assert sorted(pre) == list(range(1, K + 1))
+    assert sorted(post) == list(range(K + 1, N + 1))
+    assert sorted(unkilled) == list(range(1, N + 1))
+    # pre-kill prefix: identical fresh computation -> exact
+    for s in range(1, K + 1):
+        assert pre[s] == unkilled[s], (s, pre[s], unkilled[s])
+    # post-resume across the 2-process -> 1-process re-shard: pinned
+    post_arr = np.asarray([post[s] for s in range(K + 1, N + 1)])
+    ref = np.asarray([unkilled[s] for s in range(K + 1, N + 1)])
+    np.testing.assert_allclose(post_arr, ref, rtol=1e-6, atol=0,
+                               err_msg="elastic resume diverged from "
+                                       "the unkilled twin")
+    # typed trail: preempted on both processes, ckpt-reshard on resume
+    from raft_tpu.obs.events import read_ledger
+
+    for pid in range(2):
+        kinds = [r.get("incident") for r in read_ledger(
+            os.path.join(killed_dir, f"events.jsonl.p{pid}"))
+            if r.get("kind") == "incident"]
+        assert "preempted" in kinds, (pid, kinds)
+    resume_kinds = [r.get("incident") for r in read_ledger(
+        os.path.join(killed_dir, "events.jsonl"))
+        if r.get("kind") == "incident"]
+    assert "ckpt-reshard" in resume_kinds
+
+
+@pytest.mark.slow
+@requires_cpu_multiprocess
+def test_chaos_dist_fence_scenario(tmp_path):
+    """Chaos --dist smoke subset: the divergent-decision fence scenario
+    from scripts/chaos_dryrun.py (the full pod matrix is the script's
+    --dist invocation)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos_dryrun.py"),
+         "--dist", "--only", "dist-fence", "--steps", "2",
+         "--workdir", str(tmp_path)],
+        cwd=REPO, env=_pod_env(None), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "chaos_dryrun --dist: OK" in proc.stdout
+
+
+@pytest.mark.slow
+@requires_cpu_multiprocess
+def test_wedged_host_trips_watchdog_on_every_survivor(tmp_path):
+    """Acceptance: a wedged host (scripted collective stall) terminates
+    EVERY process with a typed host-lost/peer-fatal incident and a
+    nonzero exit within the configured timeout — no hang, no silent
+    SIGABRT."""
+    from raft_tpu.obs.events import read_ledger
+    from raft_tpu.parallel.elastic import WATCHDOG_EXIT_CODE
+
+    workdir = str(tmp_path)
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(_pod_env(port), PROCESS_ID=str(pid))
+        extra = ["--multihost", "--collective_timeout", "20"]
+        if pid == 0:
+            extra += ["--inject", "stall@2"]
+        procs.append(subprocess.Popen(
+            _twin_cli(workdir, "wedge", 6, extra), cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=400)
+        assert p.returncode == WATCHDOG_EXIT_CODE, \
+            f"proc {i} rc {p.returncode}:\n{out[-2000:]}"
+    fatal_kinds = set()
+    for pid in range(2):
+        led = os.path.join(workdir, "wedge", "runs", "twin",
+                           f"events.jsonl.p{pid}")
+        incidents = [(r.get("incident"), r.get("severity"))
+                     for r in read_ledger(led)
+                     if r.get("kind") == "incident"]
+        fatals = [k for k, sev in incidents if sev == "fatal"]
+        assert fatals, (pid, incidents)      # typed, not a bare crash
+        fatal_kinds.update(fatals)
+    assert "host-lost" in fatal_kinds
