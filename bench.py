@@ -421,6 +421,61 @@ def main():
     except Exception as e:
         print(f"fed bench (host aug) failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+
+    # Serving lane (raft_tpu/serve): synthetic requests through the
+    # real FlowServer (queue -> batcher -> AOT executor) at the bench
+    # resolution with the bench model's weights — requests/s/chip and
+    # the p95 request latency become scoreboard lanes next to the
+    # training numbers.  Full-quality iterations only (no degradation
+    # ladder: the lane measures capacity, not the shed behavior).
+    def _serve_lane():
+        from raft_tpu.serve.engine import ServeEngine
+        from raft_tpu.serve.server import FlowServer
+
+        serve_vars = {"params": state.params}
+        bs = getattr(state, "batch_stats", None)
+        if bs:
+            serve_vars["batch_stats"] = bs
+        serve_b = min(2, B)
+        engine = ServeEngine(RAFT(cfg), serve_vars, batch_size=serve_b)
+        server = FlowServer(engine, buckets={"bench": (H, W)},
+                            queue_capacity=max(8, 4 * serve_b),
+                            iter_levels=(iters,), degrade=False)
+        try:
+            server.warmup(warm_too=False)
+            rng_s = np.random.default_rng(7)
+
+            def frame():
+                return rng_s.uniform(0, 255, (H, W, 3)).astype(np.float32)
+
+            n_req = 4 if tiny else 24
+            t0 = time.perf_counter()
+            done = []
+            for i in range(n_req):
+                done.append(server.submit(frame(), frame()))
+                if (i + 1) % serve_b == 0:
+                    for f in done[-serve_b:]:
+                        f.result(timeout=600)
+            for f in done:
+                f.result(timeout=600)
+            wall = time.perf_counter() - t0
+            summary = server.close()
+            server = None
+            return {
+                "requests_per_s_per_chip": round(n_req / wall, 3),
+                "latency_p95_ms": summary.get("latency_p95_ms", 0.0),
+            }
+        finally:
+            if server is not None:
+                server.close()
+
+    serve_metrics = {"requests_per_s_per_chip": 0.0,
+                     "latency_p95_ms": 0.0}
+    try:
+        serve_metrics = _serve_lane()
+    except Exception as e:  # the serve lane must never sink the scoreboard
+        print(f"serve bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     # The headline fed lane mirrors the train CLI's auto policy: device
     # aug on an accelerator, host aug on a CPU backend (where the
     # matmul resample loses — an RAFT_BENCH_ALLOW_CPU smoke must not
@@ -436,7 +491,8 @@ def main():
                         "fed_pairs_per_s_device": round(fed_dev, 3),
                         "fed_pairs_per_s_host":
                             round(fed_pairs_per_s_host, 3),
-                        "fed_lane": fed_lane})
+                        "fed_lane": fed_lane}
+                     | serve_metrics)
 
     print(json.dumps({
         "metric": "image-pairs/sec/chip",
@@ -452,6 +508,9 @@ def main():
         "fed_lane": fed_lane,
         "fed_pairs_per_s_device": round(fed_dev, 3),
         "fed_pairs_per_s_host": round(fed_pairs_per_s_host, 3),
+        # serving lane: synthetic requests through the real FlowServer
+        # (queue -> batcher -> AOT executor) at this resolution
+        **serve_metrics,
         "host_cores": os.cpu_count(),
         "deferred_corr_grad": deferred,
         **({"tiny": True} if tiny else {}),

@@ -290,6 +290,24 @@ def _build_device_aug_sparse():
     return abstract_device_aug(sparse=True, wire_format="f32")
 
 
+def _build_serve_forward():
+    from raft_tpu.serve.engine import abstract_serve_forward
+
+    fwd, args = abstract_serve_forward(iters=2)
+    return fwd, args
+
+
+def _build_serve_forward_warm():
+    # the video-mode variant: an extra (B, H/8, W/8, 2) flow_init input
+    # and the warm-start add on the scan carry — structurally identical
+    # collectives (none), so a collective here means a sharding
+    # annotation leaked into the serving graph
+    from raft_tpu.serve.engine import abstract_serve_forward
+
+    fwd, args = abstract_serve_forward(iters=2, warm=True)
+    return fwd, args
+
+
 def _build_seeded_missharded():
     """Deliberate regression fixture: the dense lookup with its batch
     sharded over ``data`` but a REPLICATED forced output — the classic
@@ -352,6 +370,16 @@ ENTRIES: Dict[str, HloEntry] = {
     "device_aug_sparse": HloEntry(
         "device_aug_sparse", _build_device_aug_sparse,
         ("raft_tpu.data.device_aug", "abstract_device_aug")),
+    # the serving graphs (serve/engine.py): batched bf16 test_mode
+    # forwards, cold and warm-start — single-device by construction,
+    # and the bf16 churn bound guards the serving policy the same way
+    # eval_forward_bf16's does
+    "serve_forward": HloEntry(
+        "serve_forward", _build_serve_forward,
+        ("raft_tpu.serve.engine", "abstract_serve_forward")),
+    "serve_forward_warm": HloEntry(
+        "serve_forward_warm", _build_serve_forward_warm,
+        ("raft_tpu.serve.engine", "abstract_serve_forward")),
 }
 
 FIXTURE_ENTRIES: Dict[str, HloEntry] = {

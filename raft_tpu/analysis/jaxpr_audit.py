@@ -401,6 +401,42 @@ def audit_eval_forward() -> Tuple[List[Finding], Dict]:
     return _apply_waivers(findings), {}
 
 
+def audit_serve_forward() -> Tuple[List[Finding], Dict]:
+    """serve/engine.py's batched bf16 test_mode forwards (cold + the
+    flow_init warm-start variant): f64 hygiene under x64, no transfers
+    in the refinement scan, and the declared-f32 flow boundary — the
+    serving graph must hold the same contracts as the eval forward it
+    generalizes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    from raft_tpu.serve.engine import abstract_serve_forward
+
+    findings: List[Finding] = []
+    report: Dict = {"traced": []}
+    for name, warm in (("serve_forward", False),
+                       ("serve_forward_warm", True)):
+        fwd, args = abstract_serve_forward(iters=_ITERS, warm=warm)
+        with enable_x64():
+            jx = jax.make_jaxpr(fwd)(*args)
+        report["traced"].append(name)
+        findings.extend(_f64_findings(name, jx))
+        for prim, prov in find_loop_transfers(jx):
+            findings.append(_finding(
+                "scan-transfer", name,
+                f"{prim} inside a scan body at {prov}"))
+        flow_low, flow_up = jax.eval_shape(fwd, *args)
+        for out_name, leaf in [("flow_low", flow_low),
+                               ("flow_up", flow_up)]:
+            if leaf.dtype != jnp.float32:
+                findings.append(_finding(
+                    "bf16-policy", name,
+                    f"{out_name} leaves the serving forward as "
+                    f"{leaf.dtype}; flow is a declared-f32 boundary"))
+    return _apply_waivers(findings), report
+
+
 def audit_corr_lookups() -> Tuple[List[Finding], Dict]:
     """ops/corr.py + ops/corr_pallas.py lookup kernels, tiny shapes."""
     import jax
@@ -511,6 +547,7 @@ ENTRY_AUDITS: Dict[str, Callable[[], Tuple[List[Finding], Dict]]] = {
     "bf16_policy": audit_bf16_policy,
     "parallel_step": audit_parallel_step,
     "eval_forward": audit_eval_forward,
+    "serve_forward": audit_serve_forward,
     "corr_lookups": audit_corr_lookups,
     "device_aug": audit_device_aug,
     "recompile_keys": audit_recompile_keys,

@@ -1203,6 +1203,23 @@ def _build_pyramid_pallas_stacked():
     return fn, args, fmap_ranges(args)
 
 
+def _build_serve_forward():
+    from raft_tpu.serve.engine import abstract_serve_forward
+
+    fwd, args = abstract_serve_forward(iters=2)
+    return fwd, args, declared_ranges(args)
+
+
+def _build_serve_forward_warm():
+    # the video variant: the flow_init input and its warm-start add on
+    # the scan carry only exist in THIS graph — a bf16 regression on
+    # that path would pass the cold entry clean
+    from raft_tpu.serve.engine import abstract_serve_forward
+
+    fwd, args = abstract_serve_forward(iters=2, warm=True)
+    return fwd, args, declared_ranges(args)
+
+
 def _build_device_aug():
     from raft_tpu.data.device_aug import abstract_device_aug
 
@@ -1226,6 +1243,14 @@ ENTRIES: Dict[str, NumEntry] = {
                               rules=DEEP_RULES),
     "eval_forward": NumEntry("eval_forward", _build_eval_forward,
                              rules=DEEP_RULES),
+    # the serving graph (serve/engine.py): the batched bf16 inference
+    # policy — the bf16-accum and overflow rules prove the serving
+    # dtype story the same way train_step_bf16's do
+    "serve_forward": NumEntry("serve_forward", _build_serve_forward,
+                              rules=DEEP_RULES),
+    "serve_forward_warm": NumEntry("serve_forward_warm",
+                                   _build_serve_forward_warm,
+                                   rules=DEEP_RULES),
     "corr_lookup_dense": NumEntry("corr_lookup_dense",
                                   lambda: _build_corr("dense")),
     "corr_lookup_chunked": NumEntry("corr_lookup_chunked",

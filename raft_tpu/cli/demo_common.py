@@ -25,11 +25,21 @@ def add_model_args(p) -> None:
     p.add_argument("--corr_impl", default="chunked", choices=CORR_IMPLS,
                    help="on-demand correlation implementation "
                         "(with --alternate_corr)")
+    p.add_argument("--aot_cache",
+                   default=os.environ.get("RAFT_AOT_CACHE") or None,
+                   help="crash-safe on-disk executable cache directory "
+                        "(serve/aot.py): repeat invocations skip the "
+                        "XLA compile; default $RAFT_AOT_CACHE")
 
 
 def load_model(ckpt: str, small: bool = False, mixed_precision: bool = False,
-               alternate_corr: bool = False, corr_impl: str = "chunked"):
+               alternate_corr: bool = False, corr_impl: str = "chunked",
+               aot_cache: Optional[str] = None):
     """Build RAFT + load a checkpoint (demo.py:43-48 analogue).
+
+    ``aot_cache`` routes the Evaluator's per-shape compiles through the
+    verified on-disk executable cache — a demo re-run over the same
+    frame sizes starts warm instead of recompiling.
 
     Returns (model, variables, evaluator).
     """
@@ -45,7 +55,8 @@ def load_model(ckpt: str, small: bool = False, mixed_precision: bool = False,
         corr_impl=corr_impl)
     model = RAFT(cfg)
     variables = load_variables(ckpt, model)
-    return model, variables, Evaluator(model, variables)
+    return model, variables, Evaluator(model, variables,
+                                       aot_cache=aot_cache)
 
 
 def load_image(path: str) -> np.ndarray:

@@ -37,7 +37,7 @@ def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
                                  args.mixed_precision, args.alternate_corr,
-                                 args.corr_impl)
+                                 args.corr_impl, aot_cache=args.aot_cache)
     image1 = load_image(args.image1)
     image2 = load_image(args.image2)
     _, flow = infer_flow(evaluator, image1, image2, iters=args.iters)

@@ -29,7 +29,7 @@ def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
                                  args.mixed_precision, args.alternate_corr,
-                                 args.corr_impl)
+                                 args.corr_impl, aot_cache=args.aot_cache)
     frames = list_frames(args.path)
     for i, (p1, p2) in enumerate(zip(frames[:-1], frames[1:])):
         image1 = load_image(p1)

@@ -48,7 +48,7 @@ def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
                                  args.mixed_precision, args.alternate_corr,
-                                 args.corr_impl)
+                                 args.corr_impl, aot_cache=args.aot_cache)
     frames = list_frames(args.path)
     images = [resize_to_multiple_of_8(load_image(p)) for p in frames]
 

@@ -40,7 +40,7 @@ def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
                                  args.mixed_precision, args.alternate_corr,
-                                 args.corr_impl)
+                                 args.corr_impl, aot_cache=args.aot_cache)
     for i, (p1, p2) in enumerate(read_pairs(args.imglist)):
         image1 = load_image(p1)
         image2 = load_image(p2)

@@ -50,7 +50,7 @@ def main(argv=None):
     args = parse_args(argv)
     _, _, evaluator = load_model(args.model, args.small,
                                  args.mixed_precision, args.alternate_corr,
-                                 args.corr_impl)
+                                 args.corr_impl, aot_cache=args.aot_cache)
     seqs = read_sequences(args.split_file)
     if args.max_sequences:
         seqs = seqs[: args.max_sequences]

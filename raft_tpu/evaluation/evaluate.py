@@ -40,32 +40,86 @@ class Evaluator:
     in the same stall-attribution report as training — a cache-missing
     shape's compile lands inside its first dispatch span, which is
     exactly how shape thrash becomes visible in a ledger.
+
+    ``aot_cache`` (a serve.AOTCache or a directory path) routes every
+    compile through the crash-safe on-disk executable cache: repeat
+    invocations of the eval/demo CLIs stop re-paying XLA compiles (the
+    warm-restart story serving uses, shared here), with cold-vs-warm
+    seconds logged per shape.  A torn cache entry falls back to
+    recompile with a typed ``serve-cache-corrupt`` log, never a crash.
     """
 
     def __init__(self, model, variables, max_cached_shapes: int = 16,
-                 spans=None):
+                 spans=None, aot_cache=None):
         from raft_tpu.obs.spans import NULL
 
         self.model = model
         self.variables = variables
         self.max_cached_shapes = max_cached_shapes
         self.spans = spans if spans is not None else NULL
+        if isinstance(aot_cache, str):
+            from raft_tpu.serve.aot import AOTCache
+            aot_cache = AOTCache(aot_cache)
+        self.aot = aot_cache
+        self._var_sig = None
         import collections
         self._cache = collections.OrderedDict()
+
+    def _aot_compile(self, warm: bool, iters: int,
+                     image1: np.ndarray, image2: np.ndarray, flow_init):
+        """lower/compile the forward for this shape through the on-disk
+        executable cache (the SAME build recipe as the serving
+        executors — serve.engine.compile_test_forward); logs the
+        cold-vs-warm startup cost."""
+        import time
+
+        from raft_tpu.serve.engine import (_tree_signature, arg_signature,
+                                           compile_test_forward,
+                                           forward_cache_key)
+
+        model = self.model
+        if self._var_sig is None:
+            self._var_sig = _tree_signature(self.variables)
+        sds = lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+        args = (image1, image2) + ((flow_init,) if warm else ())
+        dkey = forward_cache_key("eval_forward", model, self._var_sig,
+                                 arg_signature(*args), iters, warm)
+
+        def build():
+            return compile_test_forward(
+                model, self.variables, sds(image1), sds(image2), iters,
+                flow_sds=sds(flow_init) if warm else None)
+
+        t0 = time.perf_counter()
+        fn, was_warm = self.aot.get_or_compile(
+            dkey, build, label=f"eval_forward {image1.shape} "
+                               f"iters={iters} warm={warm}")
+        import logging
+        logging.getLogger(__name__).info(
+            "Evaluator: %s startup for shape %s iters=%d warm=%s: %.2fs",
+            "warm (AOT cache)" if was_warm else "cold (compile)",
+            image1.shape, iters, warm, time.perf_counter() - t0)
+        return fn
 
     def __call__(self, image1: np.ndarray, image2: np.ndarray, iters: int,
                  flow_init: Optional[np.ndarray] = None):
         warm = flow_init is not None
-        key = (image1.shape, iters, warm)
+        # EVERY input's shape+dtype joins the memo key: the AOT path
+        # loads signature-exact compiled executables (jit would retrace
+        # on a changed image2/flow_init signature; a compiled
+        # executable must be keyed on the full call signature)
+        from raft_tpu.serve.engine import arg_signature, make_test_forward
+
+        key = (arg_signature(*((image1, image2)
+                               + ((flow_init,) if warm else ()))),
+               iters, warm)
         fn = self._cache.get(key)
         if fn is None:
-            model = self.model
-            if warm:
-                fn = jax.jit(lambda v, a, b, f: model.apply(
-                    v, a, b, iters=iters, flow_init=f, test_mode=True))
+            if self.aot is not None:
+                fn = self._aot_compile(warm, iters, image1, image2,
+                                       flow_init)
             else:
-                fn = jax.jit(lambda v, a, b: model.apply(
-                    v, a, b, iters=iters, test_mode=True))
+                fn = make_test_forward(self.model, iters, warm=warm)
             if len(self._cache) >= self.max_cached_shapes:
                 import sys
                 old_key, _ = self._cache.popitem(last=False)

@@ -37,8 +37,33 @@ def _gate(incidents, fail_on_incident: Optional[str]) -> int:
     return 0
 
 
+def _slo_gate(report, fail_on_slo: bool) -> int:
+    """The serving SLO gate: exit 1 when the run's measured p95
+    violates its configured SLO; misuse (no serving section, or no SLO
+    was configured for the run) is a loud 2, never a silent pass."""
+    if not fail_on_slo:
+        return 0
+    serving = report.get("serving")
+    if not serving:
+        print("obs report: --fail-on-slo but the ledger has no serving "
+              "summary (not a serve run?)", file=sys.stderr)
+        return 2
+    if "slo_ok" not in serving:
+        print("obs report: --fail-on-slo but the run recorded no SLO "
+              "target / no latency samples (run serve with --slo_ms)",
+              file=sys.stderr)
+        return 2
+    if not serving["slo_ok"]:
+        print(f"obs report: serving p95 "
+              f"{serving.get('latency_p95_ms')}ms violates the "
+              f"{serving.get('slo_p95_ms')}ms SLO", file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_report(path: str, as_json: bool,
-               fail_on_incident: Optional[str]) -> int:
+               fail_on_incident: Optional[str],
+               fail_on_slo: bool = False) -> int:
     from raft_tpu.obs.events import read_ledger, sanitize_json
     from raft_tpu.obs.report import build_report, render_report
 
@@ -58,7 +83,8 @@ def run_report(path: str, as_json: bool,
                          allow_nan=False))
     else:
         print(render_report(report))
-    return _gate(report["incidents"], fail_on_incident)
+    return (_gate(report["incidents"], fail_on_incident)
+            or _slo_gate(report, fail_on_slo))
 
 
 def run_merged_report(path: str, as_json: bool,
@@ -232,15 +258,27 @@ def main(argv=None) -> int:
                          "(retries, quarantines, skips, rollbacks, "
                          "checkpoint fallbacks) pass, which is the gate "
                          "chaos runs use")
+    rp.add_argument("--fail-on-slo", dest="fail_on_slo",
+                    action="store_true",
+                    help="exit 1 when the run's serving summary shows "
+                         "p95 latency above its configured SLO "
+                         "(requires a serve-run ledger with --slo_ms "
+                         "set; anything else is a loud usage error)")
     args = p.parse_args(argv)
 
     if args.selfcheck:
         return run_selfcheck()
     if args.cmd == "report":
         if args.merge:
+            if args.fail_on_slo:
+                print("obs report: --fail-on-slo is a single-run gate "
+                      "(serve runs are single-process); drop --merge",
+                      file=sys.stderr)
+                return 2
             return run_merged_report(args.ledger, args.json,
                                      args.fail_on_incident)
-        return run_report(args.ledger, args.json, args.fail_on_incident)
+        return run_report(args.ledger, args.json, args.fail_on_incident,
+                          args.fail_on_slo)
     p.print_help()
     return 2
 

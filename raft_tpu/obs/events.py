@@ -84,6 +84,37 @@ incident                severity  meaning
                                   fault fired on this host
 ``data-unreadable``     fatal     loader retry + quarantine exhausted:
                                   the dataset itself is unreadable
+``queue-full``          warn      serving admission control shed a
+                                  request typed (bounded queue at
+                                  capacity); the caller was told, the
+                                  counter advanced — never a silent
+                                  drop
+``deadline-exceeded``   warn      a request expired before dispatch
+                                  and was rejected typed PRE-dispatch
+                                  (no device time spent on an answer
+                                  nobody is waiting for)
+``bad-request``         warn      mis-shaped or non-finite-input
+                                  request rejected typed; a poisoned
+                                  request's batch slot stays zero so
+                                  neighbors are unaffected
+``serve-cache-corrupt`` recovered a torn/unverifiable AOT executable
+                                  cache entry was rejected at load and
+                                  quarantined; fell back to recompile
+``serve-degraded``      warn      the iteration controller stepped
+                                  DOWN a degradation level under
+                                  queue/SLO pressure (level span
+                                  start; accuracy held by the flat
+                                  iteration curve)
+``serve-restored``      recovered the controller stepped back UP (the
+                                  pressure cleared; level span end)
+``serve-stalled``       fatal     the dispatch watchdog declared a
+                                  wedged compile/dispatch; the server
+                                  exits nonzero (exit code 14)
+``serve-conservation``  fatal     requests unaccounted for at server
+                                  close (submitted != served +
+                                  rejected): a silent drop happened —
+                                  the invariant the serving layer
+                                  exists to make impossible
 ======================  ========  =====================================
 
 Append-only by construction: the file is opened in append mode and
@@ -131,6 +162,14 @@ DEFAULT_INCIDENT_SEVERITY = {
     "rollback": "recovered",
     "ckpt-corrupt": "recovered",
     "preempted": "recovered",
+    "queue-full": "warn",
+    "deadline-exceeded": "warn",
+    "bad-request": "warn",
+    "serve-cache-corrupt": "recovered",
+    "serve-degraded": "warn",
+    "serve-restored": "recovered",
+    "serve-stalled": "fatal",
+    "serve-conservation": "fatal",
 }
 
 

@@ -182,8 +182,21 @@ def build_report(records: List[Dict]) -> Dict:
         resilience["mean_recovery_latency_steps"] = round(
             recovery_counters.get("skipped_steps", 0) / bursts, 2)
 
+    # Serving section: the FlowServer's run_end summary (request
+    # conservation counters, latency percentiles, degradation history)
+    # plus the derived SLO verdict — the ``--fail-on-slo`` gate's input.
+    serving = (summary or {}).get("serving")
+    if serving is not None:
+        serving = dict(serving)
+        p95 = serving.get("latency_p95_ms")
+        slo = serving.get("slo_p95_ms")
+        if isinstance(p95, (int, float)) and isinstance(slo, (int, float)) \
+                and p95 == p95:
+            serving["slo_ok"] = bool(p95 <= slo)
+
     return {
         "meta": meta,
+        "serving": serving,
         "runs": n_runs,
         "steps": steps,
         "windows": len(metrics_windows),
@@ -437,6 +450,57 @@ def render_report(report: Dict) -> str:
         if res.get("unrecovered", 0):
             lines.append(f"  UNRECOVERED fatal incidents: "
                          f"{res['unrecovered']}")
+
+    serving = report.get("serving")
+    if serving:
+        lines.append("")
+        lines.append("serving:")
+        lines.append(
+            f"  requests: {serving.get('submitted', 0)} submitted  "
+            f"{serving.get('served', 0)} served  "
+            f"{serving.get('rejected_total', 0)} rejected typed "
+            f"(queue-full {serving.get('rejected_queue_full', 0)}, "
+            f"deadline {serving.get('rejected_deadline', 0)}, "
+            f"bad-request {serving.get('rejected_bad_request', 0)}, "
+            f"shutdown {serving.get('rejected_shutdown', 0)})")
+        unacc = serving.get("unaccounted", 0)
+        if unacc:
+            lines.append(f"  SILENT DROPS: {unacc} request(s) "
+                         f"unaccounted for — conservation violated")
+
+        def _ms(v):
+            return (f"{v:.1f} ms" if isinstance(v, (int, float))
+                    and v == v else "n/a")
+
+        slo = serving.get("slo_p95_ms")
+        slo_s = ""
+        if isinstance(slo, (int, float)):
+            # slo_ok is only derived when a p95 was actually measured
+            # (build_report's NaN guard) — a run that rejected every
+            # request pre-dispatch has no samples and no verdict
+            if "slo_ok" in serving:
+                verdict = "met" if serving["slo_ok"] else "VIOLATED"
+            else:
+                verdict = "no latency samples"
+            slo_s = f"   SLO p95 {_ms(slo)}: {verdict}"
+        lines.append(
+            f"  latency    p50 {_ms(serving.get('latency_p50_ms'))}   "
+            f"p95 {_ms(serving.get('latency_p95_ms'))}   "
+            f"max {_ms(serving.get('latency_max_ms'))}{slo_s}")
+        deg = serving.get("degradation") or {}
+        if deg:
+            lines.append(
+                f"  degradation: max level {deg.get('max_level', 0)} of "
+                f"ladder {deg.get('levels')}  "
+                f"({deg.get('transitions', 0)} transition(s), final "
+                f"level {deg.get('final_level', 0)})")
+        aot = serving.get("aot_cache")
+        if aot:
+            lines.append(
+                f"  aot cache: {aot.get('hits', 0)} warm hit(s)  "
+                f"{aot.get('misses', 0)} cold compile(s) "
+                f"({aot.get('compile_s', 0):.2f} s)  "
+                f"{aot.get('corrupt', 0)} corrupt")
 
     means = report["last_window_means"]
     if means:

@@ -1,0 +1,46 @@
+"""raft_tpu.serve: the fault-tolerant serving subsystem.
+
+Pieces (one module each, composable and individually testable):
+
+- :mod:`~raft_tpu.serve.aot` — crash-safe on-disk cache of AOT-compiled
+  executables (manifest-verified, typed ``serve-cache-corrupt``
+  fallback to recompile);
+- :mod:`~raft_tpu.serve.engine` — bucketed bf16 inference executor +
+  the ``abstract_serve_forward`` entry point the graftlint engines
+  audit;
+- :mod:`~raft_tpu.serve.batcher` — bounded queue, typed admission
+  control, deadline-aware assembly, per-slot poison isolation;
+- :mod:`~raft_tpu.serve.degrade` — the adaptive refinement-iteration
+  controller (graceful degradation) + latency tracking;
+- :mod:`~raft_tpu.serve.watchdog` — wedged compile/dispatch -> typed
+  ``serve-stalled`` + nonzero exit;
+- :mod:`~raft_tpu.serve.server` — the FlowServer composition with
+  health/readiness probes and the obs-ledger serving summary.
+
+``python -m raft_tpu.serve`` drives a synthetic load session (the
+chaos-matrix and bench harness target); see ``--help``.
+"""
+
+from raft_tpu.serve.aot import AOTCache, cache_key, env_fingerprint
+from raft_tpu.serve.batcher import (BadRequestError, DeadlineExceededError,
+                                    QueueFullError, Request, RequestError,
+                                    RequestQueue)
+from raft_tpu.serve.degrade import (DEFAULT_ITER_LEVELS, IterationController,
+                                    LatencyTracker)
+from raft_tpu.serve.engine import (ServeEngine, abstract_serve_forward,
+                                   bucket_for, default_buckets,
+                                   pad_to_bucket, serve_config)
+from raft_tpu.serve.server import FlowServer
+from raft_tpu.serve.watchdog import (SERVE_WATCHDOG_EXIT_CODE,
+                                     DispatchWatchdog)
+
+__all__ = [
+    "AOTCache", "cache_key", "env_fingerprint",
+    "BadRequestError", "DeadlineExceededError", "QueueFullError",
+    "Request", "RequestError", "RequestQueue",
+    "DEFAULT_ITER_LEVELS", "IterationController", "LatencyTracker",
+    "ServeEngine", "abstract_serve_forward", "bucket_for",
+    "default_buckets", "pad_to_bucket", "serve_config",
+    "FlowServer",
+    "SERVE_WATCHDOG_EXIT_CODE", "DispatchWatchdog",
+]

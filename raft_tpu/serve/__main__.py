@@ -1,0 +1,266 @@
+"""CLI driver: ``python -m raft_tpu.serve`` — a synthetic serving
+session against the real FlowServer.
+
+No network surface (the subsystem is the queue/batcher/executor
+composition; transport is deployment-specific) — the driver generates
+synthetic request traffic in-process, which is exactly what the chaos
+matrix (scripts/chaos_dryrun.py --serve), the serving bench lane
+(bench.py) and the README quickstart need: a fully-driven server with
+every failure injection reachable from flags.
+
+Prints TWO machine-readable lines on stdout:
+
+- after warmup: ``{"serve_startup": {"startup_s": ..., "warm_hits":
+  ..., "cold_compiles": ...}}`` — flushed immediately, so a SIGKILLed
+  session still reports its startup cost (the warm-restart gate's
+  measurement);
+- at exit: ``{"serve_summary": {...}}`` — the serving summary (request
+  conservation counters, latency percentiles vs SLO, degradation
+  history, AOT cache stats).
+
+Exit codes: 0 clean; 1 when ``--fail-on-slo`` trips or request
+conservation is violated; 14 (:data:`SERVE_WATCHDOG_EXIT_CODE`) when
+the dispatch watchdog declares a wedge; 2 usage.
+
+``--inject`` (serve-side chaos, distinct from the training-path
+``--inject`` grammar in resilience/faults.py):
+
+- ``overload``       submit the whole load as one burst against the
+                     bounded queue: typed ``queue-full`` sheds
+- ``deadline-storm`` every request carries a ~0 deadline: typed
+                     ``deadline-exceeded`` rejections pre-dispatch
+- ``poison@K``       request K ships non-finite pixels: typed
+                     ``bad-request``, neighbors unaffected
+- ``sigkill@K``      hard-kill the process (SIGKILL, no cleanup) after
+                     K served requests: the crash the AOT cache must
+                     survive
+- ``stall``          wedge the first dispatch forever: the watchdog
+                     must convert the hang into ``serve-stalled`` +
+                     exit 14 (pair with --watchdog_timeout)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def parse_inject(spec):
+    """(kind, arg) from the serve chaos grammar above."""
+    if not spec:
+        return None, 0
+    kind, _, arg = spec.partition("@")
+    kinds = ("overload", "deadline-storm", "poison", "sigkill", "stall")
+    if kind not in kinds:
+        raise ValueError(f"unknown serve inject {kind!r} "
+                         f"(known: {', '.join(kinds)})")
+    if kind in ("poison", "sigkill"):
+        if not arg.isdigit():
+            raise ValueError(f"inject {kind} needs @K (request ordinal)")
+        return kind, int(arg)
+    if arg:
+        raise ValueError(f"inject {kind} takes no @arg")
+    return kind, 0
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        "python -m raft_tpu.serve",
+        description="drive a synthetic session against the fault-"
+                    "tolerant flow server")
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--image_size", type=int, nargs=2, default=(64, 64))
+    p.add_argument("--batch_size", type=int, default=2)
+    p.add_argument("--queue_capacity", type=int, default=16)
+    p.add_argument("--iter_levels", default="8,4,2",
+                   help="degradation ladder, full quality first "
+                        "(production: 32,24,16,8; default is CPU-smoke "
+                        "sized)")
+    p.add_argument("--slo_ms", type=float, default=None,
+                   help="p95 latency SLO; enables the controller's "
+                        "latency signal and --fail-on-slo")
+    p.add_argument("--deadline_ms", type=float, default=None,
+                   help="per-request deadline")
+    p.add_argument("--video_streams", type=int, default=0,
+                   help="assign requests round-robin to N video streams "
+                        "(flow_init warm-start chaining)")
+    p.add_argument("--warm_iters", type=int, default=None,
+                   help="iteration floor for fully-warm video batches")
+    p.add_argument("--no_degrade", action="store_true")
+    p.add_argument("--aot_cache", default=None,
+                   help="AOT executable cache directory (warm restarts)")
+    p.add_argument("--ledger", default=None,
+                   help="obs run-ledger path (events.jsonl)")
+    p.add_argument("--watchdog_timeout", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--inject", default=None)
+    p.add_argument("--fail-on-slo", dest="fail_on_slo",
+                   action="store_true",
+                   help="exit 1 when measured p95 exceeds --slo_ms")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    try:
+        inject, inject_arg = parse_inject(args.inject)
+    except ValueError as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+
+    import numpy as np
+
+    from raft_tpu.utils.platform import ensure_platform
+
+    ensure_platform(honor_device_count_flag=False)
+
+    import jax
+
+    from raft_tpu.models import RAFT
+    from raft_tpu.obs import RunLedger
+    from raft_tpu.serve import (AOTCache, FlowServer, RequestError,
+                                ServeEngine, serve_config)
+    from raft_tpu.serve.engine import _round8
+
+    H, W = (_round8(x) for x in args.image_size)
+    levels = tuple(int(x) for x in args.iter_levels.split(","))
+    # the small model is the only sensible config for this in-process
+    # synthetic driver (checkpointed full-size serving is the eval
+    # CLI's job); no flag pretends otherwise
+    cfg = serve_config(small=True)
+    model = RAFT(cfg)
+    rng = np.random.default_rng(args.seed)
+
+    ledger = None
+    if args.ledger:
+        ledger = RunLedger(args.ledger, meta={
+            "entry": "serve", "image_size": [H, W],
+            "batch_size": args.batch_size, "iter_levels": list(levels),
+            "slo_ms": args.slo_ms,
+            "backend": jax.devices()[0].platform,
+            "devices": jax.device_count(),
+        })
+
+    def incident(kind, detail):
+        if ledger is not None:
+            ledger.incident(kind, step=0, detail=detail)
+
+    aot = AOTCache(args.aot_cache, on_incident=incident) \
+        if args.aot_cache else None
+
+    # random-init weights: the driver exercises the serving MACHINERY;
+    # checkpoint loading is the eval CLI's job (cli/evaluate.py routes
+    # through the same AOTCache)
+    init_img = np.zeros((1, H, W, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(args.seed), init_img,
+                           init_img, iters=2, train=True)
+
+    engine = ServeEngine(model, variables, batch_size=args.batch_size,
+                         aot_cache=aot)
+    if inject == "stall":
+        real_forward = engine.forward
+
+        def wedged_forward(*a, **kw):
+            time.sleep(3600)           # the wedge the watchdog must kill
+            return real_forward(*a, **kw)
+
+        engine.forward = wedged_forward
+
+    buckets = {"session": (H, W)}
+    server = FlowServer(
+        engine, buckets=buckets, queue_capacity=args.queue_capacity,
+        iter_levels=levels, slo_ms=args.slo_ms,
+        degrade=not args.no_degrade, warm_iters=args.warm_iters,
+        ledger=ledger, watchdog_timeout_s=args.watchdog_timeout)
+
+    t0 = time.perf_counter()
+    server.warmup(warm_too=args.video_streams > 0)
+    startup_s = time.perf_counter() - t0
+    stats = dict(aot.stats) if aot else {}
+    print(json.dumps({"serve_startup": {
+        "startup_s": round(startup_s, 3),
+        "warm_hits": int(stats.get("hits", 0)),
+        "cold_compiles": int(stats.get("misses", 0)),
+        "cache_corrupt": int(stats.get("corrupt", 0)),
+    }}), flush=True)
+
+    def frame():
+        return rng.integers(0, 255, (H, W, 3)).astype(np.float32)
+
+    futures = []
+    served = 0
+    for i in range(args.requests):
+        img1, img2 = frame(), frame()
+        if inject == "poison" and i == inject_arg:
+            img1 = img1.copy()
+            img1[0, 0, 0] = np.nan
+        stream = (f"s{i % args.video_streams}"
+                  if args.video_streams else None)
+        deadline = args.deadline_ms
+        if inject == "deadline-storm":
+            deadline = -1.0            # already expired at submit: the
+            # assembly-time check MUST shed it pre-dispatch regardless
+            # of how fast the batcher wakes
+        try:
+            futures.append(server.submit(img1, img2,
+                                         deadline_ms=deadline,
+                                         stream=stream))
+        except RequestError:           # typed shed (queue-full / bad
+            futures.append(None)       # request), already counted
+        if inject != "overload" and (i + 1) % args.batch_size == 0:
+            # paced mode: wait for the chunk so the queue never backs
+            # up; overload mode slams the whole burst in at once
+            for f in futures[-args.batch_size:]:
+                if f is None:
+                    continue
+                try:
+                    f.result(timeout=600)
+                    served += 1
+                    if inject == "sigkill" and served >= inject_arg:
+                        os.kill(os.getpid(), signal.SIGKILL)
+                except RequestError:
+                    continue
+    for f in futures:
+        if f is None or f.done():
+            continue
+        try:
+            f.result(timeout=600)
+        except RequestError:
+            continue
+
+    summary = server.close()
+    # same strict-JSON discipline as the ledger: a zero-served run has
+    # NaN percentiles, and bare NaN tokens break `| jq` on the one
+    # machine-readable surface this driver promises
+    from raft_tpu.obs.events import sanitize_json
+    print(json.dumps({"serve_summary": sanitize_json(summary)},
+                     default=str, allow_nan=False), flush=True)
+
+    if summary["unaccounted"]:
+        print(f"serve: request conservation VIOLATED "
+              f"({summary['unaccounted']} unaccounted)", file=sys.stderr)
+        return 1
+    if args.fail_on_slo:
+        if args.slo_ms is None:
+            print("serve: --fail-on-slo needs --slo_ms", file=sys.stderr)
+            return 2
+        p95 = summary.get("latency_p95_ms")
+        if p95 is None or p95 != p95:
+            # no samples: a loud usage outcome, never a silent green —
+            # the obs-report gate's contract, mirrored here
+            print("serve: --fail-on-slo but the session measured no "
+                  "latency (zero served requests)", file=sys.stderr)
+            return 2
+        if p95 > args.slo_ms:
+            print(f"serve: p95 {p95:.1f}ms exceeds SLO "
+                  f"{args.slo_ms:.1f}ms", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

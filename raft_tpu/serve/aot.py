@@ -1,0 +1,250 @@
+"""Crash-safe on-disk cache of AOT-compiled XLA executables.
+
+The serving cold-start problem: ``jax.jit`` compiles lazily, per
+process, so every server restart (and every eval/demo CLI invocation)
+re-pays seconds-to-minutes of XLA compile before the first request is
+served.  ``jax.experimental.serialize_executable`` can round-trip a
+compiled executable through bytes; this module turns that into a cache
+with the PR 6 checkpoint-manifest discipline:
+
+- every store is an **atomic** fsync'd-tmp + rename
+  (``training/state.py`` machinery) and ships a sidecar manifest
+  (``<key>.aotx.manifest.json``: byte size, sha256 of the exact bytes
+  renamed, the environment fingerprint, a human-readable label) —
+  written AFTER the blob, so a kill between the renames leaves a blob
+  with no manifest (an unverifiable file, refused at load), never a
+  manifest describing bytes that don't exist;
+- every load **verifies before trusting**: size + sha256 against the
+  manifest catches torn/truncated/bit-rotted files WITHOUT unpickling
+  attacker-grade bytes, and the environment fingerprint (jax/jaxlib
+  version, backend platform, device kind) catches a cache directory
+  carried across an upgrade — a stale executable must never be fed
+  inputs it was not compiled for;
+- a failed verification is a typed ``serve-cache-corrupt`` incident and
+  a **fallback to recompile** — a torn cache file must never crash the
+  server or silently mis-serve, it only costs the cold compile it would
+  have saved.
+
+Cache keys are content-addressed (sha256 over the caller's key parts:
+config fingerprint, weight-tree signature, input shapes/dtypes,
+iteration count), so distinct graphs can never collide and a config
+change naturally misses instead of mis-serving.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+AOT_SUFFIX = ".aotx"
+AOT_MANIFEST_VERSION = 1
+
+# Incident type for a cache entry that failed verification or
+# deserialization (taxonomy: obs/events.py) — severity "recovered":
+# the fallback recompile restores service.
+CACHE_CORRUPT_INCIDENT = "serve-cache-corrupt"
+
+
+def env_fingerprint() -> str:
+    """Fingerprint of everything a serialized executable is specific to:
+    jax/jaxlib versions and the backend's platform + device kind.  An
+    executable deserialized under a different environment may crash or —
+    worse — mis-execute; a mismatch is a cache MISS, not corruption."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return "|".join([jax.__version__, jaxlib.__version__,
+                     dev.platform, getattr(dev, "device_kind", "?")])
+
+
+def cache_key(*parts) -> str:
+    """Content-addressed key: sha256 over the reprs of ``parts``."""
+    blob = "\x1e".join(repr(p) for p in parts)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:32]
+
+
+class AOTCache:
+    """Disk cache of serialized compiled executables, verify-on-load.
+
+    ``on_incident(kind, detail)`` receives the typed
+    ``serve-cache-corrupt`` firing when a cached entry fails
+    verification; the entry is quarantined (renamed ``.corrupt``) so
+    the next load doesn't re-pay the failed verify, and the caller
+    recompiles.  ``stats`` counts hits/misses/corruptions and the
+    wall seconds spent compiling vs loading — the cold-vs-warm startup
+    numbers the serving CLI and eval harness log.
+    """
+
+    def __init__(self, cache_dir: str,
+                 on_incident: Optional[Callable[[str, str], None]] = None):
+        self.cache_dir = cache_dir
+        self._on_incident = on_incident
+        self._env = None  # lazy: importing jax at construction is not free
+        self.stats: Dict[str, float] = {
+            "hits": 0, "misses": 0, "corrupt": 0,
+            "compile_s": 0.0, "load_s": 0.0,
+        }
+        os.makedirs(cache_dir, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+
+    def path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, key + AOT_SUFFIX)
+
+    def _manifest_path(self, key: str) -> str:
+        from raft_tpu.training.state import manifest_path
+
+        return manifest_path(self.path(key))
+
+    def _env_fp(self) -> str:
+        if self._env is None:
+            self._env = env_fingerprint()
+        return self._env
+
+    def _incident(self, detail: str) -> None:
+        self.stats["corrupt"] += 1
+        logger.warning("AOT cache: %s", detail)
+        if self._on_incident is not None:
+            self._on_incident(CACHE_CORRUPT_INCIDENT, detail)
+
+    def _quarantine(self, key: str) -> None:
+        """Move a failed entry aside so the NEXT load is a clean miss
+        instead of re-verifying known-bad bytes; best-effort."""
+        for p in (self.path(key), self._manifest_path(key)):
+            try:
+                if os.path.exists(p):
+                    os.replace(p, p + ".corrupt")
+            except OSError:
+                logger.warning("AOT cache: could not quarantine %s", p)
+
+    # -- load / store --------------------------------------------------------
+
+    def load(self, key: str, label: str = ""):
+        """The cached executable for ``key``, or None.
+
+        Missing entry or environment mismatch -> miss (None, silent).
+        Present-but-unverifiable entry (torn blob, sha mismatch, missing
+        or unreadable manifest, undeserializable bytes) -> typed
+        ``serve-cache-corrupt`` incident, quarantine, None.
+        """
+        path = self.path(key)
+        if not os.path.exists(path):
+            return None
+        t0 = time.perf_counter()
+        mpath = self._manifest_path(key)
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            # a blob with no (readable) manifest is unverifiable: the
+            # kill-between-renames shape, or a torn manifest write
+            self._incident(
+                f"cache entry {key} ({label or 'unlabeled'}) has no "
+                f"verifiable manifest ({type(e).__name__}: {e}); "
+                f"recompiling instead of trusting unverified bytes")
+            self._quarantine(key)
+            return None
+        if manifest.get("env") != self._env_fp():
+            # stale cache from another jax/backend: a legitimate miss
+            logger.info("AOT cache: %s compiled under %r, this process "
+                        "is %r — recompiling", key, manifest.get("env"),
+                        self._env_fp())
+            return None
+        try:
+            size = os.path.getsize(path)
+            if manifest.get("size") != size:
+                raise ValueError(
+                    f"size mismatch: manifest says {manifest.get('size')} "
+                    f"bytes, file has {size} — torn or truncated write")
+            with open(path, "rb") as f:
+                data = f.read()
+            digest = hashlib.sha256(data).hexdigest()
+            if digest != manifest.get("sha256"):
+                raise ValueError("sha256 mismatch — content corrupted "
+                                 "at rest")
+            # bytes proven to be the bytes we wrote; now they may be
+            # unpickled/deserialized
+            from jax.experimental import serialize_executable as se
+
+            blob, in_tree, out_tree = pickle.loads(data)
+            compiled = se.deserialize_and_load(blob, in_tree, out_tree)
+        except Exception as e:  # noqa: BLE001 — any failure in the
+            # verify/deserialize chain means the entry cannot be
+            # trusted; the typed fallback (recompile) is the contract
+            self._incident(
+                f"cache entry {key} ({label or 'unlabeled'}) failed "
+                f"verification ({type(e).__name__}: {e}); falling back "
+                f"to recompile")
+            self._quarantine(key)
+            return None
+        self.stats["hits"] += 1
+        self.stats["load_s"] += time.perf_counter() - t0
+        return compiled
+
+    def store(self, key: str, compiled, label: str = "") -> bool:
+        """Serialize ``compiled`` under ``key`` (atomic, manifest
+        second).  Returns False (and logs) when the executable does not
+        serialize on this backend — callers keep the in-memory copy
+        either way."""
+        from raft_tpu.training.state import _atomic_write_bytes
+
+        try:
+            from jax.experimental import serialize_executable as se
+
+            blob, in_tree, out_tree = se.serialize(compiled)
+            data = pickle.dumps((blob, in_tree, out_tree))
+        except Exception as e:  # noqa: BLE001 — serialization support
+            # is backend-dependent; an unserializable executable only
+            # costs the warm start, never the request
+            logger.warning("AOT cache: executable %s (%s) does not "
+                           "serialize here (%s: %s); serving from the "
+                           "in-memory copy only", key, label,
+                           type(e).__name__, e)
+            return False
+        manifest = {
+            "v": AOT_MANIFEST_VERSION,
+            "label": label,
+            "size": len(data),
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "env": self._env_fp(),
+            "created": time.time(),
+        }
+        try:
+            _atomic_write_bytes(self.path(key), data)
+            _atomic_write_bytes(
+                self._manifest_path(key),
+                json.dumps(manifest, sort_keys=True).encode("utf-8"))
+        except OSError as e:
+            # full disk / read-only cache dir: the compiled executable
+            # is in hand — cache problems cost the warm start, never
+            # the request (a partial blob left behind is unverifiable
+            # and will be rejected+quarantined at the next load)
+            logger.warning("AOT cache: could not persist %s (%s): "
+                           "%s: %s; serving from the in-memory copy",
+                           key, label, type(e).__name__, e)
+            return False
+        return True
+
+    def get_or_compile(self, key: str, build: Callable[[], object],
+                       label: str = "") -> Tuple[object, bool]:
+        """The executable for ``key``: loaded warm from disk when a
+        verified entry exists, else built via ``build()`` (the XLA
+        compile) and stored.  Returns ``(compiled, warm)``."""
+        compiled = self.load(key, label=label)
+        if compiled is not None:
+            logger.info("AOT cache: warm hit for %s (%s)", key, label)
+            return compiled, True
+        self.stats["misses"] += 1
+        t0 = time.perf_counter()
+        compiled = build()
+        self.stats["compile_s"] += time.perf_counter() - t0
+        self.store(key, compiled, label=label)
+        return compiled, False

@@ -1,0 +1,252 @@
+"""Admission-controlled request queue + deadline-aware dynamic batcher.
+
+The request path's resilience contract (the PR 6/7
+recover-or-typed-incident rule, extended to traffic):
+
+- **No silent drops.**  Every submitted request reaches exactly one
+  terminal outcome: a result, or a TYPED rejection
+  (:class:`QueueFullError`, :class:`DeadlineExceededError`,
+  :class:`BadRequestError`) that also lands in the run ledger as an
+  incident.  The server's counters prove the conservation law
+  (``submitted == served + rejected``) and the chaos overload scenario
+  asserts it.
+- **Admission control.**  The queue is bounded; a full queue sheds the
+  NEW request typed (``queue-full``) instead of growing without bound
+  (latency collapse) or silently replacing queued work.  Mis-shaped
+  requests (wrong rank/channels, mismatched pair, no bucket family
+  holds them) are rejected typed at submit (``bad-request``) — they
+  could never be served, so they must not occupy queue capacity.
+- **Deadlines.**  A request may carry one; the batcher re-checks it at
+  assembly time and rejects already-expired requests typed
+  (``deadline-exceeded``) BEFORE dispatch — device time is the scarce
+  resource, and spending it computing an answer nobody is waiting for
+  is the storm failure mode.
+- **Poison isolation.**  Non-finite input pixels are detected per slot
+  at batch assembly (off the caller thread — the full-image scan
+  overlaps the batch window).  A poisoned request is rejected typed
+  (``bad-request``) and its slot stays ZERO — bit-identical to the
+  empty-slot padding a smaller batch would have had, so its neighbors'
+  outputs are provably identical to a batch the poisoned request never
+  joined (tests/test_serve.py pins this bit-exactly).
+
+Batching is shape-bucketed: per-family FIFO lanes (engine.py's static
+pad families), one batch per dispatch drawn from the family whose HEAD
+request is oldest — global FIFO fairness without mixing shapes into
+one executable.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class RequestError(RuntimeError):
+    """Typed rejection; ``kind`` is the ledger incident type."""
+
+    kind = "bad-request"
+
+
+class QueueFullError(RequestError):
+    kind = "queue-full"
+
+
+class DeadlineExceededError(RequestError):
+    kind = "deadline-exceeded"
+
+
+class BadRequestError(RequestError):
+    kind = "bad-request"
+
+
+@dataclass
+class Request:
+    """One admitted inference request."""
+
+    rid: int
+    image1: np.ndarray
+    image2: np.ndarray
+    family: str
+    hw: Tuple[int, int]                  # original (h, w) for unpad
+    t_submit: float
+    deadline: Optional[float] = None     # absolute monotonic seconds
+    stream: Optional[str] = None         # video stream id (warm start)
+    future: Future = field(default_factory=Future)
+
+
+def validate_shape(image1: np.ndarray, image2: np.ndarray,
+                   buckets: Dict[str, Tuple[int, int]]) -> str:
+    """Admission-time shape validation; returns the bucket family.
+    Raises :class:`BadRequestError` (typed) for anything unservable."""
+    for name, img in (("image1", image1), ("image2", image2)):
+        if not isinstance(img, np.ndarray):
+            raise BadRequestError(f"{name} is {type(img).__name__}, "
+                                  f"not an ndarray")
+        if img.ndim != 3 or img.shape[-1] != 3:
+            raise BadRequestError(
+                f"{name} has shape {getattr(img, 'shape', None)}; "
+                f"expected (H, W, 3)")
+        if img.dtype not in (np.float32, np.uint8):
+            raise BadRequestError(
+                f"{name} dtype {img.dtype} is not float32/uint8")
+    if image1.shape != image2.shape:
+        raise BadRequestError(
+            f"pair shapes disagree: {image1.shape} vs {image2.shape}")
+    from raft_tpu.serve.engine import bucket_for
+
+    h, w = image1.shape[:2]
+    family = bucket_for(h, w, buckets)
+    if family is None:
+        raise BadRequestError(
+            f"no bucket family holds a {h}x{w} frame (largest: "
+            f"{max(buckets.values(), key=lambda s: s[0] * s[1])})")
+    return family
+
+
+def slot_is_finite(req: Request) -> bool:
+    """Assembly-time poison check (uint8 cannot be non-finite)."""
+    for img in (req.image1, req.image2):
+        if img.dtype == np.float32 and not np.isfinite(img).all():
+            return False
+    return True
+
+
+class RequestQueue:
+    """Bounded, family-laned FIFO with typed admission control.
+
+    Capacity is GLOBAL (a pile-up in one family must still shed load —
+    the device is one resource); ordering is per-family FIFO with the
+    oldest head winning batch selection.
+    """
+
+    def __init__(self, capacity: int,
+                 buckets: Dict[str, Tuple[int, int]]):
+        if capacity < 1:
+            raise ValueError(f"queue capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.buckets = dict(buckets)
+        self._lanes: Dict[str, collections.deque] = {}
+        self._size = 0
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._ids = itertools.count()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._size
+
+    @property
+    def depth_fraction(self) -> float:
+        """Queue pressure in [0, 1] — the degradation controller's
+        primary signal."""
+        with self._lock:
+            return self._size / self.capacity
+
+    def submit(self, image1: np.ndarray, image2: np.ndarray,
+               deadline: Optional[float] = None,
+               stream: Optional[str] = None,
+               clock=time.monotonic) -> Request:
+        """Admit a request or raise a typed :class:`RequestError`.
+
+        Shape/bucket validation happens HERE (unservable work must not
+        occupy capacity); the finiteness scan happens at assembly, off
+        the caller thread.
+        """
+        family = validate_shape(image1, image2, self.buckets)
+        req = Request(rid=next(self._ids), image1=image1, image2=image2,
+                      family=family, hw=tuple(image1.shape[:2]),
+                      t_submit=clock(), deadline=deadline, stream=stream)
+        with self._lock:
+            if self._closed:
+                raise BadRequestError("server is shutting down")
+            if self._size >= self.capacity:
+                raise QueueFullError(
+                    f"queue at capacity ({self.capacity}); shedding "
+                    f"request {req.rid} typed instead of queueing "
+                    f"unbounded")
+            self._lanes.setdefault(family, collections.deque()).append(req)
+            self._size += 1
+            self._nonempty.notify()
+        return req
+
+    def pop_batch(self, max_batch: int,
+                  timeout: Optional[float] = None) -> List[Request]:
+        """Up to ``max_batch`` requests from the family whose head is
+        oldest; blocks up to ``timeout`` for work.  Empty list on
+        timeout or close."""
+        with self._lock:
+            if not self._size:
+                self._nonempty.wait(timeout)
+            if not self._size:
+                return []
+            family = min(
+                (f for f, lane in self._lanes.items() if lane),
+                key=lambda f: self._lanes[f][0].t_submit)
+            lane = self._lanes[family]
+            out = []
+            while lane and len(out) < max_batch:
+                out.append(lane.popleft())
+            self._size -= len(out)
+            return out
+
+    def drain(self) -> List[Request]:
+        """Close the queue and return everything still queued (the
+        server rejects them typed at shutdown — no silent drops)."""
+        with self._lock:
+            self._closed = True
+            out = [r for lane in self._lanes.values() for r in lane]
+            self._lanes.clear()
+            self._size = 0
+            self._nonempty.notify_all()
+            return out
+
+
+def assemble_batch(reqs: List[Request], hw: Tuple[int, int],
+                   batch_size: int, clock=time.monotonic):
+    """Build the padded device batch from admitted requests.
+
+    Per-slot gauntlet, in order: deadline (already expired -> typed
+    ``deadline-exceeded``, pre-dispatch), poison (non-finite pixels ->
+    typed ``bad-request``).  Rejected/empty slots stay zero — the
+    bit-identical-neighbors guarantee.
+
+    Returns ``(img1, img2, kept, rejected)``: device-ready float32
+    arrays of shape (batch_size, H, W, 3), the per-slot kept requests
+    (index-aligned; None for empty/rejected slots), and
+    ``(request, RequestError)`` pairs for the typed rejections.
+    """
+    H, W = hw
+    img1 = np.zeros((batch_size, H, W, 3), np.float32)
+    img2 = np.zeros((batch_size, H, W, 3), np.float32)
+    kept: List[Optional[Request]] = [None] * batch_size
+    rejected: List[Tuple[Request, RequestError]] = []
+    now = clock()
+    slot = 0
+    for req in reqs:
+        if req.deadline is not None and now > req.deadline:
+            rejected.append((req, DeadlineExceededError(
+                f"request {req.rid} expired {now - req.deadline:.3f}s "
+                f"before dispatch (deadline-aware shed: device time is "
+                f"not spent on an answer nobody is waiting for)")))
+            continue
+        if not slot_is_finite(req):
+            rejected.append((req, BadRequestError(
+                f"request {req.rid} carries non-finite input pixels; "
+                f"rejected per-slot — its batch slot stays zero, so "
+                f"neighbors' outputs are unaffected")))
+            continue
+        from raft_tpu.serve.engine import pad_to_bucket
+
+        img1[slot] = pad_to_bucket(req.image1.astype(np.float32), hw)
+        img2[slot] = pad_to_bucket(req.image2.astype(np.float32), hw)
+        kept[slot] = req
+        slot += 1
+    return img1, img2, kept, rejected

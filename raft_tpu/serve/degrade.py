@@ -1,0 +1,182 @@
+"""Graceful degradation: the adaptive refinement-iteration controller.
+
+RAFT's accuracy-vs-iterations curve is FLAT past ~8-12 refinement
+iterations once training converges (the round-5 depth-stability runs:
+12/24/32-iter EPE within noise of each other on the synthetic stage;
+the paper's own video mode runs warm frames at reduced iterations).
+That flatness is serving headroom: under queue pressure the server can
+shed LATENCY instead of shedding REQUESTS, by stepping the iteration
+count down a fixed ladder (32 -> 24 -> 16 -> 8 by default) and back up
+when pressure clears.  Warm-started video frames (``flow_init``
+chaining) sit even further inside the flat region — the controller
+exposes a separate, lower floor for fully-warm batches.
+
+Every level transition is a typed ledger incident (``serve-degraded``
+on the way down, ``serve-restored`` on return to full quality), so the
+active degradation level is an incident SPAN in the run ledger: the
+report shows exactly when quality was traded and for how long, and a
+chaos run can gate on "the controller engaged and the run recovered".
+
+The controller is deliberately host-side and deterministic: one
+decision per dispatched batch, hysteresis via distinct high/low
+watermarks plus a cooldown (in decisions) between steps, so a noisy
+queue cannot make it thrash.  Signals: queue pressure (depth fraction)
+and, when an SLO is configured, the rolling p95 latency.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+DEFAULT_ITER_LEVELS = (32, 24, 16, 8)
+
+
+class IterationController:
+    """Steps refinement iterations down under pressure, up when clear.
+
+    ``levels`` is the iteration ladder, full quality first, strictly
+    decreasing.  ``observe`` is called once per dispatched batch with
+    the current queue fraction (and rolling p95 latency when known) and
+    returns the iteration count the NEXT batch should run.
+    """
+
+    def __init__(self, levels: Sequence[int] = DEFAULT_ITER_LEVELS,
+                 queue_high: float = 0.75, queue_low: float = 0.25,
+                 slo_ms: Optional[float] = None,
+                 cooldown: int = 2,
+                 record: Optional[Callable[[str, str], None]] = None,
+                 clock=time.monotonic):
+        levels = tuple(int(x) for x in levels)
+        if not levels or any(b >= a for a, b in zip(levels, levels[1:])):
+            raise ValueError(f"levels must be non-empty and strictly "
+                             f"decreasing, got {levels}")
+        if not 0.0 <= queue_low < queue_high <= 1.0:
+            raise ValueError(f"need 0 <= queue_low < queue_high <= 1, "
+                             f"got {queue_low}/{queue_high}")
+        self.levels = levels
+        self.queue_high = queue_high
+        self.queue_low = queue_low
+        self.slo_ms = slo_ms
+        self.cooldown = int(cooldown)
+        self._record = record
+        self._clock = clock
+        self.level = 0
+        self.max_level_seen = 0
+        self.transitions: List[Dict] = []
+        self._since_change = self.cooldown  # free to act immediately
+
+    @property
+    def iters(self) -> int:
+        return self.levels[self.level]
+
+    def _change(self, new_level: int, why: str) -> None:
+        old = self.level
+        self.level = new_level
+        self.max_level_seen = max(self.max_level_seen, new_level)
+        self._since_change = 0
+        self.transitions.append({
+            "t": self._clock(), "from": old, "to": new_level,
+            "iters": self.levels[new_level], "why": why,
+        })
+        if self._record is None:
+            return
+        if new_level > old:
+            self._record(
+                "serve-degraded",
+                f"degradation level {old} -> {new_level}: refinement "
+                f"iterations {self.levels[old]} -> "
+                f"{self.levels[new_level]} ({why}); accuracy held by the "
+                f"flat iteration curve, latency shed instead of requests")
+        else:
+            self._record(
+                "serve-restored",
+                f"degradation level {old} -> {new_level}: refinement "
+                f"iterations restored to {self.levels[new_level]} ({why})")
+
+    def observe(self, queue_frac: float,
+                p95_ms: Optional[float] = None) -> int:
+        """One decision; returns the iteration count for the next batch."""
+        self._since_change += 1
+        if self._since_change <= self.cooldown:
+            return self.iters
+        over_slo = (self.slo_ms is not None and p95_ms is not None
+                    and p95_ms > self.slo_ms)
+        under_slo = (self.slo_ms is None or p95_ms is None
+                     or p95_ms <= 0.8 * self.slo_ms)
+        if (queue_frac >= self.queue_high or over_slo) \
+                and self.level + 1 < len(self.levels):
+            why = (f"queue at {queue_frac:.0%}" if queue_frac
+                   >= self.queue_high
+                   else f"p95 {p95_ms:.0f}ms > SLO {self.slo_ms:.0f}ms")
+            self._change(self.level + 1, why)
+        elif queue_frac <= self.queue_low and under_slo and self.level > 0:
+            self._change(self.level - 1,
+                         f"queue drained to {queue_frac:.0%}")
+        return self.iters
+
+    def summary(self) -> Dict:
+        """Counters for the ledger's run_end serving summary."""
+        return {
+            "levels": list(self.levels),
+            "final_level": self.level,
+            "max_level": self.max_level_seen,
+            "transitions": len(self.transitions),
+        }
+
+
+class LatencyTracker:
+    """Bounded reservoir of per-request latencies with rolling
+    percentiles — the controller's p95 signal and the report's SLO
+    numbers, without holding a million floats at millions-of-users
+    scale.
+
+    The summary reservoir is true reservoir sampling (Vitter's R:
+    past the cap, sample i replaces a uniformly-random slot with
+    probability cap/i) so the run-end percentiles weight the WHOLE
+    run — a fill-once buffer would report only the earliest traffic
+    and let a late SLO collapse gate green."""
+
+    def __init__(self, window: int = 512, reservoir: int = 65536,
+                 seed: int = 0):
+        import collections
+
+        import numpy as np
+
+        self.window = collections.deque(maxlen=window)
+        self._reservoir_cap = reservoir
+        self._rng = np.random.default_rng(seed)
+        self.samples: List[float] = []
+        self.count = 0
+
+    def add(self, latency_s: float) -> None:
+        self.count += 1
+        self.window.append(latency_s)
+        if len(self.samples) < self._reservoir_cap:
+            self.samples.append(latency_s)
+        else:
+            j = int(self._rng.integers(0, self.count))
+            if j < self._reservoir_cap:
+                self.samples[j] = latency_s
+
+    def rolling_p95_ms(self) -> Optional[float]:
+        if not self.window:
+            return None
+        import numpy as np
+
+        return 1000.0 * float(np.percentile(list(self.window), 95))
+
+    def percentiles_ms(self) -> Dict[str, float]:
+        import numpy as np
+
+        if not self.samples:
+            nan = float("nan")
+            return {"latency_p50_ms": nan, "latency_p95_ms": nan,
+                    "latency_max_ms": nan}
+        arr = np.asarray(self.samples)
+        return {
+            "latency_p50_ms": round(1000.0 * float(np.percentile(arr, 50)), 3),
+            "latency_p95_ms": round(1000.0 * float(np.percentile(arr, 95)), 3),
+            "latency_max_ms": round(1000.0 * float(arr.max()), 3),
+        }

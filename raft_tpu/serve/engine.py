@@ -1,0 +1,309 @@
+"""The serving executor: bucketed, AOT-compiled bf16 inference graphs.
+
+Request images arrive at arbitrary sizes; XLA executables want static
+shapes.  The resolution is the same one the device-aug wire uses
+(data/device_aug.py pads raw frames to per-family static shapes): a
+fixed table of **bucket families** derived from ``DEVICE_AUG_PAD``
+(rounded up to /8 for the encoder stride), each compiled ONCE per
+(batch capacity, iteration count, warm/cold) at a static shape.  A
+request maps to the smallest family that holds it, is edge-padded to
+the family shape (replicate padding — the ``InputPadder`` convention,
+anchored top-left so unpadding is a crop), and rides a fixed-capacity
+batch whose empty slots are zero-filled.  Empty-slot outputs are
+discarded; a zero slot is also exactly what a rejected (poisoned)
+request's slot becomes, which is what makes per-slot isolation
+bit-exact (see batcher.py).
+
+Executables are built through :class:`~raft_tpu.serve.aot.AOTCache`
+when one is attached: ``jax.jit(...).lower(...).compile()`` at startup,
+serialized to disk, verified-on-load at the next startup — the
+warm-restart path.  The model runs the bf16 inference policy
+(``compute_dtype=corr_dtype=bfloat16``) by default: serving has no
+optimizer to protect and flow leaves the graph f32 either way (the
+declared boundary the graftlint engines pin).
+
+``abstract_serve_forward`` is the lowerable entry point the four
+static-analysis engines audit — exactly the graph ``ServeEngine``
+compiles, built without weights or an engine instance.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# Bucket families: name -> static (H, W), /8-divisible (the encoder
+# downsamples by 8; InputPadder's rule).  Derived from the device-aug
+# wire's per-family raw pads (datasets.DEVICE_AUG_PAD), rounded UP to
+# /8 so every release frame of the family fits; "tiny" serves the
+# CPU-smoke/test sizes.  Order does not matter — requests map to the
+# smallest-area family that holds them.
+def _round8(x: int) -> int:
+    return ((x + 7) // 8) * 8
+
+
+def default_buckets() -> Dict[str, Tuple[int, int]]:
+    from raft_tpu.data.datasets import DEVICE_AUG_PAD
+
+    buckets = {"tiny": (64, 64)}
+    for family, (h, w) in DEVICE_AUG_PAD.items():
+        buckets[family.lower()] = (_round8(h), _round8(w))
+    return buckets
+
+
+def bucket_for(h: int, w: int,
+               buckets: Dict[str, Tuple[int, int]]) -> Optional[str]:
+    """The smallest-area family holding an (h, w) image, or None."""
+    best, best_area = None, None
+    for name, (bh, bw) in buckets.items():
+        if h <= bh and w <= bw:
+            area = bh * bw
+            if best_area is None or area < best_area:
+                best, best_area = name, area
+    return best
+
+
+def pad_to_bucket(img: np.ndarray, hw: Tuple[int, int]) -> np.ndarray:
+    """Edge-pad an (H, W, C) image to the family shape, anchored
+    top-left (unpad = crop ``[:h, :w]``)."""
+    H, W = hw
+    h, w = img.shape[:2]
+    if (h, w) == (H, W):
+        return img
+    return np.pad(img, ((0, H - h), (0, W - w), (0, 0)), mode="edge")
+
+
+def serve_config(small: bool = False, overrides: Optional[Dict] = None):
+    """The serving model config: bf16 inference policy over the
+    standard architecture (overridable for tests/benches)."""
+    from raft_tpu.config import RAFTConfig
+
+    kw = {"small": small, "compute_dtype": "bfloat16",
+          "corr_dtype": "bfloat16"}
+    kw.update(overrides or {})
+    return RAFTConfig(**kw)
+
+
+def abstract_serve_forward(iters: int = 2, hw: Tuple[int, int] = (64, 64),
+                           batch: int = 2, warm: bool = False,
+                           overrides: Optional[Dict] = None):
+    """The serving executor's jitted batched bf16 test_mode forward over
+    abstract inputs: the lowerable entry point the static-analysis
+    engines audit (exactly the graph :meth:`ServeEngine.executable`
+    compiles, built without weights).
+
+    ``warm=True`` is the video variant with the ``flow_init`` warm-start
+    argument (B, H/8, W/8, 2).  Returns ``(fwd, args_sds)`` with ``fwd``
+    supporting ``.lower(*args_sds)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.models import RAFT
+
+    model = RAFT(serve_config(overrides=dict(overrides or {})))
+    H, W = hw
+    img_sds = jax.ShapeDtypeStruct((batch, H, W, 3), jnp.float32)
+    variables_sds = jax.eval_shape(
+        lambda rng, a, b: model.init(rng, a, b, iters=iters, train=True),
+        jax.random.PRNGKey(0), img_sds, img_sds)
+    fwd = make_test_forward(model, iters, warm=warm)
+    if warm:
+        flow_sds = jax.ShapeDtypeStruct((batch, H // 8, W // 8, 2),
+                                        jnp.float32)
+        return fwd, (variables_sds, img_sds, img_sds, flow_sds)
+    return fwd, (variables_sds, img_sds, img_sds)
+
+
+def arg_signature(*args) -> tuple:
+    """((shape, dtype-str), ...) over the non-weight inputs — the
+    executable-signature half of an AOT cache key, and the memo-key
+    form compiled (signature-exact) executables demand."""
+    import numpy as np
+
+    return tuple((tuple(np.shape(a)),
+                  str(getattr(a, "dtype", np.asarray(a).dtype)))
+                 for a in args)
+
+
+def forward_cache_key(tag: str, model, var_sig: str, arg_sig,
+                      iters: int, warm: bool) -> str:
+    """The AOT-cache key recipe for a :func:`compile_test_forward`
+    executable — defined NEXT to the build so the two can never drift
+    (a key missing a field that affects the lowered graph would serve
+    a stale executable).  ``arg_sig`` is :func:`arg_signature` over
+    EVERY non-weight input (both images, plus flow_init when warm);
+    ``tag`` namespaces the consumer."""
+    from raft_tpu.serve.aot import cache_key
+    from raft_tpu.training.state import config_fingerprint
+
+    return cache_key(tag, config_fingerprint(model.cfg), var_sig,
+                     tuple(arg_sig), int(iters), bool(warm))
+
+
+def make_test_forward(model, iters: int, warm: bool):
+    """THE jitted test_mode forward (cold, or the ``flow_init``
+    warm-start variant) — single definition shared by the serving
+    executors, the Evaluator (both its jit and AOT paths), and
+    :func:`abstract_serve_forward`, so the graph the graftlint engines
+    audit is the graph production compiles and serves."""
+    import jax
+
+    if warm:
+        return jax.jit(lambda v, a, b, f: model.apply(
+            v, a, b, iters=iters, flow_init=f, test_mode=True))
+    return jax.jit(lambda v, a, b: model.apply(
+        v, a, b, iters=iters, test_mode=True))
+
+
+def compile_test_forward(model, variables, img1_sds, img2_sds,
+                         iters: int, flow_sds=None):
+    """lower -> compile :func:`make_test_forward` — THE build recipe
+    behind every AOT-cached executable.  ``flow_sds`` selects the
+    ``flow_init`` warm-start variant."""
+    fn = make_test_forward(model, iters, warm=flow_sds is not None)
+    if flow_sds is not None:
+        return fn.lower(variables, img1_sds, img2_sds,
+                        flow_sds).compile()
+    return fn.lower(variables, img1_sds, img2_sds).compile()
+
+
+def _tree_signature(variables) -> str:
+    """Shape/dtype signature of the weight tree — executables take the
+    weights as an ARGUMENT, so the cache key needs the tree's structure
+    and leaf types, never its values (a new checkpoint of the same
+    architecture warm-hits)."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(variables)[0]
+    return ";".join(
+        f"{jax.tree_util.keystr(path)}:{getattr(v, 'shape', ())}:"
+        f"{getattr(v, 'dtype', type(v).__name__)}"
+        for path, v in leaves)
+
+
+class ServeEngine:
+    """Compiles and runs the bucketed serving forwards.
+
+    One executable per (family shape, iteration count, warm) — the
+    degradation controller's iteration levels each get their own, all
+    warmed at startup so a load-shed decision never pays a compile.
+    With an :class:`AOTCache` attached, startup loads verified
+    executables from disk (warm restart) and stores fresh compiles.
+    """
+
+    def __init__(self, model, variables, batch_size: int = 4,
+                 aot_cache=None, spans=None):
+        import threading
+
+        from raft_tpu.obs.spans import NULL
+
+        self.model = model
+        self.variables = variables
+        self.batch_size = int(batch_size)
+        self.aot = aot_cache
+        self.spans = spans if spans is not None else NULL
+        self._fns: Dict[tuple, object] = {}
+        # the caller-thread warmup and the batcher thread can race the
+        # same memo miss; serializing the compile path avoids paying
+        # one multi-second XLA compile twice (and two racing cache
+        # stores for one key)
+        self._compile_lock = threading.Lock()
+        self._var_sig = None
+
+    def _cache_key(self, hw: Tuple[int, int], iters: int,
+                   warm: bool) -> str:
+        if self._var_sig is None:
+            self._var_sig = _tree_signature(self.variables)
+        H, W = hw
+        img = ((self.batch_size, H, W, 3), "float32")
+        sig = (img, img) + ((((self.batch_size, H // 8, W // 8, 2),
+                              "float32"),) if warm else ())
+        return forward_cache_key("serve_forward", self.model,
+                                 self._var_sig, sig, iters, warm)
+
+    def _build(self, hw: Tuple[int, int], iters: int, warm: bool):
+        import jax
+        import jax.numpy as jnp
+
+        H, W = hw
+        B = self.batch_size
+        img_sds = jax.ShapeDtypeStruct((B, H, W, 3), jnp.float32)
+        flow_sds = (jax.ShapeDtypeStruct((B, H // 8, W // 8, 2),
+                                         jnp.float32) if warm else None)
+        return compile_test_forward(self.model, self.variables, img_sds,
+                                    img_sds, iters, flow_sds=flow_sds)
+
+    def is_compiled(self, hw: Tuple[int, int], iters: int,
+                    warm: bool = False) -> bool:
+        """Is this executable already in the in-process memo? (The
+        server widens its watchdog bracket when a dispatch will pay a
+        lazy compile/cache-load first.)"""
+        return (tuple(hw), int(iters), bool(warm)) in self._fns
+
+    def executable(self, hw: Tuple[int, int], iters: int,
+                   warm: bool = False):
+        """The compiled forward for (family shape, iters, warm) —
+        memoized in-process, AOT-cached on disk when configured."""
+        mkey = (tuple(hw), int(iters), bool(warm))
+        fn = self._fns.get(mkey)
+        if fn is not None:
+            return fn
+        with self._compile_lock:
+            fn = self._fns.get(mkey)     # a racing thread compiled it
+            if fn is not None:
+                return fn
+            label = (f"serve_forward B={self.batch_size} hw={hw} "
+                     f"iters={iters} warm={warm}")
+            if self.aot is not None:
+                fn, was_warm = self.aot.get_or_compile(
+                    self._cache_key(hw, iters, warm),
+                    lambda: self._build(hw, iters, warm), label=label)
+                logger.info("serve: %s (%s)", label,
+                            "warm cache load" if was_warm
+                            else "cold compile")
+            else:
+                t0 = time.perf_counter()
+                fn = self._build(hw, iters, warm)
+                logger.info("serve: %s cold compile (%.2fs, no AOT "
+                            "cache)", label, time.perf_counter() - t0)
+            self._fns[mkey] = fn
+            return fn
+
+    def warmup(self, families: Dict[str, Tuple[int, int]],
+               iters_levels, warm_too: bool = True) -> float:
+        """Compile/load every (family, level[, warm]) executable; the
+        startup cost (the number the warm-restart gate measures).
+        Returns wall seconds."""
+        t0 = time.perf_counter()
+        for hw in families.values():
+            for iters in iters_levels:
+                self.executable(hw, iters, warm=False)
+                if warm_too:
+                    self.executable(hw, iters, warm=True)
+        return time.perf_counter() - t0
+
+    def forward(self, hw: Tuple[int, int], iters: int,
+                img1: np.ndarray, img2: np.ndarray,
+                flow_init: Optional[np.ndarray] = None):
+        """Run one padded batch; returns host (flow_low, flow_up).
+
+        The host conversion is the dispatch-completion barrier — the
+        caller's dispatch span measures real execution, and the
+        watchdog's progress notification happens after work provably
+        finished.
+        """
+        warm = flow_init is not None
+        fn = self.executable(hw, iters, warm=warm)
+        with self.spans.span("dispatch"):
+            if warm:
+                flow_low, flow_up = fn(self.variables, img1, img2,
+                                       flow_init)
+            else:
+                flow_low, flow_up = fn(self.variables, img1, img2)
+            return np.asarray(flow_low), np.asarray(flow_up)

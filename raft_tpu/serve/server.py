@@ -1,0 +1,445 @@
+"""FlowServer: the fault-tolerant serving composition.
+
+queue -> batcher -> AOT executor -> degradation controller, with the
+dispatch watchdog underneath and the obs ledger throughout:
+
+- :meth:`submit` is the admission edge (typed ``queue-full`` /
+  ``bad-request`` rejections raise to the caller AND land in the
+  ledger);
+- one daemon batcher thread assembles deadline-checked, poison-masked,
+  family-padded batches (batcher.py) and dispatches them through the
+  AOT-compiled bucket executables (engine.py);
+- the iteration controller (degrade.py) picks each batch's refinement
+  depth from queue pressure and rolling p95 latency; video streams
+  chain ``flow_init`` warm starts per stream id;
+- the dispatch watchdog (watchdog.py) converts a wedged compile or
+  dispatch into a typed ``serve-stalled`` incident and a nonzero exit;
+- ``health()``/``ready()`` are the probe surfaces, and ``close()``
+  writes the serving summary (request conservation counters, latency
+  percentiles vs SLO, degradation history, AOT cache stats) into the
+  ledger's ``run_end`` record — the numbers ``obs report``'s serving
+  section and its ``--fail-on-slo`` gate consume.
+
+Request conservation (NO silent drops) is a structural invariant:
+``submitted == served + rejected + in-flight`` at every instant, and
+the summary asserts the terminal form of it at close.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from raft_tpu.serve.batcher import (BadRequestError, RequestError,
+                                    RequestQueue, assemble_batch)
+from raft_tpu.serve.degrade import (DEFAULT_ITER_LEVELS, IterationController,
+                                    LatencyTracker)
+from raft_tpu.serve.watchdog import DispatchWatchdog
+
+logger = logging.getLogger(__name__)
+
+# Ledger bloat guard: a deadline storm is ONE event operationally, not
+# ten thousand; per incident kind the first firing is always recorded
+# and afterwards every INCIDENT_SAMPLE-th, with the counters carrying
+# the exact totals (the conservation law never depends on the ledger).
+INCIDENT_SAMPLE = 100
+
+
+class FlowServer:
+    """Admission-controlled, deadline-aware batched flow inference."""
+
+    def __init__(self, engine, buckets: Optional[Dict] = None,
+                 queue_capacity: int = 64,
+                 iter_levels=DEFAULT_ITER_LEVELS,
+                 slo_ms: Optional[float] = None,
+                 degrade: bool = True,
+                 warm_iters: Optional[int] = None,
+                 ledger=None,
+                 watchdog_timeout_s: Optional[float] = None,
+                 flush_every: int = 8,
+                 max_streams: int = 256,
+                 clock=time.monotonic,
+                 exit_fn=None):
+        from raft_tpu.obs.spans import NULL, SpanRecorder
+        from raft_tpu.serve.engine import default_buckets
+
+        self.engine = engine
+        self.buckets = dict(buckets or default_buckets())
+        self.queue = RequestQueue(queue_capacity, self.buckets)
+        self.slo_ms = slo_ms
+        self.warm_iters = warm_iters
+        self.ledger = ledger
+        self._clock = clock
+        self._flush_every = int(flush_every)
+        self.spans = (SpanRecorder(ledger=ledger, annotate=False)
+                      if ledger is not None else NULL)
+        if getattr(engine, "spans", None) is NULL or \
+                getattr(engine, "spans", None) is None:
+            engine.spans = self.spans
+
+        self.controller = IterationController(
+            levels=iter_levels if degrade else iter_levels[:1],
+            slo_ms=slo_ms,
+            record=lambda kind, detail: self._incident(kind, detail,
+                                                       sample=False))
+        self.latency = LatencyTracker()
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "served": 0, "rejected_queue_full": 0,
+            "rejected_deadline": 0, "rejected_bad_request": 0,
+            "rejected_shutdown": 0, "batches": 0,
+        }
+        self._incident_counts: Dict[str, int] = {}
+        # stream -> last flow_low, LRU-bounded: stream ids are
+        # client-chosen and unbounded in a long-lived server; an
+        # evicted stream simply cold-starts its next frame
+        import collections
+        self._streams: "collections.OrderedDict[str, np.ndarray]" = \
+            collections.OrderedDict()
+        self._max_streams = int(max_streams)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._warm = False
+        self._batch_no = 0
+        self.watchdog: Optional[DispatchWatchdog] = None
+        if watchdog_timeout_s is not None:
+            kw = {} if exit_fn is None else {"exit_fn": exit_fn}
+            self.watchdog = DispatchWatchdog(
+                watchdog_timeout_s,
+                on_incident=lambda kind, detail: self._incident(
+                    kind, detail, sample=False),
+                on_trip=lambda kind: self._flush_ledger(), **kw)
+            self.watchdog.start()
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        daemon=True, name="serve-batcher")
+        self._thread.start()
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _incident(self, kind: str, detail: str, sample: bool = True) -> None:
+        n = self._incident_counts.get(kind, 0) + 1
+        self._incident_counts[kind] = n
+        if self.ledger is None:
+            return
+        if sample and n > 1 and (n % INCIDENT_SAMPLE) != 0:
+            return
+        if sample and n > 1:
+            detail = f"[{n} total so far, 1-in-{INCIDENT_SAMPLE} " \
+                     f"sampled] {detail}"
+        try:
+            self.ledger.incident(kind, step=self._batch_no, detail=detail)
+        except (ValueError, OSError):
+            # closed ledger (a submit racing shutdown) or failed disk
+            # (ENOSPC): the typed rejection/counters are the contract —
+            # telemetry I/O must never replace them with its own error
+            # or kill the batcher thread
+            logger.warning("serve: incident %s not ledgered (closed "
+                           "or unwritable ledger); counters carry it",
+                           kind)
+
+    def _flush_ledger(self) -> None:
+        if self.ledger is not None:
+            try:
+                self.spans.flush(self._batch_no)
+            except Exception:  # flushing from a trip path: best-effort
+                logger.warning("serve: span flush on trip failed")
+
+    # -- admission edge ------------------------------------------------------
+
+    def warmup(self, families: Optional[Dict] = None,
+               warm_too: bool = True) -> float:
+        """Compile/load every bucket executable at every iteration
+        level; the startup cost.  Bracketed by the watchdog — a wedged
+        COMPILE is a serve-stall too."""
+        fams = dict(families) if families else self.buckets
+        token = None
+        if self.watchdog is not None:
+            # slow=True: this bracket must KEEP the startup-factor
+            # bound even if an overlapping lazy dispatch completes
+            # first (completion flips the watchdog to steady state)
+            token = self.watchdog.begin(
+                f"warmup compile of {len(fams)} family(ies) x "
+                f"{len(self.controller.levels)} level(s)", slow=True)
+        try:
+            secs = self.engine.warmup(fams, self.controller.levels,
+                                      warm_too=warm_too)
+        finally:
+            if token is not None:
+                self.watchdog.done(token)
+        self._warm = True
+        logger.info("serve: warmup took %.2fs (%s)", secs,
+                    self.engine.aot.stats if self.engine.aot else "no AOT")
+        return secs
+
+    def submit(self, image1: np.ndarray, image2: np.ndarray,
+               deadline_ms: Optional[float] = None,
+               stream: Optional[str] = None):
+        """Admit one request; returns its Future.  Raises the typed
+        :class:`RequestError` subclasses on admission rejection (also
+        counted + ledgered — the caller seeing the reason IS the typed
+        shed)."""
+        deadline = (self._clock() + deadline_ms / 1000.0
+                    if deadline_ms is not None else None)
+        # submitted and its admission outcome land under ONE lock hold
+        # (queue.submit's own lock nests safely below): a close()-time
+        # conservation snapshot must never observe a submit between the
+        # two increments and declare a spurious silent drop
+        with self._lock:
+            self.counters["submitted"] += 1
+            try:
+                req = self.queue.submit(image1, image2,
+                                        deadline=deadline,
+                                        stream=stream,
+                                        clock=self._clock)
+            except RequestError as e:
+                key = ("rejected_queue_full" if e.kind == "queue-full"
+                       else "rejected_bad_request")
+                self.counters[key] += 1
+                rejected = e
+            else:
+                rejected = None
+        if rejected is not None:
+            self._incident(rejected.kind, str(rejected))
+            raise rejected
+        return req.future
+
+    # -- probes --------------------------------------------------------------
+
+    def ready(self) -> bool:
+        """Readiness: executables warm, batcher alive, watchdog clean."""
+        return (self._warm and self._thread.is_alive()
+                and (self.watchdog is None or self.watchdog.tripped is None))
+
+    def health(self) -> Dict:
+        """Liveness + load snapshot (the probe payload)."""
+        return {
+            "ok": self._thread.is_alive()
+                  and (self.watchdog is None
+                       or self.watchdog.tripped is None),
+            "ready": self.ready(),
+            "queue_depth": len(self.queue),
+            "queue_capacity": self.queue.capacity,
+            "degradation_level": self.controller.level,
+            "iters": self.controller.iters,
+            "counters": dict(self.counters),
+        }
+
+    # -- batcher thread ------------------------------------------------------
+
+    def _reject(self, req, err: RequestError, counter_key: str) -> None:
+        with self._lock:
+            self.counters[counter_key] += 1
+        self._incident(err.kind, str(err))
+        if not req.future.set_running_or_notify_cancel():
+            return
+        req.future.set_exception(err)
+
+    def _warm_inits(self, kept, hw):
+        """Per-slot ``flow_init`` from each stream's previous
+        ``flow_low`` (forward-splatted — the paper's video warm start);
+        zero for cold slots (numerically the cold start).  Returns None
+        when NO slot is warm, so pure-cold batches use the cold
+        executable.  A stream whose stored state came from a DIFFERENT
+        bucket family (the client changed frame size mid-stream) is
+        dropped and cold-starts — a shape-mismatched warm init must
+        never kill the batcher."""
+        from raft_tpu.ops import forward_interpolate
+
+        H, W = hw
+        B = self.engine.batch_size
+        any_warm = False
+        flow_init = np.zeros((B, H // 8, W // 8, 2), np.float32)
+        for i, req in enumerate(kept):
+            if req is None or req.stream is None:
+                continue
+            prev = self._streams.get(req.stream)
+            if prev is None:
+                continue
+            if prev.shape != (H // 8, W // 8, 2):
+                self._streams.pop(req.stream, None)
+                continue
+            flow_init[i] = forward_interpolate(prev)
+            any_warm = True
+        return flow_init if any_warm else None
+
+    def _remember_stream(self, stream: str, flow_low: np.ndarray) -> None:
+        self._streams[stream] = flow_low
+        self._streams.move_to_end(stream)
+        while len(self._streams) > self._max_streams:
+            self._streams.popitem(last=False)
+
+    def _serve_loop(self) -> None:
+        B = self.engine.batch_size
+        while not self._stop.is_set():
+            with self.spans.span("queue"):
+                reqs = self.queue.pop_batch(B, timeout=0.05)
+            if not reqs:
+                continue
+            self._batch_no += 1
+            try:
+                self._process_batch(reqs, B)
+            except Exception as e:  # noqa: BLE001 — the batcher thread
+                # must survive ANY per-batch failure: a dead batcher
+                # strands every pending future forever, the exact
+                # silent-drop failure this layer exists to kill.  The
+                # batch's own requests are rejected typed instead.
+                logger.exception("serve: batch %d processing failed",
+                                 self._batch_no)
+                err = BadRequestError(
+                    f"batch {self._batch_no} processing failed "
+                    f"({type(e).__name__}: {e})")
+                for req in reqs:
+                    if not req.future.done():
+                        self._reject(req, err, "rejected_bad_request")
+            if self._batch_no % self._flush_every == 0:
+                try:
+                    self.spans.flush(self._batch_no)
+                except (ValueError, OSError):
+                    # unwritable/closed ledger: telemetry must never
+                    # kill the batcher (the silent-drop failure mode)
+                    logger.warning("serve: span flush failed at batch "
+                                   "%d; continuing", self._batch_no)
+
+    def _process_batch(self, reqs, B: int) -> None:
+        family = reqs[0].family
+        hw = self.buckets[family]
+        with self.spans.span("batch"):
+            img1, img2, kept, rejected = assemble_batch(
+                reqs, hw, B, clock=self._clock)
+        for req, err in rejected:
+            self._reject(req, err,
+                         "rejected_deadline"
+                         if err.kind == "deadline-exceeded"
+                         else "rejected_bad_request")
+        if not any(r is not None for r in kept):
+            self.spans.step_boundary()
+            return
+
+        # pressure signal includes the just-popped batch: with
+        # max_batch close to capacity the post-pop depth alone
+        # could never reach the high watermark even at saturation
+        frac = min(1.0, (len(self.queue) + len(reqs))
+                   / self.queue.capacity)
+        iters = self.controller.observe(frac,
+                                        self.latency.rolling_p95_ms())
+        flow_init = self._warm_inits(kept, hw)
+        if flow_init is not None and self.warm_iters is not None \
+                and all(r is None or (r.stream in self._streams)
+                        for r in kept):
+            # fully-warm video batch: flow_init starts the GRU at
+            # last frame's solution, so the flat region extends
+            # further down the ladder
+            iters = min(iters, self.warm_iters)
+
+        token = None
+        if self.watchdog is not None:
+            # a not-yet-memoized executable pays a lazy compile (or
+            # cache load) inside this bracket: grant it the compile
+            # bound, not the dispatch bound
+            lazy = not self.engine.is_compiled(
+                hw, iters, warm=flow_init is not None)
+            token = self.watchdog.begin(
+                f"dispatch batch {self._batch_no} family={family} "
+                f"iters={iters} warm={flow_init is not None}"
+                + (" +compile" if lazy else ""), slow=lazy)
+        try:
+            flow_low, flow_up = self.engine.forward(
+                hw, iters, img1, img2, flow_init=flow_init)
+        except Exception as e:  # noqa: BLE001 — a dispatch failure
+            # must reject ITS requests typed, not kill the server
+            if token is not None:
+                self.watchdog.done(token)
+            err = BadRequestError(
+                f"dispatch failed ({type(e).__name__}: {e})")
+            for req in kept:
+                if req is not None:
+                    self._reject(req, err, "rejected_bad_request")
+            return
+        if token is not None:
+            self.watchdog.done(token)
+
+        now = self._clock()
+        for i, req in enumerate(kept):
+            if req is None:
+                continue
+            h, w = req.hw
+            if req.stream is not None:
+                self._remember_stream(req.stream, flow_low[i])
+            with self._lock:
+                self.counters["served"] += 1
+                self.counters["batches"] = self._batch_no
+            self.latency.add(now - req.t_submit)
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(
+                    {"flow": flow_up[i, :h, :w, :],
+                     "flow_low": flow_low[i],
+                     "iters": iters,
+                     "warm": (flow_init is not None
+                              and req.stream is not None)})
+        self.spans.step_boundary()
+
+    # -- shutdown ------------------------------------------------------------
+
+    def serving_summary(self) -> Dict:
+        """The ``run_end`` serving section (also the CLI's JSON line)."""
+        with self._lock:
+            counters = dict(self.counters)
+        rejected = (counters["rejected_queue_full"]
+                    + counters["rejected_deadline"]
+                    + counters["rejected_bad_request"]
+                    + counters["rejected_shutdown"])
+        summary = {
+            **counters,
+            "rejected_total": rejected,
+            "unaccounted": counters["submitted"] - counters["served"]
+                           - rejected,
+            **self.latency.percentiles_ms(),
+            "slo_p95_ms": self.slo_ms,
+            "degradation": self.controller.summary(),
+        }
+        if self.engine.aot is not None:
+            summary["aot_cache"] = dict(self.engine.aot.stats)
+        return summary
+
+    def close(self, timeout: float = 10.0) -> Dict:
+        """Stop the batcher, reject everything still queued (typed),
+        write the serving summary, return it."""
+        deadline = self._clock() + timeout
+        while len(self.queue) and self._clock() < deadline:
+            time.sleep(0.01)
+        self._stop.set()
+        # wait out an in-flight compile/dispatch: the summary's
+        # conservation counters must be FINAL, not racing the batcher's
+        # last future resolutions (a wedged dispatch is the watchdog's
+        # job, not close's)
+        self._thread.join(timeout=max(timeout, 60.0))
+        for req in self.queue.drain():
+            self._reject(req, BadRequestError(
+                f"request {req.rid} still queued at shutdown; rejected "
+                f"typed (no silent drops)"), "rejected_shutdown")
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        summary = self.serving_summary()
+        if summary["unaccounted"]:
+            # the conservation law is the no-silent-drops proof; a
+            # violation is its own FATAL kind so the chaos gate
+            # (--fail-on-incident fatal) trips on it — 'bad-request' is
+            # a client-input rejection and only warns
+            self._incident(
+                "serve-conservation",
+                f"request conservation violated at close: "
+                f"{summary['unaccounted']} request(s) unaccounted for "
+                f"(submitted != served + rejected — a silent drop)",
+                sample=False)
+        if self.ledger is not None:
+            try:
+                self.spans.flush(self._batch_no)
+                self.ledger.close(summary={"serving": summary})
+            except (ValueError, OSError):
+                # a full disk must not eat the summary the caller is
+                # owed — the ledger just loses its run_end record
+                logger.warning("serve: final ledger flush/close failed")
+        return summary

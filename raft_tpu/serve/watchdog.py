@@ -1,0 +1,137 @@
+"""Dispatch watchdog: a wedged compile/dispatch becomes a typed
+``serve-stalled`` incident and a loud nonzero exit, never a silent hang.
+
+The PR 7 collective-watchdog pattern applied to the request path: the
+batcher thread brackets every potentially-wedging operation (XLA
+compile at warmup, device dispatch per batch) with
+``begin(detail)``/``done()``; a daemon thread checks that no bracket
+has been open longer than the bound.  Before the first completed
+dispatch the bound is ``startup_factor x timeout`` — warmup compiles
+legitimately take many step-times, but a wedged compiler must still
+kill the server within a configured window instead of hanging the
+deployment's readiness probe forever.
+
+A trip writes the typed incident through ``on_incident``, runs the
+``on_trip`` flush hook, and ``os._exit``\\ s with
+:data:`SERVE_WATCHDOG_EXIT_CODE` — the batcher's main line is blocked
+inside native code, so no Python-level unwind can reach it.  The exit
+code is distinct from the pod watchdog's 13 so chaos matrices can tell
+the two verdicts apart.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+SERVE_WATCHDOG_EXIT_CODE = 14
+
+STARTUP_TIMEOUT_FACTOR = 10
+
+
+class DispatchWatchdog:
+    """Monitors bracketed serve-side operations for wedges."""
+
+    def __init__(self, timeout_s: float,
+                 on_incident: Callable[[str, str], None],
+                 on_trip: Optional[Callable[[str], None]] = None,
+                 startup_factor: float = STARTUP_TIMEOUT_FACTOR,
+                 interval: Optional[float] = None,
+                 exit_fn: Callable[[int], None] = os._exit,
+                 clock=time.monotonic):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.startup_factor = float(startup_factor)
+        self.interval = (max(0.1, min(2.0, self.timeout_s / 4.0))
+                         if interval is None else float(interval))
+        self._on_incident = on_incident
+        self._on_trip = on_trip
+        self._exit = exit_fn
+        self._clock = clock
+        self._lock = threading.Lock()
+        # token -> (detail, t0, slow): brackets may OVERLAP (the
+        # caller-thread warmup compile races the batcher thread's first
+        # dispatch), so a single slot would let begin/done pairs
+        # clobber each other and leave a genuinely wedged operation
+        # unmonitored
+        self._open: dict = {}
+        self._next_token = 0
+        self._completed = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.tripped: Optional[str] = None
+
+    # -- batcher-side brackets ----------------------------------------------
+
+    def begin(self, detail: str, slow: bool = False) -> int:
+        """Open a bracket; returns the token ``done`` takes.
+        ``slow=True`` grants this bracket the startup-factor bound
+        even in steady state — the lazily-compiled-executable case (a
+        legitimate multi-second XLA compile mid-serve must not be
+        declared a wedge by the dispatch-sized timeout)."""
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            self._open[token] = (detail, self._clock(), bool(slow))
+            return token
+
+    def done(self, token: int) -> None:
+        with self._lock:
+            self._open.pop(token, None)
+            self._completed += 1
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 4)
+            self._thread = None
+
+    # -- thread body ---------------------------------------------------------
+
+    def check(self) -> Optional[str]:
+        """One stall check (exposed for deterministic tests); returns
+        the stall detail when the bound is exceeded, else None."""
+        with self._lock:
+            open_brackets = list(self._open.values())
+            completed = self._completed
+        now = self._clock()
+        for detail, t0, slow in open_brackets:
+            wide = slow or not completed
+            bound = self.timeout_s * (self.startup_factor if wide
+                                      else 1.0)
+            stalled = now - t0
+            if stalled <= bound:
+                continue
+            phase = ("startup/compile (bound is "
+                     f"{self.startup_factor:.0f}x the timeout)" if wide
+                     else f"steady state ({completed} dispatches "
+                          f"completed)")
+            return (f"no progress on [{detail}] for {stalled:.1f}s (> "
+                    f"{bound:.1f}s) in {phase} — compile or dispatch "
+                    f"wedged; terminating loudly instead of hanging "
+                    f"the deployment")
+        return None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            verdict = self.check()
+            if verdict is None:
+                continue
+            self.tripped = "serve-stalled"
+            try:
+                self._on_incident("serve-stalled", verdict)
+                if self._on_trip is not None:
+                    self._on_trip("serve-stalled")
+            finally:
+                self._exit(SERVE_WATCHDOG_EXIT_CODE)
+            return

@@ -52,10 +52,35 @@ checkpoints, agreement channel), gated through
                             (typed peer-fatal), with NO watchdog
                             timeout configured
 
+``--serve`` switches to the SERVING matrix: every scenario drives the
+real FlowServer through ``python -m raft_tpu.serve`` (bounded queue,
+deadline batcher, AOT executable cache, dispatch watchdog), gated
+through ``obs report --fail-on-incident fatal``:
+
+- ``serve-overload``     burst far above queue capacity -> typed
+                         ``queue-full`` sheds, ZERO silent drops
+                         (conservation counter), degradation engages
+- ``serve-deadline-storm`` every request pre-expired -> typed
+                         ``deadline-exceeded`` rejections BEFORE any
+                         dispatch
+- ``serve-poison``       a NaN-pixel request -> typed ``bad-request``,
+                         the rest of the load served normally
+- ``serve-kill-restart-warm`` cold run writes the AOT cache; SIGKILL
+                         mid-serve (no cleanup) -> restart loads the
+                         cache warm (< 50% of the cold startup,
+                         measured); then one cache file torn at rest ->
+                         restart recompiles with a typed
+                         ``serve-cache-corrupt``, exit 0
+- ``serve-stall``        the first dispatch wedges forever -> the
+                         dispatch watchdog exits 14 with a typed
+                         ``serve-stalled``; the fatal gate trips
+
 This is the scripted, runnable form of the resilience acceptance
 criteria; tests/test_resilience.py runs the cheap unit half in tier-1,
 tests/test_elastic.py runs the channel fast subset in tier-1 and the
-flagship/wedge pod gates under the slow marker.
+flagship/wedge pod gates under the slow marker, and
+tests/test_serve.py covers the serving unit half (incl. the
+batched-vs-solo parity and poison-isolation proofs).
 """
 
 import argparse
@@ -71,6 +96,7 @@ sys.path.insert(0, ROOT)
 
 WATCHDOG_EXIT_CODE = 13     # parallel/elastic.py (import-free: workers
                             # must not drag jax into this driver)
+SERVE_WATCHDOG_EXIT_CODE = 14   # serve/watchdog.py (same import rule)
 
 
 def read_incident_kinds(ledger_path):
@@ -299,6 +325,202 @@ def dist_main(args, env, workdir):
     return 1 if failures else 0
 
 
+# ---------------------------------------------------------------------------
+# --serve: the serving matrix (python -m raft_tpu.serve sessions)
+# ---------------------------------------------------------------------------
+
+def run_serve(workdir, name, extra, env, phase="run", timeout=600):
+    """One serving-CLI subprocess; returns (rc, startup, summary, tail).
+
+    ``startup``/``summary`` are the parsed ``serve_startup`` /
+    ``serve_summary`` JSON lines (None when the phase died before
+    printing them — the SIGKILL phase by design)."""
+    ledger = os.path.join(workdir, name, f"events_{phase}.jsonl")
+    cmd = [sys.executable, "-m", "raft_tpu.serve",
+           "--ledger", ledger] + extra
+    try:
+        proc = subprocess.run(cmd, cwd=ROOT, env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # a hang IS a scenario verdict (the failure mode the dispatch
+        # watchdog exists to kill) — it must become a FAIL row, not a
+        # driver traceback that loses every other scenario's verdict
+        return None, None, None, f"TIMEOUT after {timeout}s — session hung"
+    startup = summary = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            startup = rec.get("serve_startup", startup)
+            summary = rec.get("serve_summary", summary)
+    return proc.returncode, startup, summary, proc.stdout[-4000:]
+
+
+def serve_main(args, env, workdir):
+    """The serving fault matrix: recover (typed incident, exit 0, fatal
+    gate passes) or terminate loudly (typed incident, nonzero, gate
+    trips) — and the warm-restart economics are MEASURED, not assumed."""
+    base = ["--requests", "8", "--batch_size", "2", "--queue_capacity",
+            "16", "--iter_levels", "4,2"]
+
+    all_names = ("serve-overload", "serve-deadline-storm", "serve-poison",
+                 "serve-kill-restart-warm", "serve-stall")
+    if args.only and args.only not in all_names:
+        print(f"unknown serve scenario {args.only!r} "
+              f"(known: {', '.join(all_names)})")
+        return 2
+
+    def want(name):
+        return not args.only or args.only == name
+
+    rows = []
+    failures = 0
+
+    def finish(name, want_kinds, expect_fatal, fail, phases_ledgers):
+        nonlocal failures
+        seen = set()
+        for lp in phases_ledgers:
+            if os.path.isfile(lp):
+                try:
+                    ks, _ = read_incident_kinds(lp)
+                    seen.update(ks)
+                except (OSError, ValueError):
+                    pass   # a torn ledger from the SIGKILL phase
+        if fail is None:
+            missing = want_kinds - seen
+            gate_rcs = [gate(lp, env) for lp in phases_ledgers
+                        if os.path.isfile(lp)]
+            if missing:
+                fail = f"missing typed incident(s): {sorted(missing)}"
+            elif expect_fatal and all(rc == 0 for rc in gate_rcs):
+                fail = "serving fatal gate did NOT trip"
+            elif not expect_fatal and any(gate_rcs):
+                fail = "serving fatal gate tripped on a recovered run"
+        verdict = "FAIL" if fail else (
+            "terminated+gated" if expect_fatal else "recovered")
+        rows.append((name, sorted(seen), verdict, fail))
+        failures += bool(fail)
+
+    def ledger(name, phase):
+        return os.path.join(workdir, name, f"events_{phase}.jsonl")
+
+    # -- overload: typed queue-full sheds, zero silent drops, the
+    # iteration controller engages under the burst
+    if want("serve-overload"):
+        name, fail = "serve-overload", None
+        rc, _, summary, tail = run_serve(
+            workdir, name, base + ["--requests", "24", "--queue_capacity",
+                                   "4", "--inject", "overload"], env)
+        if rc != 0:
+            fail = f"exit {rc} != 0\n{tail}"
+        elif summary is None or summary["unaccounted"] != 0:
+            fail = f"silent drops: {summary and summary['unaccounted']}"
+        elif not summary["rejected_queue_full"]:
+            fail = "no queue-full sheds under a 6x-capacity burst"
+        elif summary["degradation"]["max_level"] < 1:
+            fail = "iteration controller never engaged under overload"
+        finish(name, {"queue-full", "serve-degraded"}, False, fail,
+               [ledger(name, "run")])
+
+    # -- deadline storm: every rejection typed and PRE-dispatch
+    if want("serve-deadline-storm"):
+        name, fail = "serve-deadline-storm", None
+        rc, _, summary, tail = run_serve(
+            workdir, name, base + ["--inject", "deadline-storm"], env)
+        if rc != 0:
+            fail = f"exit {rc} != 0\n{tail}"
+        elif summary is None or summary["unaccounted"] != 0:
+            fail = "silent drops under the storm"
+        elif summary["served"] or summary["rejected_deadline"] != 8:
+            fail = (f"expected 8/8 typed pre-dispatch rejections, got "
+                    f"served={summary and summary['served']} "
+                    f"deadline={summary and summary['rejected_deadline']}")
+        finish(name, {"deadline-exceeded"}, False, fail,
+               [ledger(name, "run")])
+
+    # -- poison: typed reject, the rest of the load unharmed
+    if want("serve-poison"):
+        name, fail = "serve-poison", None
+        rc, _, summary, tail = run_serve(
+            workdir, name, base + ["--inject", "poison@3"], env)
+        if rc != 0:
+            fail = f"exit {rc} != 0\n{tail}"
+        elif summary is None or summary["unaccounted"] != 0:
+            fail = "silent drops around the poisoned request"
+        elif summary["rejected_bad_request"] != 1 or summary["served"] != 7:
+            fail = (f"expected 1 typed reject + 7 served, got "
+                    f"bad={summary and summary['rejected_bad_request']} "
+                    f"served={summary and summary['served']}")
+        finish(name, {"bad-request"}, False, fail, [ledger(name, "run")])
+
+    # -- kill + restart warm: the AOT cache survives SIGKILL (atomic
+    # writes), the restart is measurably warm, and a TORN cache file
+    # degrades typed to recompile
+    if want("serve-kill-restart-warm"):
+        name, fail = "serve-kill-restart-warm", None
+        cache = os.path.join(workdir, name, "aot")
+        rc, startup, _, tail = run_serve(
+            workdir, name, base + ["--aot_cache", cache, "--inject",
+                                   "sigkill@2"], env, phase="cold")
+        cold_s = startup and startup["startup_s"]
+        if rc != -9:
+            fail = f"SIGKILL phase exit {rc} != -9 (SIGKILL)\n{tail}"
+        elif not cold_s or startup["cold_compiles"] < 1:
+            fail = f"cold phase reported no compile ({startup})"
+        if fail is None:
+            rc, startup, summary, tail = run_serve(
+                workdir, name, base + ["--aot_cache", cache], env,
+                phase="warm")
+            if rc != 0:
+                fail = f"warm restart exit {rc} != 0\n{tail}"
+            elif startup["warm_hits"] < 1 or startup["cold_compiles"]:
+                fail = f"restart was not warm ({startup})"
+            elif startup["startup_s"] >= 0.5 * cold_s:
+                fail = (f"warm startup {startup['startup_s']}s is not < 50% "
+                        f"of cold {cold_s}s")
+        if fail is None:
+            blobs = [f for f in os.listdir(cache) if f.endswith(".aotx")]
+            with open(os.path.join(cache, blobs[0]), "r+b") as f:
+                f.truncate(64)     # torn at rest
+            rc, startup, summary, tail = run_serve(
+                workdir, name, base + ["--aot_cache", cache], env,
+                phase="torn")
+            if rc != 0:
+                fail = f"torn-cache restart exit {rc} != 0\n{tail}"
+            elif not startup["cache_corrupt"]:
+                fail = "torn cache file was not detected"
+            elif summary["unaccounted"] or summary["served"] != 8:
+                fail = f"torn-cache restart did not serve cleanly ({summary})"
+        finish(name, {"serve-cache-corrupt"}, False, fail,
+               [ledger(name, p) for p in ("cold", "warm", "torn")])
+
+    # -- stall: wedged dispatch -> watchdog exit 14, typed, gated
+    if want("serve-stall"):
+        name, fail = "serve-stall", None
+        rc, _, summary, tail = run_serve(
+            workdir, name, base + ["--inject", "stall",
+                                   "--watchdog_timeout", "3"], env)
+        if rc != SERVE_WATCHDOG_EXIT_CODE:
+            fail = f"exit {rc} != {SERVE_WATCHDOG_EXIT_CODE} (watchdog)\n{tail}"
+        finish(name, {"serve-stalled"}, True, fail, [ledger(name, "run")])
+
+    print("\nchaos serve fault matrix:")
+    for name, kinds, verdict, f in rows:
+        print(f"  {name:<24} {verdict:<16} "
+              f"incidents={','.join(kinds) or '-'}")
+        if f:
+            print(f"    FAILURE: {f}")
+    print(f"\nchaos_dryrun --serve: "
+          f"{'OK' if not failures else f'{failures} FAILED'} "
+          f"(workdir: {workdir})")
+    return 1 if failures else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser("chaos_dryrun")
     ap.add_argument("--only", default=None,
@@ -310,6 +532,11 @@ def main(argv=None):
                          "runs of the real CLI (sharded checkpoints, "
                          "agreement channel, watchdog), gated via "
                          "obs report --merge")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the SERVING matrix instead: python -m "
+                         "raft_tpu.serve sessions (overload, deadline "
+                         "storm, poison, SIGKILL+warm-restart, stall), "
+                         "gated via obs report --fail-on-incident fatal")
     ap.add_argument("--workdir", default=None)
     args = ap.parse_args(argv)
 
@@ -317,6 +544,11 @@ def main(argv=None):
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_")
+    if args.dist and args.serve:
+        print("pick one of --dist / --serve")
+        return 2
+    if args.serve:
+        return serve_main(args, env, workdir)
     if args.dist:
         return dist_main(args, env, workdir)
     S = args.steps

@@ -166,6 +166,8 @@ def test_evaluator_cache_is_lru_bounded():
             0, 255, (1, 64, w, 3)).astype(np.float32)
         ev(im, im, iters=1)
     assert len(ev._cache) == 2
-    # most-recent shapes survive
-    assert any(k[0] == (1, 64, 80, 3) for k in ev._cache)
-    assert not any(k[0] == (1, 64, 64, 3) for k in ev._cache)
+    # most-recent shapes survive (key = (arg_signature, iters, warm);
+    # arg_signature is ((shape, dtype), ...) over every input)
+    shapes = [k[0][0][0] for k in ev._cache]
+    assert (1, 64, 80, 3) in shapes
+    assert (1, 64, 64, 3) not in shapes

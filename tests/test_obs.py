@@ -636,3 +636,119 @@ def test_train_dryrun_writes_ledger_and_report_attributes(tmp_path):
     assert inc["severity"] == "fatal"
     assert [i["kind"] for i in nan_report["incidents"]].count(
         "fault-injected") == 1
+
+
+# ---------------------------------------------------------------------------
+# serving section: report rendering, --fail-on-slo, incident taxonomy
+# ---------------------------------------------------------------------------
+
+def _serve_ledger(tmp_path, name, slo_ms, p95_ms, incidents=()):
+    """A canned serve-run ledger whose run_end carries a serving
+    summary (what FlowServer.close writes)."""
+    path = str(tmp_path / name)
+    ledger = RunLedger(path, meta={"entry": "serve", "batch_size": 2})
+    for kind, step, detail in incidents:
+        ledger.incident(kind, step=step, detail=detail)
+    ledger.close(summary={"serving": {
+        "submitted": 10, "served": 8, "rejected_queue_full": 1,
+        "rejected_deadline": 1, "rejected_bad_request": 0,
+        "rejected_shutdown": 0, "rejected_total": 2, "unaccounted": 0,
+        "latency_p50_ms": 40.0, "latency_p95_ms": p95_ms,
+        "latency_max_ms": p95_ms * 1.2, "slo_p95_ms": slo_ms,
+        "degradation": {"levels": [32, 24, 16, 8], "final_level": 0,
+                        "max_level": 2, "transitions": 4},
+        "aot_cache": {"hits": 2, "misses": 1, "corrupt": 0,
+                      "compile_s": 3.0, "load_s": 0.1},
+    }})
+    return path
+
+
+def test_report_serving_section_renders_and_derives_slo(tmp_path):
+    path = _serve_ledger(tmp_path, "ok.jsonl", slo_ms=100.0, p95_ms=60.0)
+    report = build_report(read_ledger(path))
+    serving = report["serving"]
+    assert serving["slo_ok"] is True
+    text = render_report(report)
+    assert "serving:" in text
+    assert "10 submitted  8 served  2 rejected typed" in text
+    assert "p95 60.0 ms" in text and "SLO p95 100.0 ms: met" in text
+    assert "max level 2" in text
+    assert "2 warm hit(s)" in text
+
+    bad = _serve_ledger(tmp_path, "bad.jsonl", slo_ms=50.0, p95_ms=60.0)
+    bad_report = build_report(read_ledger(bad))
+    assert bad_report["serving"]["slo_ok"] is False
+    assert "SLO p95 50.0 ms: VIOLATED" in render_report(bad_report)
+
+
+def test_report_serving_conservation_violation_is_loud(tmp_path):
+    path = str(tmp_path / "drop.jsonl")
+    ledger = RunLedger(path, meta={"entry": "serve"})
+    ledger.close(summary={"serving": {
+        "submitted": 5, "served": 3, "rejected_total": 1,
+        "unaccounted": 1, "rejected_queue_full": 1,
+        "rejected_deadline": 0, "rejected_bad_request": 0,
+        "rejected_shutdown": 0,
+        "latency_p50_ms": 1.0, "latency_p95_ms": 2.0,
+        "latency_max_ms": 3.0, "slo_p95_ms": None}})
+    text = render_report(build_report(read_ledger(path)))
+    assert "SILENT DROPS: 1 request(s)" in text
+
+
+def test_fail_on_slo_exit_codes(tmp_path):
+    from raft_tpu.obs.__main__ import main as obs_main
+
+    ok = _serve_ledger(tmp_path, "ok.jsonl", slo_ms=100.0, p95_ms=60.0)
+    bad = _serve_ledger(tmp_path, "bad.jsonl", slo_ms=50.0, p95_ms=60.0)
+    assert obs_main(["report", ok, "--fail-on-slo"]) == 0
+    assert obs_main(["report", bad, "--fail-on-slo"]) == 1
+    # no SLO configured for the run: a loud usage error, never a pass
+    noslo = _serve_ledger(tmp_path, "noslo.jsonl", slo_ms=None,
+                          p95_ms=60.0)
+    assert obs_main(["report", noslo, "--fail-on-slo"]) == 2
+    # not a serve run at all
+    plain = str(tmp_path / "plain.jsonl")
+    RunLedger(plain, meta={"entry": "train"}).close(summary={"steps": 1})
+    assert obs_main(["report", plain, "--fail-on-slo"]) == 2
+    # the SLO gate composes with the incident gate (incident wins)
+    stalled = _serve_ledger(
+        tmp_path, "stalled.jsonl", slo_ms=100.0, p95_ms=60.0,
+        incidents=[("serve-stalled", 3, "wedged dispatch")])
+    assert obs_main(["report", stalled, "--fail-on-incident", "fatal",
+                     "--fail-on-slo"]) == 1
+
+
+def test_serving_incident_taxonomy_severities():
+    """The degradation-level / serving incident kinds are first-class
+    taxonomy entries with the severities the gates depend on."""
+    from raft_tpu.obs.events import DEFAULT_INCIDENT_SEVERITY
+
+    assert DEFAULT_INCIDENT_SEVERITY["queue-full"] == "warn"
+    assert DEFAULT_INCIDENT_SEVERITY["deadline-exceeded"] == "warn"
+    assert DEFAULT_INCIDENT_SEVERITY["bad-request"] == "warn"
+    assert DEFAULT_INCIDENT_SEVERITY["serve-cache-corrupt"] == "recovered"
+    assert DEFAULT_INCIDENT_SEVERITY["serve-degraded"] == "warn"
+    assert DEFAULT_INCIDENT_SEVERITY["serve-restored"] == "recovered"
+    assert DEFAULT_INCIDENT_SEVERITY["serve-stalled"] == "fatal"
+    # a conservation violation (a silent drop happened) must trip the
+    # fatal gate — it is NOT a client-input warn
+    assert DEFAULT_INCIDENT_SEVERITY["serve-conservation"] == "fatal"
+    # and the docstring taxonomy table documents every one of them
+    import raft_tpu.obs.events as events_mod
+
+    for kind in ("queue-full", "deadline-exceeded", "bad-request",
+                 "serve-cache-corrupt", "serve-degraded",
+                 "serve-restored", "serve-stalled",
+                 "serve-conservation"):
+        assert f"``{kind}``" in events_mod.__doc__
+
+
+def test_report_serving_no_samples_gives_no_slo_verdict(tmp_path):
+    """An SLO-configured run that measured nothing (every request shed
+    pre-dispatch -> NaN percentiles) must say so — not claim VIOLATED."""
+    path = _serve_ledger(tmp_path, "empty.jsonl", slo_ms=50.0,
+                         p95_ms=float("nan"))
+    report = build_report(read_ledger(path))
+    assert "slo_ok" not in report["serving"]
+    text = render_report(report)
+    assert "no latency samples" in text and "VIOLATED" not in text
