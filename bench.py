@@ -484,6 +484,12 @@ def main():
     fed_pairs_per_s = fed_dev if platform != "cpu" else fed_pairs_per_s_host
     fed_lane = "device" if platform != "cpu" else "host"
 
+    # lane -> registered entry point whose graph the lane measures
+    # (raft_tpu/entrypoints.py): the scoreboard and the graftlint
+    # budget/audit ledgers talk about the same graphs by construction
+    from raft_tpu.entrypoints import bench_lanes
+    lane_entries = bench_lanes()
+
     if ledger is not None:
         ledger.close(summary=health.summary()
                      | {"pairs_per_s": round(pairs_per_s, 3),
@@ -511,6 +517,8 @@ def main():
         # serving lane: synthetic requests through the real FlowServer
         # (queue -> batcher -> AOT executor) at this resolution
         **serve_metrics,
+        # which registered entry point each lane exercises
+        "lane_entrypoints": lane_entries,
         "host_cores": os.cpu_count(),
         "deferred_corr_grad": deferred,
         **({"tiny": True} if tiny else {}),

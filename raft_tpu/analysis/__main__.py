@@ -17,6 +17,16 @@ Engine-specific extras:
   bf16 accumulation, softmax hygiene) and statically verifies the
   Pallas kernels' BlockSpecs/VMEM against the ledger's ``pallas_vmem``
   section; ``--update-budgets`` re-baselines that section too.
+- ``--engine registry`` runs the structural coverage auditor against
+  ``raft_tpu/entrypoints.py``: every ``jit``/``pallas_call``/
+  ``shard_map`` call site reachable from a registered entry, every
+  budgets.json row mapped back to one, every entry traced, engine
+  participation consistent, and NO stale inline waivers (staleness
+  gates here; ``--audits coverage,budgets,trace,participation,waivers``
+  selects sub-checks).
+- ``--prune-budgets`` previews the ledger rows a full
+  ``--update-budgets`` run would drop (entries that no longer exist in
+  the registry), then exits 0.
 - ``--list-waivers`` enumerates every active suppression in the tree —
   inline ``# graftlint: disable`` comments (with staleness: a waiver
   that no longer matches any finding is marked ``[stale]``) and the
@@ -59,17 +69,24 @@ def default_paths() -> list:
 
 
 def collect_waivers(paths) -> list:
-    """Every declared suppression, as dicts: inline lint waivers (with
+    """Every declared suppression, as dicts: inline waivers (with
     activity — a waiver whose line no longer produces a finding is
-    rot), plus the data-declared jaxpr/HLO waiver tuples."""
+    rot), plus the data-declared jaxpr/HLO waiver tuples.
+
+    Activity comes from registry_audit.active_waiver_keys — the SAME
+    computation engine 5's stale-waiver gate uses (engine-1 rules plus
+    the coverage scan, so an inline ``unregistered-entrypoint`` waiver
+    counts as active here exactly when the gate says so).
+    """
     import inspect
+    import os as _os
 
     from raft_tpu.analysis.budgets import display_path
-    from raft_tpu.analysis.lint import (iter_python_files, parse_waivers,
-                                        run_lint)
+    from raft_tpu.analysis.lint import iter_python_files, parse_waivers
+    from raft_tpu.analysis.registry_audit import (active_waiver_keys,
+                                                  scan_coverage)
 
-    lint_findings = run_lint(paths)
-    active = {(f.path, f.line) for f in lint_findings if f.waived}
+    active = active_waiver_keys(paths, scan_coverage(paths))
     out = []
     for path in iter_python_files(paths):
         with open(path, encoding="utf-8") as f:
@@ -79,7 +96,7 @@ def collect_waivers(paths) -> list:
             out.append({
                 "engine": "lint", "path": display_path(path),
                 "line": line, "rules": sorted(rules), "reason": reason,
-                "active": (path, line) in active})
+                "active": (_os.path.abspath(path), line) in active})
 
     def data_waivers(engine, module):
         src_path = inspect.getsourcefile(module)
@@ -129,14 +146,15 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "python -m raft_tpu.analysis",
         description="graftlint: AST lint + jaxpr audit + HLO "
-                    "collective/cost audit + numerics/Pallas audit "
-                    "for raft_tpu")
+                    "collective/cost audit + numerics/Pallas audit + "
+                    "registry coverage audit for raft_tpu")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories for the AST engine "
                         "(default: raft_tpu/, scripts/, bench.py, "
                         "__graft_entry__.py)")
     p.add_argument("--engine",
-                   choices=["lint", "jaxpr", "hlo", "numerics", "all"],
+                   choices=["lint", "jaxpr", "hlo", "numerics",
+                            "registry", "all"],
                    default="all")
     p.add_argument("--rules", default=None,
                    help="comma-separated lint rule ids to run "
@@ -157,6 +175,11 @@ def main(argv=None) -> int:
                    help="enumerate every active waiver (inline lint "
                         "disables with staleness, jaxpr/HLO data "
                         "waivers) and exit")
+    p.add_argument("--prune-budgets", action="store_true",
+                   help="dry-run: list the budgets.json rows a full "
+                        "--update-budgets run would prune (rows whose "
+                        "entry no longer exists in "
+                        "raft_tpu/entrypoints.py) and exit 0")
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (findings + report)")
     p.add_argument("--verbose", action="store_true",
@@ -168,11 +191,28 @@ def main(argv=None) -> int:
         p.error("--update-budgets requires --engine hlo or numerics "
                 "(or all)")
 
-    if args.engine in ("jaxpr", "hlo", "numerics", "all"):
+    if args.engine in ("jaxpr", "hlo", "numerics", "registry", "all"):
         _force_cpu_with_virtual_devices()
 
     from raft_tpu.analysis import findings as fmod
     from raft_tpu.analysis.lint import run_lint
+
+    if args.prune_budgets:
+        import json as _json
+
+        from raft_tpu.analysis.registry_audit import orphan_rows
+
+        orphans = orphan_rows(args.budgets)
+        if args.json:
+            print(_json.dumps({"would_prune": orphans}, indent=2))
+        else:
+            n = sum(len(v) for v in orphans.values())
+            for section, rows in orphans.items():
+                for row in rows:
+                    print(f"would prune [{section}] {row}")
+            print(f"--prune-budgets (dry run): {n} orphan row(s); a "
+                  f"full --update-budgets run drops them")
+        return 0
 
     if args.list_waivers:
         waivers = collect_waivers(args.paths or default_paths())
@@ -206,6 +246,10 @@ def main(argv=None) -> int:
             numerics_known = (set(_NE) | set(_NF)
                               | set(pallas_audit.FIXTURE_ENTRIES.keys()))
             known |= numerics_known
+        if args.engine in ("registry", "all"):
+            from raft_tpu.analysis.registry_audit import CHECKS
+
+            known |= set(CHECKS)
         unknown = sorted(set(audits) - known)
         if unknown:
             p.error(f"unknown audit(s) {unknown}; known: {sorted(known)}")
@@ -295,6 +339,24 @@ def main(argv=None) -> int:
             all_findings += nfs
             report["numerics"] = nreport
         timings["numerics"] = round(time.monotonic() - t0, 2)
+    if args.engine in ("registry", "all"):
+        from raft_tpu.utils.platform import ensure_platform
+
+        ensure_platform(strict=True)
+        t0 = time.monotonic()
+        from raft_tpu.analysis.registry_audit import CHECKS, \
+            run_registry_audit
+
+        reg_names = audits
+        if audits is not None:
+            reg_names = [a for a in audits if a in CHECKS]
+        if reg_names != []:
+            rfs, rreport = run_registry_audit(
+                reg_names, paths=args.paths or None,
+                budgets_path=args.budgets)
+            all_findings += rfs
+            report["registry"] = rreport
+        timings["registry"] = round(time.monotonic() - t0, 2)
 
     report["engine_timings"] = timings
     out = (fmod.render_json(all_findings, report) if args.json

@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from raft_tpu.analysis.findings import Finding
 
@@ -82,7 +82,8 @@ def load_budgets(path: Optional[str] = None) -> Optional[Dict]:
 
 def save_budgets(path: Optional[str], meta: Optional[Dict],
                  entries: Dict[str, Dict],
-                 section: str = "entries") -> str:
+                 section: str = "entries",
+                 prune: Optional[Sequence[str]] = None) -> str:
     """Write the ledger, merging over an existing file: only the entries
     measured this run are replaced (so ``--update-budgets --audits x``
     re-baselines one entry without dropping the rest).
@@ -92,11 +93,19 @@ def save_budgets(path: Optional[str], meta: Optional[Dict],
     every other section survives a write untouched.  ``meta=None``
     keeps the existing meta (the Pallas facts are trace-structural and
     carry no toolchain pin of their own).
+
+    ``prune`` drops the named rows from the merged section — the
+    full-run ``--update-budgets`` path passes the rows whose entry no
+    longer exists in ``raft_tpu/entrypoints.py``, so a renamed or
+    deleted entry's record stops being merged forward forever (the
+    caller prints the diff; ``--prune-budgets`` previews it).
     """
     path = path or default_budgets_path()
     existing = load_budgets(path) or {}
     merged = dict(existing.get(section, {}))
     merged.update(entries)
+    for name in prune or ():
+        merged.pop(name, None)
     payload = dict(existing)
     if meta is not None:
         payload["meta"] = meta

@@ -48,26 +48,22 @@ from __future__ import annotations
 import dataclasses
 import importlib
 import inspect
+import json
 import re
 import time
 from collections import Counter
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from raft_tpu import entrypoints as registry
 from raft_tpu.analysis import budgets as budgets_mod
 from raft_tpu.analysis.findings import Finding
 from raft_tpu.analysis.jaxpr_audit import (JaxprWaiver, apply_data_waivers,
                                            donation_alias_count)
+# the collective vocabulary lives on the registry (single source of
+# truth shared with the per-entry forbid/require declarations)
+from raft_tpu.entrypoints import COLLECTIVE_KINDS, NO_COLLECTIVES
 
-# Every HLO opcode that moves data across devices.  "-start" variants
-# cover async-split collectives (TPU); the matching "-done" ops carry no
-# second transfer and are not counted.
-COLLECTIVE_KINDS = (
-    "all-reduce", "all-gather", "all-to-all", "collective-permute",
-    "reduce-scatter", "collective-broadcast", "all-reduce-start",
-    "all-gather-start", "collective-permute-start", "ragged-all-to-all",
-)
-
-_NO_COLLECTIVES = COLLECTIVE_KINDS  # forbid-list for single-device entries
+_NO_COLLECTIVES = NO_COLLECTIVES  # forbid-list for single-device entries
 
 # Pinned compile options — the ledger is only comparable under one
 # fixed optimization pipeline (see module docstring).
@@ -183,12 +179,11 @@ def measure_compiled(entry: str, lowered_text: str, compiled,
 
 
 # --------------------------------------------------------------------------
-# entry-point registry
+# entry enumeration — derived from raft_tpu/entrypoints.py (engine 5
+# cross-checks that this derivation and the registry never diverge)
 # --------------------------------------------------------------------------
 
-class SkipEntry(Exception):
-    """Raised by a builder when its environment prerequisite is absent;
-    the runner reports a note instead of a finding."""
+SkipEntry = registry.SkipEntry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -204,108 +199,14 @@ class HloEntry:
     budgeted: bool = True
 
 
-def _audit_mesh():
-    import jax
-
-    from raft_tpu.parallel.mesh import virtual_device_mesh
-
-    mesh = virtual_device_mesh()
-    if mesh is None:
-        raise SkipEntry(
-            f"needs 8 devices, have {jax.device_count()} (run via "
-            f"`python -m raft_tpu.analysis`, which forces 8 virtual "
-            f"CPU devices)")
-    return mesh
+def _from_registry(e: "registry.EntryPoint") -> HloEntry:
+    return HloEntry(e.name, e.hlo_build or e.build, e.anchor,
+                    donated=e.donated, forbid=e.forbid,
+                    require=e.require, budgeted=e.budgeted)
 
 
-def _build_train_step():
-    from raft_tpu.training.step import abstract_train_step
-
-    # `small` keeps the compile ~20 s; donation/collective/churn facts
-    # are structural and identical on the large model (which engine 2
-    # traces).
-    return abstract_train_step(iters=2, donate=True,
-                               overrides={"small": True})
-
-
-def _build_parallel_step():
-    from raft_tpu.parallel.step import abstract_parallel_step
-
-    mesh = _audit_mesh()
-    return abstract_parallel_step(
-        mesh, iters=2, overrides={"small": True, "corr_shard": True},
-        shard_inputs=True)
-
-
-def _build_eval_forward():
-    from raft_tpu.evaluation.evaluate import abstract_eval_forward
-
-    return abstract_eval_forward(iters=2)
-
-
-def _build_eval_forward_bf16():
-    # the entry with real f32<->bf16 boundary crossings: its
-    # convert_f32_bf16 bound is the churn gate (a policy change that
-    # starts bouncing activations between dtypes shows up here first)
-    from raft_tpu.evaluation.evaluate import abstract_eval_forward
-
-    return abstract_eval_forward(
-        iters=2, overrides={"compute_dtype": "bfloat16",
-                            "corr_dtype": "bfloat16"})
-
-
-def _build_corr_dense():
-    from raft_tpu.ops.corr import abstract_corr_lookup
-
-    return abstract_corr_lookup("dense")
-
-
-def _build_corr_chunked():
-    from raft_tpu.ops.corr import abstract_corr_lookup
-
-    return abstract_corr_lookup("chunked")
-
-
-def _build_corr_pallas():
-    from raft_tpu.ops.corr_pallas import abstract_ondemand_lookup
-
-    return abstract_ondemand_lookup()
-
-
-def _build_corr_ring():
-    from raft_tpu.parallel.ring import abstract_ring_lookup
-
-    return abstract_ring_lookup(_audit_mesh())
-
-
-def _build_device_aug():
-    from raft_tpu.data.device_aug import abstract_device_aug
-
-    return abstract_device_aug(sparse=False)
-
-
-def _build_device_aug_sparse():
-    from raft_tpu.data.device_aug import abstract_device_aug
-
-    return abstract_device_aug(sparse=True, wire_format="f32")
-
-
-def _build_serve_forward():
-    from raft_tpu.serve.engine import abstract_serve_forward
-
-    fwd, args = abstract_serve_forward(iters=2)
-    return fwd, args
-
-
-def _build_serve_forward_warm():
-    # the video-mode variant: an extra (B, H/8, W/8, 2) flow_init input
-    # and the warm-start add on the scan carry — structurally identical
-    # collectives (none), so a collective here means a sharding
-    # annotation leaked into the serving graph
-    from raft_tpu.serve.engine import abstract_serve_forward
-
-    fwd, args = abstract_serve_forward(iters=2, warm=True)
-    return fwd, args
+ENTRIES: Dict[str, HloEntry] = {
+    name: _from_registry(e) for name, e in registry.hlo_entries().items()}
 
 
 def _build_seeded_missharded():
@@ -320,67 +221,13 @@ def _build_seeded_missharded():
     from raft_tpu.ops.corr import abstract_corr_lookup
     from raft_tpu.parallel.mesh import DATA_AXIS
 
-    mesh = _audit_mesh()
+    mesh = registry.audit_mesh()
     fn, (f_sds, _, co_sds) = abstract_corr_lookup("dense", batch=8)
     sharded = NamedSharding(mesh, P(DATA_AXIS))
     bad = jax.jit(fn, in_shardings=(sharded, sharded, sharded),
                   out_shardings=NamedSharding(mesh, P()))
     return bad, (f_sds, f_sds, co_sds)
 
-
-ENTRIES: Dict[str, HloEntry] = {
-    "train_step": HloEntry(
-        "train_step", _build_train_step,
-        ("raft_tpu.training.step", "abstract_train_step"), donated=True),
-    "parallel_step": HloEntry(
-        "parallel_step", _build_parallel_step,
-        ("raft_tpu.parallel.step", "abstract_parallel_step"),
-        # all-reduce (gradients) and the spatial path's legitimate
-        # resharding traffic are ledger-pinned EXACTLY; all-to-all has
-        # no sanctioned source in this program, so it is forbidden
-        # structurally on top of the ledger.
-        forbid=("all-to-all", "ragged-all-to-all")),
-    "eval_forward": HloEntry(
-        "eval_forward", _build_eval_forward,
-        ("raft_tpu.evaluation.evaluate", "abstract_eval_forward")),
-    "eval_forward_bf16": HloEntry(
-        "eval_forward_bf16", _build_eval_forward_bf16,
-        ("raft_tpu.evaluation.evaluate", "abstract_eval_forward")),
-    "corr_lookup_dense": HloEntry(
-        "corr_lookup_dense", _build_corr_dense,
-        ("raft_tpu.ops.corr", "abstract_corr_lookup")),
-    "corr_lookup_chunked": HloEntry(
-        "corr_lookup_chunked", _build_corr_chunked,
-        ("raft_tpu.ops.corr", "abstract_corr_lookup")),
-    "corr_lookup_pallas": HloEntry(
-        "corr_lookup_pallas", _build_corr_pallas,
-        ("raft_tpu.ops.corr_pallas", "abstract_ondemand_lookup")),
-    "corr_ring": HloEntry(
-        "corr_ring", _build_corr_ring,
-        ("raft_tpu.parallel.ring", "abstract_ring_lookup"),
-        forbid=("all-gather", "all-gather-start", "all-to-all",
-                "ragged-all-to-all"),
-        require=("collective-permute",)),
-    # the h2d-lane augmentation graphs (data/device_aug.py): strictly
-    # single-device programs — any collective means a sharding
-    # annotation leaked into the input pipeline
-    "device_aug": HloEntry(
-        "device_aug", _build_device_aug,
-        ("raft_tpu.data.device_aug", "abstract_device_aug")),
-    "device_aug_sparse": HloEntry(
-        "device_aug_sparse", _build_device_aug_sparse,
-        ("raft_tpu.data.device_aug", "abstract_device_aug")),
-    # the serving graphs (serve/engine.py): batched bf16 test_mode
-    # forwards, cold and warm-start — single-device by construction,
-    # and the bf16 churn bound guards the serving policy the same way
-    # eval_forward_bf16's does
-    "serve_forward": HloEntry(
-        "serve_forward", _build_serve_forward,
-        ("raft_tpu.serve.engine", "abstract_serve_forward")),
-    "serve_forward_warm": HloEntry(
-        "serve_forward_warm", _build_serve_forward_warm,
-        ("raft_tpu.serve.engine", "abstract_serve_forward")),
-}
 
 FIXTURE_ENTRIES: Dict[str, HloEntry] = {
     "seeded_missharded": HloEntry(
@@ -574,12 +421,33 @@ def run_hlo_audit(names: Optional[Sequence[str]] = None,
                         f"— run --update-budgets without --audits to "
                         f"re-baseline everything"))
             records = {}
+        # a FULL re-baseline also prunes rows whose entry no longer
+        # exists in the registry (a rename would otherwise merge its
+        # old row forward forever); each dropped row is printed as a
+        # note finding — the diff reviewers sign off on
+        prune: List[str] = []
+        if names is None and records:
+            sanctioned = set(registry.expected_budget_rows("entries"))
+            ledger_rows = (ledger or {}).get("entries", {})
+            prune = sorted(set(ledger_rows) - sanctioned)
+            for row in prune:
+                findings.append(Finding(
+                    engine="hlo", rule="budget-pruned",
+                    path=budgets_mod.display_path(ledger_path),
+                    line=budgets_mod.budget_line(ledger_path, row),
+                    message=f"pruned ledger row '{row}' — no registered "
+                            f"entry claims it (renamed or deleted); "
+                            f"dropped record: "
+                            f"{json.dumps(ledger_rows[row], sort_keys=True)}",
+                    severity="note", data={"entry": row}))
         if records:
             budgets_mod.save_budgets(ledger_path,
-                                     current_meta(tolerance), records)
+                                     current_meta(tolerance), records,
+                                     prune=prune)
         report["budgets_written"] = {
             "path": budgets_mod.display_path(ledger_path),
             "entries": sorted(records),
+            "pruned": prune,
             "skipped_broken": skipped}
     else:
         if not strict:

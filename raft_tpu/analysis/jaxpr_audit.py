@@ -43,6 +43,7 @@ import dataclasses
 import json
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from raft_tpu import entrypoints as registry
 from raft_tpu.analysis.findings import Finding
 
 # Primitives that move data across the device boundary or re-enter
@@ -234,9 +235,8 @@ def _f64_findings(entry: str, closed) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
-# entry-point audits — traces come from the lowerable entry-point
-# builders the production modules expose (training/step.py
-# abstract_train_step and friends; shapes there are chosen so every
+# entry-point audits — traces come from the registry's canonical
+# builds (raft_tpu/entrypoints.py; shapes there are chosen so every
 # pyramid level stays >= 1px and traces take seconds: trace cost scales
 # with graph size, not shapes).  The HLO engine (hlo_audit.py) compiles
 # the same builders; this engine stays compile-free.
@@ -250,14 +250,12 @@ def audit_train_step() -> Tuple[List[Finding], Dict]:
     import jax
     from jax.experimental import enable_x64
 
-    from raft_tpu.training.step import abstract_train_step
-
     # two INDEPENDENT builds: identical jaxprs == stable compile key.
-    # add_noise=True covers the widest trace (the noise path is where
-    # dtype-less random draws would hide).
-    step1, (state_sds, batch_sds) = abstract_train_step(
-        iters=_ITERS, add_noise=True)
-    step2, _ = abstract_train_step(iters=_ITERS, add_noise=True)
+    # The registry's canonical build traces add_noise=True (the widest
+    # trace — the noise path is where dtype-less random draws hide).
+    build = registry.ENTRYPOINTS["train_step"].build
+    step1, (state_sds, batch_sds) = build()
+    step2, _ = build()
     findings: List[Finding] = []
     with enable_x64():
         jx1 = jax.make_jaxpr(step1)(state_sds, batch_sds)
@@ -286,8 +284,8 @@ def audit_donation() -> Tuple[List[Finding], Dict]:
     """training/step.py donate=True: aliases must cover the state."""
     import jax
 
-    from raft_tpu.training.step import abstract_train_step
-
+    abstract_train_step = registry.resolve_anchor(
+        registry.ENTRYPOINTS["train_step"])
     step, (state_sds, batch_sds) = abstract_train_step(
         iters=_ITERS, donate=True)
     low = step.lower(state_sds, batch_sds)
@@ -311,11 +309,8 @@ def audit_bf16_policy() -> Tuple[List[Finding], Dict]:
     import jax
     import jax.numpy as jnp
 
-    from raft_tpu.training.step import abstract_train_step
-
-    step, (state_sds, batch_sds) = abstract_train_step(
-        iters=_ITERS,
-        overrides={"compute_dtype": "bfloat16", "corr_dtype": "bfloat16"})
+    step, (state_sds, batch_sds) = registry.ENTRYPOINTS[
+        "train_step_bf16"].build()
     jx = jax.make_jaxpr(step)(state_sds, batch_sds)
     findings: List[Finding] = []
     bad = find_unaccumulated_bf16_dots(jx)
@@ -351,27 +346,20 @@ def audit_parallel_step() -> Tuple[List[Finding], Dict]:
     """parallel/step.py under the (data=2, spatial=4) CPU mesh."""
     import jax
 
-    from raft_tpu.parallel.mesh import set_mesh, virtual_device_mesh
-    from raft_tpu.parallel.step import abstract_parallel_step
-
-    mesh = virtual_device_mesh()
-    if mesh is None:
-        return [_finding(
-            "sharded-trace", "parallel_step",
-            f"skipped: needs 8 devices, have {jax.device_count()} (run "
-            f"via `python -m raft_tpu.analysis`, which forces 8 virtual "
-            f"CPU devices)", severity="note")], {}
-
-    step, (state_sds, batch_sds) = abstract_parallel_step(
-        mesh, iters=_ITERS)
-    with set_mesh(mesh):
+    entry = registry.ENTRYPOINTS["parallel_step"]
+    try:
+        step, (state_sds, batch_sds) = entry.build()
+    except registry.SkipEntry as e:
+        return [_finding("sharded-trace", "parallel_step",
+                         f"skipped: {e}", severity="note")], {}
+    with registry.trace_context(entry):
         jx = jax.make_jaxpr(step)(state_sds, batch_sds)
     findings = _f64_findings("parallel_step", jx)
     for prim, prov in find_loop_transfers(jx):
         findings.append(_finding(
             "scan-transfer", "parallel_step",
             f"{prim} inside a scan body at {prov}"))
-    return _apply_waivers(findings), {"mesh": dict(mesh.shape)}
+    return _apply_waivers(findings), {"mesh": dict(registry.AUDIT_MESH)}
 
 
 def audit_eval_forward() -> Tuple[List[Finding], Dict]:
@@ -380,18 +368,16 @@ def audit_eval_forward() -> Tuple[List[Finding], Dict]:
     import jax.numpy as jnp
     from jax.experimental import enable_x64
 
-    from raft_tpu.evaluation.evaluate import abstract_eval_forward
-
-    fwd, (variables_sds, img_sds, _) = abstract_eval_forward(iters=_ITERS)
+    fwd, args = registry.ENTRYPOINTS["eval_forward"].build()
 
     with enable_x64():
-        jx = jax.make_jaxpr(fwd)(variables_sds, img_sds, img_sds)
+        jx = jax.make_jaxpr(fwd)(*args)
     findings = _f64_findings("eval_forward", jx)
     for prim, prov in find_loop_transfers(jx):
         findings.append(_finding(
             "scan-transfer", "eval_forward",
             f"{prim} inside a scan body at {prov}"))
-    flow_low, flow_up = jax.eval_shape(fwd, variables_sds, img_sds, img_sds)
+    flow_low, flow_up = jax.eval_shape(fwd, *args)
     for name, leaf in [("flow_low", flow_low), ("flow_up", flow_up)]:
         if leaf.dtype != jnp.float32:
             findings.append(_finding(
@@ -411,13 +397,12 @@ def audit_serve_forward() -> Tuple[List[Finding], Dict]:
     import jax.numpy as jnp
     from jax.experimental import enable_x64
 
-    from raft_tpu.serve.engine import abstract_serve_forward
-
     findings: List[Finding] = []
     report: Dict = {"traced": []}
-    for name, warm in (("serve_forward", False),
-                       ("serve_forward_warm", True)):
-        fwd, args = abstract_serve_forward(iters=_ITERS, warm=warm)
+    for name, entry in registry.ENTRYPOINTS.items():
+        if "serve_forward" not in entry.jaxpr:
+            continue
+        fwd, args = entry.build()
         with enable_x64():
             jx = jax.make_jaxpr(fwd)(*args)
         report["traced"].append(name)
@@ -442,21 +427,15 @@ def audit_corr_lookups() -> Tuple[List[Finding], Dict]:
     import jax
     from jax.experimental import enable_x64
 
-    from raft_tpu.ops.corr import abstract_corr_lookup
-
     findings: List[Finding] = []
     report: Dict = {"traced": []}
 
-    entries = [("corr_lookup_dense", lambda: abstract_corr_lookup("dense")),
-               ("corr_lookup_chunked",
-                lambda: abstract_corr_lookup("chunked"))]
-
-    def pallas():
-        from raft_tpu.ops.corr_pallas import abstract_ondemand_lookup
-
-        return abstract_ondemand_lookup()
-
-    entries.append(("corr_lookup_pallas", pallas))
+    # the grad-free (compile-shaped) builds: this engine's f64 check
+    # predates the grad=True numerics variants and stays on the forward
+    # lookups
+    entries = [(name, e.hlo_build or e.build)
+               for name, e in registry.ENTRYPOINTS.items()
+               if "corr_lookups" in e.jaxpr]
 
     for name, build in entries:
         try:
@@ -489,12 +468,12 @@ def audit_device_aug() -> Tuple[List[Finding], Dict]:
     import jax
     from jax.experimental import enable_x64
 
-    from raft_tpu.data.device_aug import abstract_device_aug
-
     findings: List[Finding] = []
     report: Dict = {"traced": []}
-    for name, sparse in (("device_aug", False), ("device_aug_sparse", True)):
-        fn, args = abstract_device_aug(sparse=sparse)
+    for name, entry in registry.ENTRYPOINTS.items():
+        if "device_aug" not in entry.jaxpr:
+            continue
+        fn, args = entry.build()
         with enable_x64():
             jx = jax.make_jaxpr(fn)(*args)
         report["traced"].append(name)
@@ -541,7 +520,11 @@ def audit_recompile_keys() -> Tuple[List[Finding], Dict]:
     return [], report
 
 
-ENTRY_AUDITS: Dict[str, Callable[[], Tuple[List[Finding], Dict]]] = {
+# Audit-kind implementations.  WHICH of them run — and in what order —
+# is the registry's call (each entry's ``jaxpr`` tuple plus the
+# report-only JAXPR_REPORTS); an audit kind declared there without an
+# implementation here fails loudly at import.
+_AUDIT_IMPLS: Dict[str, Callable[[], Tuple[List[Finding], Dict]]] = {
     "train_step": audit_train_step,
     "donation": audit_donation,
     "bf16_policy": audit_bf16_policy,
@@ -552,6 +535,9 @@ ENTRY_AUDITS: Dict[str, Callable[[], Tuple[List[Finding], Dict]]] = {
     "device_aug": audit_device_aug,
     "recompile_keys": audit_recompile_keys,
 }
+
+ENTRY_AUDITS: Dict[str, Callable[[], Tuple[List[Finding], Dict]]] = {
+    name: _AUDIT_IMPLS[name] for name in registry.jaxpr_audit_names()}
 
 
 def run_jaxpr_audit(names: Optional[Sequence[str]] = None

@@ -84,6 +84,7 @@ import re
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from raft_tpu import entrypoints as registry
 from raft_tpu.analysis.findings import Finding
 from raft_tpu.analysis.jaxpr_audit import (JaxprWaiver, apply_data_waivers,
                                            provenance)
@@ -1102,8 +1103,7 @@ ALL_RULES = frozenset({"dtype-overflow", "unguarded-partial",
 DEEP_RULES = ALL_RULES - {"dtype-overflow"}
 
 
-class SkipEntry(Exception):
-    """Environment prerequisite absent — runner reports a note."""
+SkipEntry = registry.SkipEntry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1115,160 +1115,35 @@ class NumEntry:
     budgeted: bool = True         # fixtures never get ledger records
 
 
-def _mesh_or_skip():
-    import jax
-
-    from raft_tpu.parallel.mesh import virtual_device_mesh
-
-    mesh = virtual_device_mesh()
-    if mesh is None:
-        raise SkipEntry(
-            f"needs 8 devices, have {jax.device_count()} (run via "
-            f"`python -m raft_tpu.analysis`, which forces 8 virtual "
-            f"CPU devices)")
-    return mesh
-
-
-def _build_train_step():
-    from raft_tpu.training.step import abstract_train_step
-
-    step, (state_sds, batch_sds) = abstract_train_step(
-        iters=2, add_noise=True)
-    return step, (state_sds, batch_sds), declared_ranges(
-        (state_sds, batch_sds))
-
-
-def _build_train_step_bf16():
-    from raft_tpu.training.step import abstract_train_step
-
-    step, (state_sds, batch_sds) = abstract_train_step(
-        iters=2,
-        overrides={"compute_dtype": "bfloat16", "corr_dtype": "bfloat16"})
-    return step, (state_sds, batch_sds), declared_ranges(
-        (state_sds, batch_sds))
-
-
-def _build_parallel_step():
-    from raft_tpu.parallel.mesh import set_mesh
-    from raft_tpu.parallel.step import abstract_parallel_step
-
-    mesh = _mesh_or_skip()
-    step, (state_sds, batch_sds) = abstract_parallel_step(mesh, iters=2)
-
-    class _Ctx:
-        def __enter__(self):
-            self._cm = set_mesh(mesh)
-            return self._cm.__enter__()
-
-        def __exit__(self, *a):
-            return self._cm.__exit__(*a)
-
-    return step, (state_sds, batch_sds), declared_ranges(
-        (state_sds, batch_sds)), _Ctx()
-
-
-def _build_eval_forward():
-    from raft_tpu.evaluation.evaluate import abstract_eval_forward
-
-    fwd, (variables_sds, img_sds, _) = abstract_eval_forward(iters=2)
-    args = (variables_sds, img_sds, img_sds)
-    return fwd, args, declared_ranges(args)
-
-
-def _build_corr(kind):
-    from raft_tpu.ops.corr import abstract_corr_lookup
-
-    fn, args = abstract_corr_lookup(kind)
-    return fn, args, fmap_ranges(args)
-
-
-def _build_corr_pallas():
-    from raft_tpu.ops.corr_pallas import abstract_ondemand_lookup
-
-    fn, args = abstract_ondemand_lookup(grad=True)
-    return fn, args, fmap_ranges(args)
-
-
-def _build_pyramid_pallas():
-    from raft_tpu.ops.corr_pallas import abstract_pyramid_lookup
-
-    fn, args = abstract_pyramid_lookup(grad=True)
-    return fn, args, fmap_ranges(args)
-
-
-def _build_pyramid_pallas_stacked():
-    from raft_tpu.ops.corr_pallas import abstract_pyramid_lookup
-
-    fn, args = abstract_pyramid_lookup(stacked=True, grad=True)
-    return fn, args, fmap_ranges(args)
-
-
-def _build_serve_forward():
-    from raft_tpu.serve.engine import abstract_serve_forward
-
-    fwd, args = abstract_serve_forward(iters=2)
-    return fwd, args, declared_ranges(args)
-
-
-def _build_serve_forward_warm():
-    # the video variant: the flow_init input and its warm-start add on
-    # the scan carry only exist in THIS graph — a bf16 regression on
-    # that path would pass the cold entry clean
-    from raft_tpu.serve.engine import abstract_serve_forward
-
-    fwd, args = abstract_serve_forward(iters=2, warm=True)
-    return fwd, args, declared_ranges(args)
-
-
-def _build_device_aug():
-    from raft_tpu.data.device_aug import abstract_device_aug
-
-    fn, (batch_sds,) = abstract_device_aug(sparse=False)
-    return fn, (batch_sds,), device_aug_ranges(batch_sds)
-
-
-def _build_device_aug_sparse():
-    from raft_tpu.data.device_aug import abstract_device_aug
-
-    fn, (batch_sds,) = abstract_device_aug(sparse=True, wire_format="f32")
-    return fn, (batch_sds,), device_aug_ranges(batch_sds)
-
-
-ENTRIES: Dict[str, NumEntry] = {
-    "train_step": NumEntry("train_step", _build_train_step,
-                           rules=DEEP_RULES),
-    "train_step_bf16": NumEntry("train_step_bf16", _build_train_step_bf16,
-                                rules=DEEP_RULES),
-    "parallel_step": NumEntry("parallel_step", _build_parallel_step,
-                              rules=DEEP_RULES),
-    "eval_forward": NumEntry("eval_forward", _build_eval_forward,
-                             rules=DEEP_RULES),
-    # the serving graph (serve/engine.py): the batched bf16 inference
-    # policy — the bf16-accum and overflow rules prove the serving
-    # dtype story the same way train_step_bf16's do
-    "serve_forward": NumEntry("serve_forward", _build_serve_forward,
-                              rules=DEEP_RULES),
-    "serve_forward_warm": NumEntry("serve_forward_warm",
-                                   _build_serve_forward_warm,
-                                   rules=DEEP_RULES),
-    "corr_lookup_dense": NumEntry("corr_lookup_dense",
-                                  lambda: _build_corr("dense")),
-    "corr_lookup_chunked": NumEntry("corr_lookup_chunked",
-                                    lambda: _build_corr("chunked")),
-    "corr_lookup_pallas": NumEntry("corr_lookup_pallas",
-                                   _build_corr_pallas, pallas=True),
-    "corr_pyramid_pallas": NumEntry("corr_pyramid_pallas",
-                                    _build_pyramid_pallas, pallas=True),
-    "corr_pyramid_pallas_stacked": NumEntry(
-        "corr_pyramid_pallas_stacked", _build_pyramid_pallas_stacked,
-        pallas=True),
-    # h2d-lane augmentation graphs (data/device_aug.py): shallow,
-    # spec-bounded programs — the full rule set applies, incl. the
-    # dtype-overflow proof over the fixed-point photometric chains
-    "device_aug": NumEntry("device_aug", _build_device_aug),
-    "device_aug_sparse": NumEntry("device_aug_sparse",
-                                  _build_device_aug_sparse),
+# Input-spec recipe names the registry's ``ranges`` field selects:
+# how each entry's declared VRange seeds derive from its abstract args.
+RANGE_RECIPES: Dict[str, Callable[[tuple], List[VRange]]] = {
+    "declared": lambda args: declared_ranges(args),
+    "fmap": lambda args: fmap_ranges(args),
+    "device_aug": lambda args: device_aug_ranges(args[0]),
 }
+
+
+def _from_registry(e: "registry.EntryPoint") -> NumEntry:
+    """Adapt a registry entry to this engine's builder shape
+    ``() -> (fn, args, ranges[, ctx])``."""
+    def build():
+        fn, args = e.build()
+        ranges = RANGE_RECIPES[e.ranges](args)
+        if e.needs_mesh:
+            return fn, args, ranges, registry.trace_context(e)
+        return fn, args, ranges
+
+    return NumEntry(e.name, build,
+                    rules=DEEP_RULES if e.deep else ALL_RULES,
+                    pallas=e.pallas, budgeted=e.budgeted)
+
+
+# entry enumeration — derived from raft_tpu/entrypoints.py (engine 5
+# cross-checks this derivation against the declared participation)
+ENTRIES: Dict[str, NumEntry] = {
+    name: _from_registry(e)
+    for name, e in registry.numerics_entries().items()}
 
 
 # --------------------------------------------------------------------------
@@ -1459,7 +1334,8 @@ def run_numerics_audit(names: Optional[Sequence[str]] = None,
         report[name] = entry_report
 
     pfs, preport = pallas_audit.compare_budgets(
-        pallas_measurements, budgets_path=budgets_path, update=update)
+        pallas_measurements, budgets_path=budgets_path, update=update,
+        full_run=names is None)
     findings.extend(pfs)
     if preport:
         report["pallas_vmem"] = preport

@@ -238,14 +238,18 @@ def audit_entry_kernels(entry: str, closed
 
 def compare_budgets(measurements: Dict[str, Dict],
                     budgets_path: Optional[str] = None,
-                    update: bool = False) -> Tuple[List[Finding], Dict]:
+                    update: bool = False,
+                    full_run: bool = False) -> Tuple[List[Finding], Dict]:
     """Measured kernel facts vs the ledger's ``pallas_vmem`` section.
 
     ``vmem_bytes`` is an upper bound (growth fails, improvement is a
     note past 2x slack); ``calls`` compares exactly.  ``update=True``
-    merge-writes the section instead (commit the budgets.json diff).
-    Kernels with a cap violation still gate via the structural rule —
-    the ledger can never sanction an unfittable block.
+    merge-writes the section instead (commit the budgets.json diff);
+    with ``full_run`` (no ``--audits`` selection) the write also prunes
+    rows whose ``entry/`` prefix no longer names a registered Pallas
+    entry, each dropped row printed as a note finding.  Kernels with a
+    cap violation still gate via the structural rule — the ledger can
+    never sanction an unfittable block.
     """
     if not measurements and not update:
         return [], {}
@@ -265,12 +269,32 @@ def compare_budgets(measurements: Dict[str, Dict],
             # zero records would be a silent no-op write — skip it
             report["budgets_written"] = {"kernels": []}
             return findings, report
+        prune: List[str] = []
+        if full_run:
+            import json
+
+            from raft_tpu.entrypoints import expected_budget_rows
+
+            sanctioned = set(expected_budget_rows("pallas_vmem"))
+            prune = sorted(k for k in section
+                           if k.split("/", 1)[0] not in sanctioned)
+            for row in prune:
+                findings.append(Finding(
+                    engine="numerics", rule="budget-pruned",
+                    path=budgets_mod.display_path(ledger_path),
+                    line=budgets_mod.budget_line(ledger_path, row),
+                    message=f"pruned pallas_vmem row '{row}' — its "
+                            f"entry prefix no longer names a registered "
+                            f"Pallas entry; dropped record: "
+                            f"{json.dumps(section[row], sort_keys=True)}",
+                    severity="note", data={"kernel": row}))
         meta = ledger.get("meta") or {}
         budgets_mod.save_budgets(ledger_path, meta or None, clean,
-                                 section="pallas_vmem")
+                                 section="pallas_vmem", prune=prune)
         report["budgets_written"] = {
             "path": budgets_mod.display_path(ledger_path),
-            "kernels": sorted(clean)}
+            "kernels": sorted(clean),
+            "pruned": prune}
         return findings, report
 
     disp = budgets_mod.display_path(ledger_path)

@@ -1,0 +1,544 @@
+"""graftlint engine 5: the structural coverage auditor.
+
+Engines 1-4 audit what the registered entry points contain; none of
+them can say *"this graph isn't registered at all"* — the gap a new
+``jax.jit``/``pallas_call`` acquires by simply never being added to
+``raft_tpu/entrypoints.py`` (no jaxpr audit, no HLO budget, no numerics
+proof, no AOT cache key: invisible to the whole stack).  This engine
+closes the loop structurally, against the registry:
+
+- ``unregistered-entrypoint`` — an AST scan over the package finds
+  every ``jax.jit`` / ``pjit`` / ``pallas_call`` / ``shard_map`` call
+  site (calls, decorators, and ``functools.partial(jax.jit, ...)``
+  wrappers) and flags any that is not reachable from a registered
+  entry's builder through the package's (name-based, conservative)
+  call graph.  Waivable inline with the engine-1 syntax::
+
+      # graftlint: disable=unregistered-entrypoint -- <why>
+
+  ``raft_tpu/analysis/`` itself is out of scope by design: the
+  engines' deliberately-broken seeded fixtures ARE unregistered
+  lowerable graphs, on purpose.
+- ``orphan-budget`` / ``missing-budget`` — every ``budgets.json`` row
+  must map back to a registered entry (an orphan row after a rename is
+  a finding, not silent dead weight), and every registry entry that
+  declares a budgets section must have a live row.
+- ``entry-trace`` — every registered entry must actually build and
+  abstractly trace (``jax.eval_shape`` under its mesh recipe); an
+  entry whose builder broke is a registry lie.
+- ``engine-participation`` — the engines' derived tables
+  (``jaxpr_audit.ENTRY_AUDITS``, ``hlo_audit.ENTRIES``,
+  ``numerics_audit.ENTRIES``) must match the registry's declared
+  participation exactly, and every entry must participate in at least
+  one engine (registered-but-unaudited is the same hole as
+  unregistered).
+- ``stale-waiver`` — an inline waiver whose file:line no longer
+  produces the finding it suppresses is exit 1 here (rot used to be a
+  ``--list-waivers`` footnote; now it gates).
+
+Sub-audits are selectable with ``--audits
+coverage,budgets,trace,participation,waivers`` (tests scope fixture
+runs this way); the default runs everything.  Only ``trace`` needs
+jax; the rest run source/ledger-only, so ``--audits coverage`` is
+sub-second.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from raft_tpu import entrypoints as registry
+from raft_tpu.analysis import budgets as budgets_mod
+from raft_tpu.analysis.findings import Finding
+
+# The registry-coverage rule's sub-audit names (the engine's --audits
+# vocabulary).
+CHECKS = ("coverage", "budgets", "trace", "participation", "waivers")
+
+# Names whose call lowers a graph to XLA.
+LOWERING_NAMES = {"jit", "pjit", "pallas_call", "shard_map"}
+
+
+def default_scan_paths() -> List[str]:
+    """The coverage scan scope: the installed package, minus
+    ``analysis/`` (whose seeded fixtures are unregistered lowerable
+    graphs on purpose — they are the engines' test vectors)."""
+    import raft_tpu
+
+    return [os.path.dirname(os.path.abspath(raft_tpu.__file__))]
+
+
+def _scan_files(paths: Sequence[str]) -> List[str]:
+    from raft_tpu.analysis.lint import iter_python_files
+
+    analysis_dir = os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for p in iter_python_files(paths):
+        if os.path.dirname(os.path.abspath(p)).startswith(analysis_dir):
+            continue
+        out.append(p)
+    return out
+
+
+# --------------------------------------------------------------------------
+# coverage scan (pure ast — unit-tested against fixture sources)
+# --------------------------------------------------------------------------
+
+def _terminal_name(node) -> Optional[str]:
+    """The rightmost identifier of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _lowering_names_in_call(call: ast.Call) -> Set[str]:
+    """Lowering names a Call node invokes: its func, plus top-level
+    args (catches ``functools.partial(jax.jit, ...)`` wrappers)."""
+    names = set()
+    for node in [call.func] + list(call.args):
+        n = _terminal_name(node)
+        if n in LOWERING_NAMES:
+            names.add(n)
+    return names
+
+
+class _FileFacts(ast.NodeVisitor):
+    """One file's call-site and call-graph facts.
+
+    ``functions``: name -> set of names referenced inside that def
+    (including nested defs' names — defining is referencing).
+    ``sites``: (line, lowering-name, enclosing-def-names) per call
+    site, decorators included.
+    """
+
+    def __init__(self):
+        self.functions: Dict[str, Set[str]] = {}
+        self.sites: List[Tuple[int, str, Tuple[str, ...]]] = []
+        self.links: List[Set[str]] = []   # module-level co-references
+        # (first line, last line, assignment targets) per module-level
+        # statement — pseudo-enclosing names for module-level sites
+        self.stmt_targets: List[Tuple[int, int, Set[str]]] = []
+        self._stack: List[str] = []
+
+    def _add_ref(self, name: str) -> None:
+        for fn in self._stack:
+            self.functions.setdefault(fn, set()).add(name)
+
+    def _visit_def(self, node) -> None:
+        self._add_ref(node.name)
+        self._stack.append(node.name)
+        self.functions.setdefault(node.name, set())
+        for deco in node.decorator_list:
+            n = _terminal_name(deco)
+            if n in LOWERING_NAMES:
+                self.sites.append((deco.lineno, n, tuple(self._stack)))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Call(self, node: ast.Call) -> None:
+        for n in sorted(_lowering_names_in_call(node)):
+            self.sites.append((node.lineno, n, tuple(self._stack)))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self._add_ref(node.id)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self._add_ref(node.attr)
+        self.generic_visit(node)
+
+
+def scan_coverage(paths: Sequence[str],
+                  roots: Optional[Iterable[str]] = None) -> List[Finding]:
+    """``unregistered-entrypoint`` findings for every lowering call
+    site under ``paths`` not reachable from a registry root.
+
+    Reachability is a name-based BFS over the scanned files' call
+    graph — conservative in the safe-for-lint direction (a name
+    collision can only over-approximate reachability, never flag a
+    covered site).  Inline waivers use the engine-1 syntax and are
+    applied here (engine-1's parser, so the semantics cannot drift).
+    """
+    from raft_tpu.analysis.lint import apply_waivers, parse_waivers
+
+    roots = set(registry.coverage_roots() if roots is None else roots)
+    facts: Dict[str, _FileFacts] = {}
+    findings: List[Finding] = []
+    for path in _scan_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue  # engine 1 owns syntax errors
+        v = _FileFacts()
+        v.visit(tree)
+        # module-level statements (custom_vjp/defvjp registrations,
+        # dispatch tables) connect the names they co-reference: when
+        # one side is reachable, so is the other — the only way a
+        # backward kernel registered at module scope stays covered.
+        # Their assignment TARGETS double as the pseudo-enclosing
+        # names of module-level call sites (``_fast = jax.jit(impl)``
+        # is covered exactly when ``_fast`` is reachable).
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Import,
+                                 ast.ImportFrom)):
+                continue
+            names = {n for node in ast.walk(stmt)
+                     for n in [_terminal_name(node)] if n}
+            if len(names) > 1:
+                v.links.append(names)
+            targets = {node.id for node in ast.walk(stmt)
+                       if isinstance(node, ast.Name)
+                       and isinstance(node.ctx, ast.Store)}
+            v.stmt_targets.append(
+                (stmt.lineno, getattr(stmt, "end_lineno", stmt.lineno),
+                 targets))
+        facts[path] = v
+
+    # package-wide name graph: name -> union of referenced names
+    graph: Dict[str, Set[str]] = {}
+    for v in facts.values():
+        for fn, refs in v.functions.items():
+            graph.setdefault(fn, set()).update(refs)
+        for group in v.links:
+            for name in group:
+                graph.setdefault(name, set()).update(group - {name})
+    reachable = set()
+    frontier = [r for r in roots]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        frontier.extend(graph.get(name, ()))
+
+    for path, v in facts.items():
+        file_findings = []
+        for line, kind, enclosing in v.sites:
+            if not enclosing:
+                # module-level site: its statement's assignment targets
+                # stand in for the enclosing def (``_fast =
+                # jax.jit(impl)`` is covered when ``_fast`` is)
+                enclosing = tuple(
+                    n for lo, hi, targets in v.stmt_targets
+                    if lo <= line <= hi for n in targets)
+            if any(fn in reachable for fn in enclosing):
+                continue
+            where = ".".join(enclosing) or "<module>"
+            file_findings.append(Finding(
+                engine="registry", rule="unregistered-entrypoint",
+                path=budgets_mod.display_path(path), line=line,
+                message=f"{kind} call site in '{where}' is not reachable "
+                        f"from any registered entry point — register a "
+                        f"builder for this graph in "
+                        f"raft_tpu/entrypoints.py (audits, budgets and "
+                        f"cache keys follow), or waive inline with a "
+                        f"reason",
+                data={"kind": kind, "function": where}))
+        if file_findings:
+            with open(path, encoding="utf-8") as f:
+                waivers, _ = parse_waivers(f.read(), path)
+            file_findings = apply_waivers(file_findings, waivers)
+        findings.extend(file_findings)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# budgets.json cross-check (ledger-only, jax-free)
+# --------------------------------------------------------------------------
+
+def check_budgets(budgets_path: Optional[str] = None) -> List[Finding]:
+    """Every ledger row maps to a registered entry; every registered
+    budgets-section declaration has a live row."""
+    ledger_path = budgets_path or budgets_mod.default_budgets_path()
+    ledger = budgets_mod.load_budgets(ledger_path)
+    disp = budgets_mod.display_path(ledger_path)
+    if ledger is None:
+        return [Finding(
+            engine="registry", rule="missing-budget", path=disp, line=0,
+            message="no budgets.json ledger — run `python -m "
+                    "raft_tpu.analysis --engine hlo --update-budgets` "
+                    "(then `--engine numerics --update-budgets`) and "
+                    "commit it")]
+    findings: List[Finding] = []
+
+    sanctioned = set(registry.expected_budget_rows("entries"))
+    rows = set(ledger.get("entries", {}))
+    for row in sorted(rows - sanctioned):
+        findings.append(Finding(
+            engine="registry", rule="orphan-budget", path=disp,
+            line=budgets_mod.budget_line(ledger_path, row),
+            message=f"ledger row '{row}' maps to no registered entry "
+                    f"(renamed or deleted?) — prune it with a full "
+                    f"--update-budgets run (or preview with "
+                    f"--prune-budgets)",
+            data={"section": "entries", "row": row}))
+    for name in sorted(sanctioned - rows):
+        findings.append(Finding(
+            engine="registry", rule="missing-budget", path=disp, line=0,
+            message=f"registered entry '{name}' declares the 'entries' "
+                    f"budgets section but has no ledger row — run "
+                    f"`python -m raft_tpu.analysis --engine hlo "
+                    f"--update-budgets` and commit the diff",
+            data={"section": "entries", "row": name}))
+
+    pallas_sanctioned = set(registry.expected_budget_rows("pallas_vmem"))
+    pallas_rows = set(ledger.get("pallas_vmem", {}))
+    for row in sorted(pallas_rows):
+        if row.split("/", 1)[0] not in pallas_sanctioned:
+            findings.append(Finding(
+                engine="registry", rule="orphan-budget", path=disp,
+                line=budgets_mod.budget_line(ledger_path, row),
+                message=f"pallas_vmem row '{row}' has no registered "
+                        f"Pallas entry prefix — prune it with a full "
+                        f"`--engine numerics --update-budgets` run",
+                data={"section": "pallas_vmem", "row": row}))
+    covered_prefixes = {r.split("/", 1)[0] for r in pallas_rows}
+    for name in sorted(pallas_sanctioned - covered_prefixes):
+        findings.append(Finding(
+            engine="registry", rule="missing-budget", path=disp, line=0,
+            message=f"registered Pallas entry '{name}' has no "
+                    f"pallas_vmem ledger rows — run `python -m "
+                    f"raft_tpu.analysis --engine numerics "
+                    f"--update-budgets` and commit the diff",
+            data={"section": "pallas_vmem", "row": name}))
+    return findings
+
+
+def orphan_rows(budgets_path: Optional[str] = None) -> Dict[str, List[str]]:
+    """The ``--prune-budgets`` dry-run payload: per section, the rows a
+    full ``--update-budgets`` run would drop."""
+    ledger = budgets_mod.load_budgets(budgets_path) or {}
+    entries = set(registry.expected_budget_rows("entries"))
+    pallas = set(registry.expected_budget_rows("pallas_vmem"))
+    return {
+        "entries": sorted(r for r in ledger.get("entries", {})
+                          if r not in entries),
+        "pallas_vmem": sorted(r for r in ledger.get("pallas_vmem", {})
+                              if r.split("/", 1)[0] not in pallas),
+    }
+
+
+# --------------------------------------------------------------------------
+# trace + participation checks
+# --------------------------------------------------------------------------
+
+def check_traces() -> Tuple[List[Finding], Dict]:
+    """Every registered entry must build and abstractly trace under its
+    declared mesh recipe.  Environment gaps (SkipEntry/ImportError)
+    degrade to notes, same as engines 2-4."""
+    import jax
+
+    findings: List[Finding] = []
+    timings: Dict[str, float] = {}
+    for name, entry in registry.ENTRYPOINTS.items():
+        t0 = time.monotonic()
+        try:
+            fn, args = entry.build()
+            with registry.trace_context(entry):
+                jax.eval_shape(fn, *args)
+        except registry.SkipEntry as e:
+            findings.append(Finding(
+                engine="registry", rule="entry-trace", path=name, line=0,
+                message=f"skipped: {e}", severity="note"))
+            continue
+        except ImportError as e:
+            findings.append(Finding(
+                engine="registry", rule="entry-trace", path=name, line=0,
+                message=f"skipped: unavailable here ({e})",
+                severity="note"))
+            continue
+        except Exception as e:
+            # ANY builder failure becomes an error finding naming the
+            # entry: no exception class may pass as "traces fine"
+            path, line = registry.entry_anchor(entry)
+            findings.append(Finding(
+                engine="registry", rule="entry-trace", path=path,
+                line=line,
+                message=f"registered entry '{name}' fails to trace: "
+                        f"{type(e).__name__}: {e} — the registry "
+                        f"promises every entry is lowerable; fix the "
+                        f"builder or unregister it",
+                data={"entry": name}))
+            continue
+        timings[name] = round(time.monotonic() - t0, 2)
+    return findings, {"traced": sorted(timings), "seconds": timings}
+
+
+def check_participation() -> List[Finding]:
+    """The engines' derived tables must match the registry's declared
+    participation, and every entry must be audited by SOMETHING."""
+    findings: List[Finding] = []
+
+    def mismatch(engine: str, declared: set, derived: set) -> None:
+        for name in sorted(declared - derived):
+            findings.append(Finding(
+                engine="registry", rule="engine-participation",
+                path="raft_tpu/entrypoints.py", line=0,
+                message=f"entry '{name}' declares {engine} "
+                        f"participation but the {engine} engine does "
+                        f"not enumerate it — its table was bypassed",
+                data={"engine": engine, "entry": name}))
+        for name in sorted(derived - declared):
+            findings.append(Finding(
+                engine="registry", rule="engine-participation",
+                path="raft_tpu/entrypoints.py", line=0,
+                message=f"the {engine} engine enumerates '{name}' but "
+                        f"no registry entry declares it — a "
+                        f"hand-maintained entry crept back into "
+                        f"analysis/",
+                data={"engine": engine, "entry": name}))
+
+    try:
+        from raft_tpu.analysis.hlo_audit import ENTRIES as HLO
+        from raft_tpu.analysis.jaxpr_audit import ENTRY_AUDITS
+        from raft_tpu.analysis.numerics_audit import ENTRIES as NUM
+    except Exception as e:
+        # an engine module that no longer imports (e.g. a registry
+        # audit kind without an implementation) is itself the finding
+        return [Finding(
+            engine="registry", rule="engine-participation",
+            path="raft_tpu/entrypoints.py", line=0,
+            message=f"an analysis engine failed to derive its table "
+                    f"from the registry: {type(e).__name__}: {e}")]
+
+    mismatch("hlo", set(registry.hlo_entries()), set(HLO))
+    mismatch("numerics", set(registry.numerics_entries()), set(NUM))
+    mismatch("jaxpr", set(registry.jaxpr_audit_names()),
+             set(ENTRY_AUDITS))
+    for name, entry in registry.ENTRYPOINTS.items():
+        if not (entry.jaxpr or entry.hlo or entry.numerics):
+            findings.append(Finding(
+                engine="registry", rule="engine-participation",
+                path="raft_tpu/entrypoints.py", line=0,
+                message=f"entry '{name}' participates in no analysis "
+                        f"engine — registered-but-unaudited is the "
+                        f"same hole as unregistered",
+                data={"entry": name}))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# waiver staleness
+# --------------------------------------------------------------------------
+
+def active_waiver_keys(paths: Sequence[str],
+                       extra_findings: Sequence[Finding] = ()
+                       ) -> Set[Tuple[str, int]]:
+    """``(abs_path, line)`` of every inline waiver currently
+    suppressing a finding — engine 1's rules plus this engine's
+    coverage findings (``extra_findings``).  ONE implementation shared
+    by :func:`check_waiver_staleness` and ``--list-waivers``'s activity
+    column, so the gate and the inventory can never disagree about
+    which waivers are alive."""
+    from raft_tpu.analysis.lint import run_lint
+
+    lint_findings = run_lint(paths)
+    active = {(os.path.abspath(f.path), f.line)
+              for f in lint_findings if f.waived}
+    # engine-5 findings carry repo-relative display paths (absolute
+    # when outside the repo): resolve against the repo root
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    active |= {(os.path.abspath(os.path.join(root, f.path)), f.line)
+               for f in extra_findings if f.waived}
+    return active
+
+
+def check_waiver_staleness(paths: Optional[Sequence[str]] = None,
+                           extra_findings: Sequence[Finding] = ()
+                           ) -> List[Finding]:
+    """``stale-waiver`` errors for inline waivers that no longer match
+    any finding at their line — from engine 1's rules or this engine's
+    coverage scan (``extra_findings``)."""
+    from raft_tpu.analysis.lint import iter_python_files, parse_waivers
+
+    if paths is None:
+        from raft_tpu.analysis.__main__ import default_paths
+
+        paths = default_paths()
+    active = active_waiver_keys(paths, extra_findings)
+    out: List[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        waivers, _ = parse_waivers(source, path)
+        for line, (rules, reason) in sorted(waivers.items()):
+            if (os.path.abspath(path), line) in active:
+                continue
+            out.append(Finding(
+                engine="registry", rule="stale-waiver",
+                path=budgets_mod.display_path(path), line=line,
+                message=f"waiver disable={','.join(sorted(rules))} no "
+                        f"longer matches any finding at this line — "
+                        f"the code moved or the issue was fixed; "
+                        f"delete the waiver (reason was: {reason})",
+                data={"rules": sorted(rules)}))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the audit
+# --------------------------------------------------------------------------
+
+def run_registry_audit(names: Optional[Sequence[str]] = None,
+                       paths: Optional[Sequence[str]] = None,
+                       budgets_path: Optional[str] = None
+                       ) -> Tuple[List[Finding], Dict]:
+    """Run the named sub-audits (default: all of :data:`CHECKS`).
+
+    ``paths`` scopes the coverage scan AND the waiver-staleness check
+    (tests point both at seeded fixture files); the default scans the
+    package for coverage and the full lint scope for waivers.
+    Returns ``(findings, report)``.
+    """
+    selected = set(CHECKS if names is None else names)
+    unknown = selected - set(CHECKS)
+    if unknown:
+        raise KeyError(f"unknown registry audit(s) {sorted(unknown)}; "
+                       f"known: {list(CHECKS)}")
+    findings: List[Finding] = []
+    report: Dict = {"entries": len(registry.ENTRYPOINTS)}
+
+    coverage: List[Finding] = []
+    if selected & {"coverage", "waivers"}:
+        # the waiver-staleness check needs the coverage findings even
+        # when only "waivers" is selected — an inline
+        # unregistered-entrypoint waiver is active exactly when the
+        # scan (waived-ly) fires at its line
+        t0 = time.monotonic()
+        coverage = scan_coverage(paths or default_scan_paths())
+        if "coverage" in selected:
+            findings.extend(coverage)
+            report["coverage"] = {
+                "call_sites_flagged": sum(1 for f in coverage
+                                          if not f.waived),
+                "waived": sum(1 for f in coverage if f.waived),
+                "seconds": round(time.monotonic() - t0, 2)}
+    if "budgets" in selected:
+        bf = check_budgets(budgets_path)
+        findings.extend(bf)
+        report["budgets"] = {
+            "orphans": [f.data["row"] for f in bf
+                        if f.rule == "orphan-budget"],
+            "missing": [f.data["row"] for f in bf
+                        if f.rule == "missing-budget" and f.data]}
+    if "participation" in selected:
+        findings.extend(check_participation())
+    if "trace" in selected:
+        tf, treport = check_traces()
+        findings.extend(tf)
+        report["trace"] = treport
+    if "waivers" in selected:
+        findings.extend(check_waiver_staleness(paths, coverage))
+    return findings, report

@@ -562,8 +562,10 @@ def abstract_device_aug(sparse: bool = False, batch: int = 2,
                         raw_hw: Tuple[int, int] = (96, 112),
                         crop: Tuple[int, int] = (64, 64),
                         wire_format: str = "int16"):
-    """The lowerable device-augmentation entry point for the
-    static-analysis engines: the real jitted graph over abstract inputs.
+    """The lowerable device-augmentation entry point behind the
+    ``device_aug``/``device_aug_sparse`` records in
+    ``raft_tpu/entrypoints.py``: the real jitted graph over abstract
+    inputs.
 
     Returns ``(fn, (batch_sds,))`` with ``fn`` supporting ``.lower()``.
     The default int16 wire covers the decode/encode twins the production

@@ -1,0 +1,518 @@
+"""The first-class entry-point registry: every lowerable graph, in one
+table.
+
+Everything in this package that reaches XLA — ``jax.jit`` / ``pjit`` /
+``pallas_call`` / ``shard_map`` — lowers through one of the
+``abstract_*`` builders the production modules expose, and every one of
+those builders is registered HERE, as data: its name, its builder (the
+abstract, never-allocating build the analysis engines trace and
+compile), its mesh recipe, its budgets.json participation, its
+engine-participation flags, and — for the AOT-cached serving/eval
+graphs — the cache-key recipe.
+
+Consumers (none of them keeps a hand-maintained entry list anymore):
+
+- **graftlint engine 2** (``analysis/jaxpr_audit.py``) derives its
+  audit set from each entry's ``jaxpr`` audit kinds;
+- **engine 3** (``analysis/hlo_audit.py``) compiles every ``hlo=True``
+  entry and budget-gates the ``budgeted`` ones against the
+  ``entries`` section of ``analysis/budgets.json``;
+- **engine 4** (``analysis/numerics_audit.py``) abstract-interprets
+  every ``numerics=True`` entry (``deep`` selects the rule set,
+  ``ranges`` names the input-spec recipe) and runs the Pallas verifier
+  over ``pallas=True`` entries (the ``pallas_vmem`` ledger section);
+- **engine 5** (``analysis/registry_audit.py``) is the structural
+  coverage auditor: every ``jit``/``pallas_call``/``shard_map`` call
+  site in the package must be reachable from a registered entry, every
+  budgets.json row must map back to one, every entry must trace, and
+  the engines' derived tables must match the declared participation;
+- the **serve/eval AOT caches** key executables with
+  :func:`forward_cache_key` / :func:`arg_signature` — defined here,
+  once, so the two cache consumers (``serve/engine.py``,
+  ``evaluation/evaluate.py``) can never drift again;
+- **bench.py** tags its scoreboard lanes with the registry entries
+  they exercise (:func:`bench_lanes`).
+
+Adding a new kernel or workload is ONE entry here: audits, budgets,
+coverage and cache keying follow structurally.  This module imports no
+jax at module scope — builders import lazily — so the registry is
+readable from jax-free contexts (the budgets cross-check, the AST
+coverage scan, ``--prune-budgets``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+# --------------------------------------------------------------------------
+# shared structural vocabulary (engine 3 imports these back)
+# --------------------------------------------------------------------------
+
+# Every HLO opcode that moves data across devices.  "-start" variants
+# cover async-split collectives (TPU); the matching "-done" ops carry no
+# second transfer and are not counted.
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "all-to-all", "collective-permute",
+    "reduce-scatter", "collective-broadcast", "all-reduce-start",
+    "all-gather-start", "collective-permute-start", "ragged-all-to-all",
+)
+
+# forbid-list for single-device entries: no collective of any kind
+NO_COLLECTIVES = COLLECTIVE_KINDS
+
+# The audit mesh recipe: the (axis, size) shape every sharded entry is
+# audited under — 8 virtual CPU devices, the same mesh
+# ``parallel.mesh.virtual_device_mesh`` builds and tests/conftest force.
+AUDIT_MESH = (("data", 2), ("spatial", 4))
+
+
+class SkipEntry(Exception):
+    """Raised by a builder whose environment prerequisite is absent
+    (too few devices, pallas unavailable); engines report a note
+    instead of a finding."""
+
+
+def audit_mesh():
+    """The 8-device virtual audit mesh, or :class:`SkipEntry`."""
+    import jax
+
+    from raft_tpu.parallel.mesh import virtual_device_mesh
+
+    mesh = virtual_device_mesh(**{ax: n for ax, n in AUDIT_MESH})
+    if mesh is None:
+        raise SkipEntry(
+            f"needs 8 devices, have {jax.device_count()} (run via "
+            f"`python -m raft_tpu.analysis`, which forces 8 virtual "
+            f"CPU devices)")
+    return mesh
+
+
+# --------------------------------------------------------------------------
+# the AOT cache-key recipe (single definition — serve/engine.py and
+# evaluation/evaluate.py import these; a key missing a field that
+# affects the lowered graph would serve a stale executable)
+# --------------------------------------------------------------------------
+
+def arg_signature(*args) -> tuple:
+    """((shape, dtype-str), ...) over the non-weight inputs — the
+    executable-signature half of an AOT cache key, and the memo-key
+    form compiled (signature-exact) executables demand."""
+    import numpy as np
+
+    return tuple((tuple(np.shape(a)),
+                  str(getattr(a, "dtype", np.asarray(a).dtype)))
+                 for a in args)
+
+
+def tree_signature(variables) -> str:
+    """Shape/dtype signature of the weight tree — executables take the
+    weights as an ARGUMENT, so the cache key needs the tree's structure
+    and leaf types, never its values (a new checkpoint of the same
+    architecture warm-hits)."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(variables)[0]
+    return ";".join(
+        f"{jax.tree_util.keystr(path)}:{getattr(v, 'shape', ())}:"
+        f"{getattr(v, 'dtype', type(v).__name__)}"
+        for path, v in leaves)
+
+
+def forward_cache_key(tag: str, model, var_sig: str, arg_sig,
+                      iters: int, warm: bool) -> str:
+    """THE AOT-cache key recipe for a compiled test-mode forward —
+    every consumer (the serving executors, the Evaluator's AOT path)
+    assembles keys through this one function.  ``arg_sig`` is
+    :func:`arg_signature` over EVERY non-weight input (both images,
+    plus flow_init when warm); ``tag`` namespaces the consumer (the
+    registry entry's ``cache_tag``)."""
+    from raft_tpu.serve.aot import cache_key
+    from raft_tpu.training.state import config_fingerprint
+
+    return cache_key(tag, config_fingerprint(model.cfg), var_sig,
+                     tuple(arg_sig), int(iters), bool(warm))
+
+
+# --------------------------------------------------------------------------
+# the registry
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """One registered lowerable graph.
+
+    ``build`` is the canonical abstract build — ``() -> (fn, args)``
+    with ``fn`` supporting ``.lower(*args)`` — the one engines 2/4/5
+    trace.  ``hlo_build`` optionally overrides it for engine 3's
+    compiles (e.g. the ``small`` model, donation, grad-free kernels) so
+    compile cost stays bounded without changing what gets traced.
+    """
+
+    name: str
+    # (module, attr) of the production builder: where program-level
+    # findings anchor, and an engine-5 coverage root
+    anchor: Tuple[str, str]
+    build: Callable[[], tuple]
+    hlo_build: Optional[Callable[[], tuple]] = None
+    # extra engine-5 reachability roots ("function name" granularity)
+    # for call sites the anchor's call graph cannot reach
+    covers: Tuple[str, ...] = ()
+    # mesh recipe: build under the AUDIT_MESH virtual mesh, and trace
+    # inside ``set_mesh`` (builders raise SkipEntry when it's absent)
+    needs_mesh: bool = False
+    # --- engine participation -------------------------------------------
+    jaxpr: Tuple[str, ...] = ()   # engine-2 audit kinds tracing this entry
+    hlo: bool = False             # engine 3 compiles it
+    numerics: bool = False        # engine 4 interprets it
+    pallas: bool = False          # engine 4's Pallas verifier walks it
+    # --- budgets.json participation -------------------------------------
+    budgeted: bool = True         # measurements may enter the ledger
+    # --- engine-3 structural facts --------------------------------------
+    donated: bool = False
+    forbid: Tuple[str, ...] = NO_COLLECTIVES
+    require: Tuple[str, ...] = ()
+    # --- engine-4 facts --------------------------------------------------
+    deep: bool = False            # DEEP_RULES (skip vacuous overflow proof)
+    ranges: str = "declared"      # input-spec recipe name (numerics_audit)
+    # --- AOT cache participation ----------------------------------------
+    cache_tag: Optional[str] = None  # forward_cache_key namespace
+    # --- bench participation --------------------------------------------
+    bench_lane: Optional[str] = None  # scoreboard lane exercising this graph
+
+    @property
+    def budget_sections(self) -> Tuple[str, ...]:
+        """The budgets.json sections this entry owns rows in."""
+        if not self.budgeted:
+            return ()
+        sections = ()
+        if self.hlo:
+            sections += ("entries",)
+        if self.pallas:
+            sections += ("pallas_vmem",)
+        return sections
+
+
+def resolve_anchor(entry: EntryPoint):
+    """The production builder object behind ``entry.anchor``."""
+    import importlib
+
+    return getattr(importlib.import_module(entry.anchor[0]),
+                   entry.anchor[1])
+
+
+def trace_context(entry: EntryPoint):
+    """The context to trace/interpret ``entry`` under: ``set_mesh`` of
+    the audit mesh for sharded entries, a no-op otherwise."""
+    import contextlib
+
+    if not entry.needs_mesh:
+        return contextlib.nullcontext()
+    from raft_tpu.parallel.mesh import set_mesh
+
+    return set_mesh(audit_mesh())
+
+
+# -- builders (the canonical abstract builds; all imports lazy) ------------
+
+def _build_train_step():
+    from raft_tpu.training.step import abstract_train_step
+
+    # add_noise=True covers the widest trace (the noise path is where
+    # dtype-less random draws would hide)
+    return abstract_train_step(iters=2, add_noise=True)
+
+
+def _hlo_train_step():
+    from raft_tpu.training.step import abstract_train_step
+
+    # `small` keeps the compile ~20 s; donation/collective/churn facts
+    # are structural and identical on the large model (which engine 2
+    # traces)
+    return abstract_train_step(iters=2, donate=True,
+                               overrides={"small": True})
+
+
+def _build_train_step_bf16():
+    from raft_tpu.training.step import abstract_train_step
+
+    return abstract_train_step(
+        iters=2,
+        overrides={"compute_dtype": "bfloat16", "corr_dtype": "bfloat16"})
+
+
+def _build_parallel_step():
+    from raft_tpu.parallel.step import abstract_parallel_step
+
+    return abstract_parallel_step(audit_mesh(), iters=2)
+
+
+def _hlo_parallel_step():
+    from raft_tpu.parallel.step import abstract_parallel_step
+
+    return abstract_parallel_step(
+        audit_mesh(), iters=2,
+        overrides={"small": True, "corr_shard": True}, shard_inputs=True)
+
+
+def _build_eval_forward():
+    from raft_tpu.evaluation.evaluate import abstract_eval_forward
+
+    return abstract_eval_forward(iters=2)
+
+
+def _build_eval_forward_bf16():
+    # the entry with real f32<->bf16 boundary crossings: its
+    # convert_f32_bf16 bound is the churn gate (a policy change that
+    # starts bouncing activations between dtypes shows up here first)
+    from raft_tpu.evaluation.evaluate import abstract_eval_forward
+
+    return abstract_eval_forward(
+        iters=2, overrides={"compute_dtype": "bfloat16",
+                            "corr_dtype": "bfloat16"})
+
+
+def _build_serve_forward():
+    from raft_tpu.serve.engine import abstract_serve_forward
+
+    return abstract_serve_forward(iters=2)
+
+
+def _build_serve_forward_warm():
+    # the video-mode variant: an extra (B, H/8, W/8, 2) flow_init input
+    # and the warm-start add on the scan carry only exist in THIS graph
+    from raft_tpu.serve.engine import abstract_serve_forward
+
+    return abstract_serve_forward(iters=2, warm=True)
+
+
+def _build_corr_dense():
+    from raft_tpu.ops.corr import abstract_corr_lookup
+
+    return abstract_corr_lookup("dense")
+
+
+def _build_corr_chunked():
+    from raft_tpu.ops.corr import abstract_corr_lookup
+
+    return abstract_corr_lookup("chunked")
+
+
+def _build_corr_pallas():
+    # grad=True so the numerics/Pallas pass covers the backward kernels
+    from raft_tpu.ops.corr_pallas import abstract_ondemand_lookup
+
+    return abstract_ondemand_lookup(grad=True)
+
+
+def _hlo_corr_pallas():
+    from raft_tpu.ops.corr_pallas import abstract_ondemand_lookup
+
+    return abstract_ondemand_lookup()
+
+
+def _build_pyramid_pallas():
+    from raft_tpu.ops.corr_pallas import abstract_pyramid_lookup
+
+    return abstract_pyramid_lookup(grad=True)
+
+
+def _build_pyramid_pallas_stacked():
+    from raft_tpu.ops.corr_pallas import abstract_pyramid_lookup
+
+    return abstract_pyramid_lookup(stacked=True, grad=True)
+
+
+def _build_corr_ring():
+    from raft_tpu.parallel.ring import abstract_ring_lookup
+
+    return abstract_ring_lookup(audit_mesh())
+
+
+def _build_device_aug():
+    from raft_tpu.data.device_aug import abstract_device_aug
+
+    return abstract_device_aug(sparse=False)
+
+
+def _build_device_aug_sparse():
+    from raft_tpu.data.device_aug import abstract_device_aug
+
+    return abstract_device_aug(sparse=True, wire_format="f32")
+
+
+ENTRYPOINTS: Dict[str, EntryPoint] = {e.name: e for e in (
+    EntryPoint(
+        "train_step",
+        anchor=("raft_tpu.training.step", "abstract_train_step"),
+        build=_build_train_step, hlo_build=_hlo_train_step,
+        jaxpr=("train_step", "donation"), hlo=True, numerics=True,
+        donated=True, deep=True, bench_lane="device"),
+    EntryPoint(
+        "train_step_bf16",
+        anchor=("raft_tpu.training.step", "abstract_train_step"),
+        build=_build_train_step_bf16,
+        jaxpr=("bf16_policy",), numerics=True, deep=True),
+    EntryPoint(
+        "parallel_step",
+        anchor=("raft_tpu.parallel.step", "abstract_parallel_step"),
+        build=_build_parallel_step, hlo_build=_hlo_parallel_step,
+        needs_mesh=True,
+        jaxpr=("parallel_step",), hlo=True, numerics=True,
+        # all-reduce (gradients) and the spatial path's legitimate
+        # resharding traffic are ledger-pinned EXACTLY; all-to-all has
+        # no sanctioned source in this program, so it is forbidden
+        # structurally on top of the ledger
+        forbid=("all-to-all", "ragged-all-to-all"), deep=True),
+    EntryPoint(
+        "eval_forward",
+        anchor=("raft_tpu.evaluation.evaluate", "abstract_eval_forward"),
+        build=_build_eval_forward,
+        jaxpr=("eval_forward",), hlo=True, numerics=True, deep=True,
+        cache_tag="eval_forward"),
+    EntryPoint(
+        "eval_forward_bf16",
+        anchor=("raft_tpu.evaluation.evaluate", "abstract_eval_forward"),
+        build=_build_eval_forward_bf16, hlo=True),
+    EntryPoint(
+        "serve_forward",
+        anchor=("raft_tpu.serve.engine", "abstract_serve_forward"),
+        build=_build_serve_forward,
+        jaxpr=("serve_forward",), hlo=True, numerics=True, deep=True,
+        cache_tag="serve_forward", bench_lane="serve"),
+    EntryPoint(
+        "serve_forward_warm",
+        anchor=("raft_tpu.serve.engine", "abstract_serve_forward"),
+        build=_build_serve_forward_warm,
+        jaxpr=("serve_forward",), hlo=True, numerics=True, deep=True,
+        cache_tag="serve_forward"),
+    EntryPoint(
+        "corr_lookup_dense",
+        anchor=("raft_tpu.ops.corr", "abstract_corr_lookup"),
+        build=_build_corr_dense,
+        jaxpr=("corr_lookups",), hlo=True, numerics=True, ranges="fmap"),
+    EntryPoint(
+        "corr_lookup_chunked",
+        anchor=("raft_tpu.ops.corr", "abstract_corr_lookup"),
+        build=_build_corr_chunked,
+        jaxpr=("corr_lookups",), hlo=True, numerics=True, ranges="fmap"),
+    EntryPoint(
+        "corr_lookup_pallas",
+        anchor=("raft_tpu.ops.corr_pallas", "abstract_ondemand_lookup"),
+        build=_build_corr_pallas, hlo_build=_hlo_corr_pallas,
+        jaxpr=("corr_lookups",), hlo=True, numerics=True, pallas=True,
+        ranges="fmap"),
+    EntryPoint(
+        "corr_pyramid_pallas",
+        anchor=("raft_tpu.ops.corr_pallas", "abstract_pyramid_lookup"),
+        build=_build_pyramid_pallas,
+        numerics=True, pallas=True, ranges="fmap"),
+    EntryPoint(
+        "corr_pyramid_pallas_stacked",
+        anchor=("raft_tpu.ops.corr_pallas", "abstract_pyramid_lookup"),
+        build=_build_pyramid_pallas_stacked,
+        numerics=True, pallas=True, ranges="fmap"),
+    EntryPoint(
+        "corr_ring",
+        anchor=("raft_tpu.parallel.ring", "abstract_ring_lookup"),
+        build=_build_corr_ring, needs_mesh=True, hlo=True,
+        forbid=("all-gather", "all-gather-start", "all-to-all",
+                "ragged-all-to-all"),
+        require=("collective-permute",)),
+    # the h2d-lane augmentation graphs (data/device_aug.py): strictly
+    # single-device programs — any collective means a sharding
+    # annotation leaked into the input pipeline
+    EntryPoint(
+        "device_aug",
+        anchor=("raft_tpu.data.device_aug", "abstract_device_aug"),
+        build=_build_device_aug,
+        jaxpr=("device_aug",), hlo=True, numerics=True,
+        ranges="device_aug", bench_lane="fed"),
+    EntryPoint(
+        "device_aug_sparse",
+        anchor=("raft_tpu.data.device_aug", "abstract_device_aug"),
+        build=_build_device_aug_sparse,
+        jaxpr=("device_aug",), hlo=True, numerics=True,
+        ranges="device_aug"),
+)}
+
+# Engine-2 report-only audits that are not entry points (they audit
+# config data, not a lowerable graph) but still run with the engine.
+JAXPR_REPORTS: Tuple[str, ...] = ("recompile_keys",)
+
+
+# --------------------------------------------------------------------------
+# derived views (what the engines enumerate)
+# --------------------------------------------------------------------------
+
+def jaxpr_audit_names() -> List[str]:
+    """Engine-2 audit kinds, in registry order, plus the report-only
+    audits — the exact key order of ``jaxpr_audit.ENTRY_AUDITS``."""
+    names: List[str] = []
+    for e in ENTRYPOINTS.values():
+        for a in e.jaxpr:
+            if a not in names:
+                names.append(a)
+    names.extend(JAXPR_REPORTS)
+    return names
+
+
+def hlo_entries() -> Dict[str, EntryPoint]:
+    return {n: e for n, e in ENTRYPOINTS.items() if e.hlo}
+
+
+def numerics_entries() -> Dict[str, EntryPoint]:
+    return {n: e for n, e in ENTRYPOINTS.items() if e.numerics}
+
+
+def pallas_entries() -> Dict[str, EntryPoint]:
+    return {n: e for n, e in ENTRYPOINTS.items() if e.pallas}
+
+
+def expected_budget_rows(section: str) -> List[str]:
+    """Registry-sanctioned row names (entry names for ``entries``,
+    ``entry/`` prefixes for ``pallas_vmem``) — what engine 5's ledger
+    cross-check and ``--update-budgets`` pruning key on."""
+    if section == "entries":
+        return [n for n, e in ENTRYPOINTS.items()
+                if e.hlo and e.budgeted]
+    if section == "pallas_vmem":
+        return [n for n, e in ENTRYPOINTS.items()
+                if e.pallas and e.budgeted]
+    raise KeyError(f"unknown budgets section {section!r}")
+
+
+def coverage_roots() -> List[str]:
+    """Function names engine-5's reachability scan starts from: every
+    entry's anchor attr plus its declared extra ``covers`` roots."""
+    roots: List[str] = []
+    for e in ENTRYPOINTS.values():
+        for name in (e.anchor[1],) + e.covers:
+            if name not in roots:
+                roots.append(name)
+    return roots
+
+
+def bench_lanes() -> Dict[str, str]:
+    """Scoreboard lane -> registry entry whose graph the lane measures
+    (bench.py stamps this mapping into its JSON line)."""
+    return {e.bench_lane: n for n, e in ENTRYPOINTS.items()
+            if e.bench_lane}
+
+
+def entry_anchor(entry: EntryPoint) -> Tuple[str, int]:
+    """(repo-relative file, def line) of the entry's production builder
+    — where a program-level finding points."""
+    import importlib
+    import inspect
+
+    from raft_tpu.analysis.budgets import display_path
+
+    try:
+        mod = importlib.import_module(entry.anchor[0])
+        fn = getattr(mod, entry.anchor[1])
+        path = inspect.getsourcefile(fn)
+        line = inspect.getsourcelines(fn)[1]
+        return display_path(path), line
+    except (ImportError, AttributeError, OSError, TypeError):
+        return entry.anchor[0].replace(".", "/") + ".py", 0

@@ -73,13 +73,14 @@ class Evaluator:
         cold-vs-warm startup cost."""
         import time
 
-        from raft_tpu.serve.engine import (_tree_signature, arg_signature,
-                                           compile_test_forward,
-                                           forward_cache_key)
+        from raft_tpu.entrypoints import (arg_signature,
+                                          forward_cache_key,
+                                          tree_signature)
+        from raft_tpu.serve.engine import compile_test_forward
 
         model = self.model
         if self._var_sig is None:
-            self._var_sig = _tree_signature(self.variables)
+            self._var_sig = tree_signature(self.variables)
         sds = lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
         args = (image1, image2) + ((flow_init,) if warm else ())
         dkey = forward_cache_key("eval_forward", model, self._var_sig,
@@ -108,7 +109,8 @@ class Evaluator:
         # loads signature-exact compiled executables (jit would retrace
         # on a changed image2/flow_init signature; a compiled
         # executable must be keyed on the full call signature)
-        from raft_tpu.serve.engine import arg_signature, make_test_forward
+        from raft_tpu.entrypoints import arg_signature
+        from raft_tpu.serve.engine import make_test_forward
 
         key = (arg_signature(*((image1, image2)
                                + ((flow_init,) if warm else ()))),
@@ -141,9 +143,11 @@ class Evaluator:
 def abstract_eval_forward(iters: int = 2, hw=(64, 64),
                           overrides: Dict = None):
     """The Evaluator's jitted batch-1 test_mode forward over abstract
-    inputs: the lowerable entry point the static-analysis engines audit
-    (exactly the cold-path ``jax.jit`` the shape-bucket cache compiles,
-    built without an Evaluator or real weights).
+    inputs: the lowerable entry point behind the
+    ``eval_forward``/``eval_forward_bf16`` records in
+    ``raft_tpu/entrypoints.py`` (exactly the cold-path ``jax.jit`` the
+    shape-bucket cache compiles, built without an Evaluator or real
+    weights).
 
     Returns ``(fwd, (variables_sds, img1_sds, img2_sds))`` with ``fwd``
     supporting ``.lower()``.

@@ -529,7 +529,10 @@ def chunked_corr_lookup(fmap1: jax.Array, fmap2_pyramid: Sequence[jax.Array],
 def abstract_corr_lookup(kind: str = "dense", batch: int = 1, hw=(8, 8),
                          channels: int = 16, radius: int = 4,
                          num_levels: int = 4, chunk: int = 32):
-    """Lowerable corr-lookup entry points for the static-analysis engines.
+    """Lowerable corr-lookup entry points behind the
+    ``corr_lookup_dense``/``corr_lookup_chunked`` records in
+    ``raft_tpu/entrypoints.py`` (the registry the analysis engines and
+    the engine-5 coverage scan iterate).
 
     ``kind``: ``dense`` (direct matmul pyramid + windowed lookup — the
     all-pairs training path) or ``chunked`` (the on-demand O(H*W) path).

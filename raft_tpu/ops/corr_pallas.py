@@ -1517,8 +1517,9 @@ pyramid_window_lookup.defvjp(_pyr_lookup_fwd, _pyr_lookup_bwd)
 def abstract_ondemand_lookup(batch: int = 1, hw=(8, 8), channels: int = 16,
                              radius: int = 4, num_levels: int = 4,
                              grad: bool = False):
-    """Lowerable Pallas-lookup entry point for the static-analysis
-    engines.  Off-TPU this lowers through the kernel's interpret-mode
+    """Lowerable Pallas-lookup entry point behind the
+    ``corr_lookup_pallas`` record in ``raft_tpu/entrypoints.py``.
+    Off-TPU this lowers through the kernel's interpret-mode
     fallback (``_on_tpu`` dispatch), which is exactly what CPU callers
     of ``corr_impl="ondemand"`` execute — so the audit covers the
     fallback path's lowering, while Mosaic-specific behavior stays a
@@ -1557,7 +1558,9 @@ def abstract_pyramid_lookup(stacked: bool = False, grad: bool = True,
                             radius: int = 4, num_levels: int = 4,
                             q_tile: int = 64):
     """Lowerable dense-pyramid fused-lookup entry point (the all-pairs
-    training path's Pallas kernels) for the static-analysis engines.
+    training path's Pallas kernels) behind the
+    ``corr_pyramid_pallas``/``corr_pyramid_pallas_stacked`` records in
+    ``raft_tpu/entrypoints.py``.
 
     ``stacked=False`` builds the padded per-level pyramid and rides
     ``pyramid_window_lookup`` (one launch per level);  ``stacked=True``

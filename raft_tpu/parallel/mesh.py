@@ -88,10 +88,13 @@ def virtual_device_mesh(data: int = 2, spatial: int = 4) -> Optional[Mesh]:
     """The audit/test mesh, or None when the backend has too few devices.
 
     Single source of the (data=2, spatial=4) harness mesh the graftlint
-    jaxpr/HLO engines and the sharding tests lower against; callers that
-    get None report a skip note instead of failing (the 8 virtual CPU
-    devices come from ``xla_force_host_platform_device_count``, which
-    ``python -m raft_tpu.analysis`` and tests/conftest.py both force).
+    engines and the sharding tests lower against — the registry's
+    ``AUDIT_MESH`` recipe (``raft_tpu/entrypoints.py``) resolves here,
+    and mesh-needing entries raise ``SkipEntry`` through
+    ``entrypoints.audit_mesh`` when this returns None (the 8 virtual
+    CPU devices come from ``xla_force_host_platform_device_count``,
+    which ``python -m raft_tpu.analysis`` and tests/conftest.py both
+    force).
     """
     if jax.device_count() < data * spatial:
         return None

@@ -129,12 +129,14 @@ def ring_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array, mesh: Mesh,
 def abstract_ring_lookup(mesh: Mesh, batch: int = 2, hw=(8, 16),
                          channels: int = 16, radius: int = 4,
                          num_levels: int = 4):
-    """Lowerable ring-corr entry point for the static-analysis engines:
-    ring-rotated volume + query-sharded windowed lookup, the exact path
-    ``corr_shard_impl="ring"`` runs inside the model.  The HLO auditor
-    asserts its lowering rides ``collective-permute`` (the ring hops)
-    and nothing else — a ring that degenerates into all-gathers has
-    silently lost its O(H*W) memory guarantee.
+    """Lowerable ring-corr entry point behind the ``corr_ring`` record
+    in ``raft_tpu/entrypoints.py``: ring-rotated volume + query-sharded
+    windowed lookup, the exact path ``corr_shard_impl="ring"`` runs
+    inside the model.  The registry declares the structural contract
+    the HLO auditor enforces — the lowering MUST ride
+    ``collective-permute`` (the ring hops) and must not all-gather: a
+    ring that degenerates into all-gathers has silently lost its
+    O(H*W) memory guarantee.
 
     Shapes default to the smallest config whose query count divides the
     mesh's ``spatial`` axis and whose batch divides ``data``.

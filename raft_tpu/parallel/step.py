@@ -108,7 +108,9 @@ def abstract_parallel_step(mesh: Mesh, iters: int = 2,
                            max_flow: float = 400.0,
                            shard_inputs: bool = False):
     """The sharded train step over abstract inputs on ``mesh``: the
-    lowerable entry point the static-analysis engines audit.
+    lowerable entry point behind the ``parallel_step`` record in
+    ``raft_tpu/entrypoints.py`` (its mesh recipe is the registry's
+    ``AUDIT_MESH``; engine 5 verifies it traces).
 
     ``shard_inputs=True`` jits with the production placements (state
     replicated, batch sharded over ``data`` — exactly what

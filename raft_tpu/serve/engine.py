@@ -22,9 +22,11 @@ warm-restart path.  The model runs the bf16 inference policy
 optimizer to protect and flow leaves the graph f32 either way (the
 declared boundary the graftlint engines pin).
 
-``abstract_serve_forward`` is the lowerable entry point the four
-static-analysis engines audit — exactly the graph ``ServeEngine``
-compiles, built without weights or an engine instance.
+``abstract_serve_forward`` is the lowerable entry point behind the
+``serve_forward``/``serve_forward_warm`` records in
+``raft_tpu/entrypoints.py`` — exactly the graph ``ServeEngine``
+compiles, built without weights or an engine instance, audited by all
+five static-analysis engines.
 """
 
 from __future__ import annotations
@@ -34,6 +36,14 @@ import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+# The AOT cache-key recipe lives ON THE REGISTRY
+# (raft_tpu/entrypoints.py) — one definition, imported by both cache
+# consumers (these serving executors and the Evaluator's AOT path), so
+# the two can never drift again.  Re-exported here because this module
+# remains the conventional import site.
+from raft_tpu.entrypoints import (arg_signature, forward_cache_key,  # noqa: F401
+                                  tree_signature as _tree_signature)
 
 logger = logging.getLogger(__name__)
 
@@ -120,32 +130,6 @@ def abstract_serve_forward(iters: int = 2, hw: Tuple[int, int] = (64, 64),
     return fwd, (variables_sds, img_sds, img_sds)
 
 
-def arg_signature(*args) -> tuple:
-    """((shape, dtype-str), ...) over the non-weight inputs — the
-    executable-signature half of an AOT cache key, and the memo-key
-    form compiled (signature-exact) executables demand."""
-    import numpy as np
-
-    return tuple((tuple(np.shape(a)),
-                  str(getattr(a, "dtype", np.asarray(a).dtype)))
-                 for a in args)
-
-
-def forward_cache_key(tag: str, model, var_sig: str, arg_sig,
-                      iters: int, warm: bool) -> str:
-    """The AOT-cache key recipe for a :func:`compile_test_forward`
-    executable — defined NEXT to the build so the two can never drift
-    (a key missing a field that affects the lowered graph would serve
-    a stale executable).  ``arg_sig`` is :func:`arg_signature` over
-    EVERY non-weight input (both images, plus flow_init when warm);
-    ``tag`` namespaces the consumer."""
-    from raft_tpu.serve.aot import cache_key
-    from raft_tpu.training.state import config_fingerprint
-
-    return cache_key(tag, config_fingerprint(model.cfg), var_sig,
-                     tuple(arg_sig), int(iters), bool(warm))
-
-
 def make_test_forward(model, iters: int, warm: bool):
     """THE jitted test_mode forward (cold, or the ``flow_init``
     warm-start variant) — single definition shared by the serving
@@ -171,20 +155,6 @@ def compile_test_forward(model, variables, img1_sds, img2_sds,
         return fn.lower(variables, img1_sds, img2_sds,
                         flow_sds).compile()
     return fn.lower(variables, img1_sds, img2_sds).compile()
-
-
-def _tree_signature(variables) -> str:
-    """Shape/dtype signature of the weight tree — executables take the
-    weights as an ARGUMENT, so the cache key needs the tree's structure
-    and leaf types, never its values (a new checkpoint of the same
-    architecture warm-hits)."""
-    import jax
-
-    leaves = jax.tree_util.tree_flatten_with_path(variables)[0]
-    return ";".join(
-        f"{jax.tree_util.keystr(path)}:{getattr(v, 'shape', ())}:"
-        f"{getattr(v, 'dtype', type(v).__name__)}"
-        for path, v in leaves)
 
 
 class ServeEngine:

@@ -234,11 +234,12 @@ def abstract_train_step(iters: int = 2, donate: bool = False,
                         hw: Tuple[int, int] = (64, 64),
                         gamma: float = 0.8, max_flow: float = 400.0):
     """The real jitted train step over abstract inputs: the lowerable
-    entry point the static-analysis engines audit (jaxpr invariants,
-    HLO collective/cost budgets) instead of reaching into private
-    helpers.  Everything is abstract — ``jax.eval_shape`` builds the
-    train state, the batch is ShapeDtypeStructs — so calling this never
-    allocates or computes.
+    entry point behind the ``train_step``/``train_step_bf16`` records
+    in ``raft_tpu/entrypoints.py`` (the registry every static-analysis
+    engine, budget ledger and coverage scan iterates — new builders
+    must register there).  Everything is abstract — ``jax.eval_shape``
+    builds the train state, the batch is ShapeDtypeStructs — so calling
+    this never allocates or computes.
 
     Returns ``(step, (state_sds, batch_sds))`` where ``step`` is the
     jit-wrapped train step (supports ``.lower()``) and the args are the

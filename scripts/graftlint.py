@@ -1,24 +1,31 @@
 #!/usr/bin/env python
-"""graftlint gate: all four analysis engines, exit nonzero on findings.
+"""graftlint gate: all five analysis engines, exit nonzero on findings.
 
 Thin wrapper over ``python -m raft_tpu.analysis`` so CI lanes and
 pre-push hooks have a stable entry point:
 
-    python scripts/graftlint.py                   # full gate: lint + jaxpr + hlo + numerics
+    python scripts/graftlint.py                   # full gate: lint + jaxpr + hlo + numerics + registry
     python scripts/graftlint.py --engine lint     # sub-second, jax-free
     python scripts/graftlint.py --engine numerics # dtype/range + Pallas verifier
+    python scripts/graftlint.py --engine registry # entry-point coverage vs entrypoints.py
     python scripts/graftlint.py --json            # machine-readable
     python scripts/graftlint.py --list-waivers    # waiver inventory
 
-The full gate fans the four engines out as PARALLEL subprocesses —
+The full gate fans the five engines out as PARALLEL subprocesses —
 they are independent (each forces its own 8-virtual-device CPU
 backend), so the wall clock is max(engine) rather than sum(engine):
-the HLO engine's compiles dominate (numerics traces in ~25-40 s),
-keeping the whole gate around ~100 s wall vs ~130 s serial and inside
-the tier-1 timeout budget.  A per-engine timing line is printed
-either way.  Any other flag combination (a single --engine,
---update-budgets, --list-waivers, explicit paths) delegates to the
-module CLI in-process.
+the HLO engine's compiles dominate (numerics traces in ~25-40 s, the
+registry auditor ~20 s), keeping the whole gate around ~100 s wall vs
+~150 s serial and inside the tier-1 timeout budget.  A per-engine
+timing line is printed either way.  Any other flag combination (a
+single --engine, --update-budgets, --list-waivers, explicit paths)
+delegates to the module CLI in-process.
+
+Every engine subprocess runs under a timeout (default 600 s; override
+with ``RAFT_GRAFTLINT_ENGINE_TIMEOUT`` seconds): a wedged engine (a
+hung compile, a deadlocked backend) is killed and reported as a typed
+``engine-timeout`` finding with a nonzero exit instead of hanging the
+whole gate to the tier-1 ceiling.
 
 Exit code 0 = clean (all remaining findings carry waivers with
 reasons); 1 = at least one unwaived finding; 2 = usage error.  See
@@ -35,7 +42,13 @@ import time
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
-ENGINES = ("lint", "jaxpr", "hlo", "numerics")
+ENGINES = ("lint", "jaxpr", "hlo", "numerics", "registry")
+
+# Per-engine subprocess budget, measured from the common spawn point.
+# Generous vs the slowest engine (hlo ~100 s): tripping it means a
+# WEDGED engine, not a slow one.
+ENGINE_TIMEOUT_S = float(os.environ.get(
+    "RAFT_GRAFTLINT_ENGINE_TIMEOUT", "600"))
 
 
 def parallel_gate(json_out: bool, verbose: bool) -> int:
@@ -55,7 +68,29 @@ def parallel_gate(json_out: bool, verbose: bool) -> int:
     }
     findings, report, timings, rc_usage = [], {}, {}, 0
     for engine, proc in procs.items():
-        out, err = proc.communicate()
+        # all engines started together at t0, so each one's budget is
+        # the remainder of the shared deadline — a wedged engine gets
+        # killed and typed instead of hanging the gate
+        remaining = max(0.0, t0 + ENGINE_TIMEOUT_S - time.monotonic())
+        try:
+            out, err = proc.communicate(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+            print(f"graftlint: engine {engine} exceeded its "
+                  f"{ENGINE_TIMEOUT_S:.0f}s timeout and was killed:\n"
+                  f"{err[-2000:]}", file=sys.stderr)
+            findings.append(fmod.Finding(
+                engine=engine, rule="engine-timeout", path=engine,
+                line=0,
+                message=f"engine subprocess exceeded the "
+                        f"{ENGINE_TIMEOUT_S:.0f}s per-engine timeout "
+                        f"and was killed — a wedged compile/backend, "
+                        f"not a finding-free run (raise "
+                        f"RAFT_GRAFTLINT_ENGINE_TIMEOUT if the engine "
+                        f"legitimately grew)"))
+            timings[engine] = round(time.monotonic() - t0, 2)
+            continue
         if proc.returncode == 2:
             rc_usage = 2
         try:

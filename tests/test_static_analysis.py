@@ -1,7 +1,9 @@
 """graftlint: one minimal failing fixture per lint rule, per jaxpr
-invariant, per HLO-audit rule and per numerics-audit rule, plus the
-repo-wide clean-run gates (all four engines must pass over the tree as
-committed — this is the tier-1 lint lane).
+invariant, per HLO-audit rule, per numerics-audit rule and per
+registry-audit rule, plus the repo-wide clean-run gates (all five
+engines must pass over the tree as committed — this is the tier-1
+lint lane).  Engines 2-5 enumerate their entries from
+raft_tpu/entrypoints.py; the registry tests pin that derivation.
 
 Everything here is CPU-only and fast-lane (no ``slow`` marker): the AST
 fixtures are string literals, the jaxpr/numerics fixtures are tiny
@@ -1208,9 +1210,7 @@ def test_numerics_list_waivers_coverage(capsys):
     assert "numerics" in out.splitlines()[-1]   # the per-engine tally
 
 
-def test_graftlint_wrapper_fans_out_four_engines():
-    """The CI wrapper must run all four engines in parallel — the
-    per-engine timing line is its contract with the tier-1 budget."""
+def _load_graftlint_script():
     import importlib.util
     import os
 
@@ -1219,4 +1219,270 @@ def test_graftlint_wrapper_fans_out_four_engines():
         "graftlint_script", os.path.join(root, "scripts", "graftlint.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    assert mod.ENGINES == ("lint", "jaxpr", "hlo", "numerics")
+    return mod
+
+
+def test_graftlint_wrapper_fans_out_five_engines():
+    """The CI wrapper must run all five engines in parallel — the
+    per-engine timing line is its contract with the tier-1 budget."""
+    mod = _load_graftlint_script()
+    assert mod.ENGINES == ("lint", "jaxpr", "hlo", "numerics", "registry")
+    # the per-engine timeout exists and is generous vs the slowest
+    # engine (hlo ~100 s) — tripping it means wedged, not slow
+    assert mod.ENGINE_TIMEOUT_S >= 300
+
+
+def test_graftlint_wrapper_engine_timeout_is_typed(capsys):
+    """A wedged engine subprocess is killed at the per-engine timeout
+    and becomes a typed ``engine-timeout`` finding with exit 1 — not a
+    hang to the tier-1 ceiling."""
+    mod = _load_graftlint_script()
+    mod.ENGINE_TIMEOUT_S = 0.05
+    rc = mod.parallel_gate(json_out=False, verbose=False)
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "engine-timeout" in out.out
+    assert "was killed" in out.err
+
+
+# --------------------------------------------------------------------------
+# engine 5: the entry-point registry coverage auditor
+# --------------------------------------------------------------------------
+
+from raft_tpu import entrypoints as ep                    # noqa: E402
+from raft_tpu.analysis import registry_audit as ra        # noqa: E402
+
+
+def test_engines_enumerate_from_registry():
+    """No hand-maintained entry lists remain in analysis/: all four
+    engines' tables derive from raft_tpu/entrypoints.py."""
+    assert list(ja.ENTRY_AUDITS) == ep.jaxpr_audit_names()
+    assert list(ha.ENTRIES) == list(ep.hlo_entries())
+    assert list(na.ENTRIES) == list(ep.numerics_entries())
+    # structural facts ride the registry into the engines
+    assert ha.ENTRIES["corr_ring"].require == ("collective-permute",)
+    assert ha.ENTRIES["train_step"].donated
+    assert na.ENTRIES["corr_lookup_pallas"].pallas
+    assert na.ENTRIES["train_step"].rules == na.DEEP_RULES
+    # every entry is audited by at least one engine
+    for e in ep.ENTRYPOINTS.values():
+        assert e.jaxpr or e.hlo or e.numerics, e.name
+
+
+def test_cache_key_recipe_single_definition():
+    """Drift-regression (PR-10 follow-up): the AOT cache-key recipe is
+    defined ONCE, on the registry, and both consumers import it."""
+    import ast
+    import os
+
+    import raft_tpu.serve.engine as se
+
+    assert se.arg_signature is ep.arg_signature
+    assert se.forward_cache_key is ep.forward_cache_key
+    assert se._tree_signature is ep.tree_signature
+    # and no second def of any recipe function exists in the package
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    recipe = {"arg_signature", "forward_cache_key", "tree_signature"}
+    defs = []
+    for dirpath, dirs, files in os.walk(os.path.join(root, "raft_tpu")):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+            defs += [(os.path.relpath(path, root), n.name)
+                     for n in ast.walk(tree)
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name in recipe]
+    assert sorted(defs) == sorted(
+        [(os.path.join("raft_tpu", "entrypoints.py"), n)
+         for n in recipe]), defs
+
+
+def test_seeded_unregistered_entrypoint_trips(tmp_path, capsys):
+    from raft_tpu.analysis.__main__ import main
+
+    fixture = tmp_path / "unreg.py"
+    fixture.write_text(textwrap.dedent("""\
+        import jax
+
+
+        def my_secret_entry(x):
+            return jax.jit(lambda y: y * 2)(x)
+    """))
+    rc = main(["--engine", "registry", "--audits", "coverage,waivers",
+               str(fixture), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    hits = [f for f in payload["findings"]
+            if f["rule"] == "unregistered-entrypoint"]
+    assert len(hits) == 1
+    assert hits[0]["path"].endswith("unreg.py") and hits[0]["line"] == 5
+    assert "entrypoints.py" in hits[0]["message"]
+
+    # the waived twin passes (engine-1 waiver syntax, reason mandatory)
+    waived = tmp_path / "waived.py"
+    waived.write_text(textwrap.dedent("""\
+        import jax
+
+
+        def my_waived_entry(x):
+            # graftlint: disable=unregistered-entrypoint -- demo, never ships
+            return jax.jit(lambda y: y * 2)(x)
+    """))
+    assert main(["--engine", "registry", "--audits", "coverage,waivers",
+                 str(waived)]) == 0
+    capsys.readouterr()
+
+
+def test_seeded_stale_waiver_trips(tmp_path, capsys):
+    from raft_tpu.analysis.__main__ import main
+
+    fixture = tmp_path / "stale.py"
+    fixture.write_text(textwrap.dedent("""\
+        def clean_fn(x):
+            # graftlint: disable=bare-print -- the print is long gone
+            return x + 1
+    """))
+    rc = main(["--engine", "registry", "--audits", "coverage,waivers",
+               str(fixture), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    hits = [f for f in payload["findings"] if f["rule"] == "stale-waiver"]
+    assert len(hits) == 1
+    # a standalone waiver comment governs the NEXT statement line —
+    # the finding points where the suppression would have applied
+    assert hits[0]["path"].endswith("stale.py") and hits[0]["line"] == 3
+
+
+@pytest.fixture()
+def orphaned_ledger(tmp_path):
+    """The checked-in ledger plus an orphan row per section, minus one
+    sanctioned row."""
+    with open(bmod.default_budgets_path(), encoding="utf-8") as f:
+        payload = json.load(f)
+    payload["entries"]["renamed_old_entry"] = dict(
+        payload["entries"]["train_step"])
+    payload["pallas_vmem"]["ghost/_ghost_kernel"] = {
+        "vmem_bytes": 1, "calls": 1}
+    del payload["entries"]["serve_forward"]
+    path = tmp_path / "budgets.json"
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    return str(path)
+
+
+def test_seeded_orphan_budget_trips(orphaned_ledger, capsys):
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--engine", "registry", "--audits", "budgets",
+               "--budgets", orphaned_ledger, "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    orphans = {f["data"]["row"]: f for f in payload["findings"]
+               if f["rule"] == "orphan-budget"}
+    assert set(orphans) == {"renamed_old_entry", "ghost/_ghost_kernel"}
+    # orphan findings point at the exact ledger line
+    assert all(f["line"] > 0 for f in orphans.values())
+    missing = [f["data"]["row"] for f in payload["findings"]
+               if f["rule"] == "missing-budget"]
+    assert missing == ["serve_forward"]
+
+
+def test_prune_budgets_dry_run_and_update_prune(orphaned_ledger, capsys):
+    from raft_tpu.analysis.__main__ import main
+
+    # dry run: lists both orphans, exits 0, writes nothing
+    before = open(orphaned_ledger).read()
+    rc = main(["--prune-budgets", "--budgets", orphaned_ledger])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "renamed_old_entry" in out and "ghost/_ghost_kernel" in out
+    assert open(orphaned_ledger).read() == before
+    # the clean checked-in ledger previews zero prunes
+    assert ra.orphan_rows() == {"entries": [], "pallas_vmem": []}
+    # save_budgets prune semantics (the full --update-budgets path):
+    # the orphan row is dropped, sanctioned rows survive
+    bmod.save_budgets(orphaned_ledger, None,
+                      {"train_step": {"flops": 1.0}},
+                      prune=["renamed_old_entry"])
+    after = json.load(open(orphaned_ledger))
+    assert "renamed_old_entry" not in after["entries"]
+    assert "eval_forward" in after["entries"]
+
+
+def test_participation_check_trips_on_bypassed_table(monkeypatch):
+    """A hand-added engine entry that bypasses the registry is exactly
+    what the participation check exists to catch."""
+    monkeypatch.setitem(na.ENTRIES, "rogue_entry",
+                        na.ENTRIES["corr_lookup_dense"])
+    hits = [f for f in ra.check_participation()
+            if f.rule == "engine-participation"]
+    assert len(hits) == 1 and "rogue_entry" in hits[0].message
+    assert fmod.gate(hits)
+
+
+def test_module_level_jit_alias_coverage(tmp_path):
+    """A module-level ``_fast = jax.jit(impl)`` binding is covered
+    exactly when its assignment target is reachable — module-level
+    sites must not be unconditionally flagged."""
+    p = tmp_path / "mod.py"
+    p.write_text("import jax\n_fast = jax.jit(lambda x: x)\n")
+    assert ra.scan_coverage([str(p)], roots={"_fast"}) == []
+    flagged = ra.scan_coverage([str(p)], roots={"unrelated"})
+    assert [(f.rule, f.line) for f in flagged] == \
+        [("unregistered-entrypoint", 2)]
+
+
+def test_list_waivers_agrees_with_stale_gate(tmp_path, capsys):
+    """--list-waivers activity and engine 5's stale-waiver gate share
+    one computation: an inline unregistered-entrypoint waiver the gate
+    accepts must read [active] in the inventory, not [STALE]."""
+    from raft_tpu.analysis.__main__ import collect_waivers
+
+    fixture = tmp_path / "waived.py"
+    fixture.write_text(textwrap.dedent("""\
+        import jax
+
+
+        def my_waived_entry(x):
+            # graftlint: disable=unregistered-entrypoint -- demo only
+            return jax.jit(lambda y: y * 2)(x)
+    """))
+    # the data-declared jaxpr/hlo/numerics waivers ride along whatever
+    # the paths are; the inline inventory for the fixture is one entry
+    [w] = [w for w in collect_waivers([str(fixture)])
+           if w["engine"] == "lint"]
+    assert w["rules"] == ["unregistered-entrypoint"] and w["active"]
+
+
+def test_coverage_scan_reaches_module_level_registrations():
+    """custom_vjp backward kernels are linked only by module-level
+    defvjp calls; the scan's co-reference edges must cover them (a
+    regression here floods the gate with false positives)."""
+    findings = ra.scan_coverage(ra.default_scan_paths())
+    assert [f.render() for f in findings if not f.waived] == []
+
+
+@pytest.fixture(scope="module")
+def registry_results():
+    import time
+
+    if jax.device_count() < 8:
+        pytest.skip("registry trace gate needs the 8-device CPU harness")
+    t0 = time.monotonic()
+    findings, report = ra.run_registry_audit()
+    return findings, report, time.monotonic() - t0
+
+
+def test_registry_gate_repo_clean(registry_results):
+    findings, report, elapsed = registry_results
+    gating = fmod.gate(findings)
+    assert gating == [], "\n" + "\n".join(f.render() for f in gating)
+    # the clean-run ceiling: measured ~22 s solo on this container;
+    # 120 s keeps the 5-way parallel graftlint inside tier-1
+    assert elapsed < 120, f"registry engine took {elapsed:.0f}s"
+    # every registered entry actually traced (none skipped)
+    assert set(report["trace"]["seconds"]) == set(ep.ENTRYPOINTS)
+    assert report["coverage"]["call_sites_flagged"] == 0
