@@ -476,6 +476,139 @@ def main():
     except Exception as e:  # the serve lane must never sink the scoreboard
         print(f"serve bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+
+    # Stereo workload lanes (raft_tpu/workloads/stereo): the SAME
+    # architecture at 1D correlation, measured both ways the flow graph
+    # is — a train-step lane at the bench config and a serving lane
+    # through the real FlowServer with a stereo engine.  Random-init
+    # weights (the lanes measure machinery rate, not accuracy).
+    def _stereo_train_lane():
+        from raft_tpu.training import create_train_state as _cts
+        from raft_tpu.workloads.stereo import (StereoRAFT,
+                                               make_stereo_train_step,
+                                               stereo_config)
+
+        s_cfg = stereo_config(overrides={
+            "compute_dtype": cfg.compute_dtype,
+            "corr_dtype": cfg.corr_dtype,
+            "remat": cfg.remat, "remat_policy": cfg.remat_policy})
+        s_model = StereoRAFT(s_cfg)
+        s_batch = {
+            "image1": batch["image1"], "image2": batch["image2"],
+            "disp": jnp.asarray(
+                rng.uniform(0, 32, (B, H, W)).astype(np.float32)),
+            "valid": jnp.ones((B, H, W), np.float32),
+        }
+        tx2, _ = make_optimizer(lr=4e-4, num_steps=1000, wdecay=1e-4)
+        s_state = _cts(s_model, tx2, jax.random.PRNGKey(1), s_batch,
+                       iters=iters)
+        s_step = make_stereo_train_step(s_model, iters=iters, donate=True)
+        s_state, m = s_step(s_state, s_batch)
+        float(m["loss"])                      # warmup + compile
+        n = 2 if tiny else 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            s_state, m = s_step(s_state, s_batch)
+        float(m["loss"])
+        return round(B * n / (time.perf_counter() - t0), 3)
+
+    def _stereo_serve_lane():
+        from raft_tpu.serve.engine import ServeEngine
+        from raft_tpu.serve.server import FlowServer
+        from raft_tpu.workloads.stereo import (STEREO_SERVE_OVERRIDES,
+                                               StereoRAFT,
+                                               compile_stereo_forward,
+                                               stereo_config)
+
+        s_model = StereoRAFT(stereo_config(
+            overrides=STEREO_SERVE_OVERRIDES))
+        init_img = np.zeros((1, H, W, 3), np.float32)
+        s_vars = s_model.init(jax.random.PRNGKey(2), init_img, init_img,
+                              iters=2, train=True)
+        serve_b = min(2, B)
+        engine = ServeEngine(s_model, s_vars, batch_size=serve_b,
+                             compile_fn=compile_stereo_forward,
+                             cache_tag="stereo_serve", warm_channels=1)
+        server = FlowServer({"stereo": engine}, buckets={"bench": (H, W)},
+                            queue_capacity=max(8, 4 * serve_b),
+                            iter_levels=(iters,), degrade=False)
+        try:
+            server.warmup(warm_too=False)
+            rng_s = np.random.default_rng(11)
+
+            def frame():
+                return rng_s.uniform(0, 255, (H, W, 3)).astype(np.float32)
+
+            n_req = 4 if tiny else 24
+            t0 = time.perf_counter()
+            done = []
+            for i in range(n_req):
+                done.append(server.submit(frame(), frame(),
+                                          workload="stereo"))
+                if (i + 1) % serve_b == 0:
+                    for f in done[-serve_b:]:
+                        f.result(timeout=600)
+            for f in done:
+                f.result(timeout=600)
+            wall = time.perf_counter() - t0
+            summary = server.close()
+            server = None
+            return {
+                "stereo_pairs_per_s_per_chip": round(n_req / wall, 3),
+                "stereo_latency_p95_ms":
+                    summary.get("latency_p95_ms", 0.0),
+            }
+        finally:
+            if server is not None:
+                server.close()
+
+    def _confidence_overhead():
+        """Percent step-time delta of the uncertainty head on the eval
+        forward — the price of shipping confidence with every flow."""
+        from raft_tpu.models import RAFT as _RAFT
+
+        img = jnp.asarray(
+            rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32))
+        # identical configs except the head flag: the delta measures
+        # the head, not a config difference
+        base = dataclasses.replace(cfg, remat=False, remat_policy="")
+        times = {}
+        for label, head in (("off", False), ("on", True)):
+            m = _RAFT(dataclasses.replace(base, uncertainty_head=head))
+            v = m.init(jax.random.PRNGKey(3), img, img, iters=2,
+                       train=True)
+            fwd = jax.jit(lambda variables, a, b, mm=m: mm.apply(
+                variables, a, b, iters=iters, test_mode=True))
+            out = fwd(v, img, img)
+            np.asarray(out[0])                # warmup + compile
+            n = 2 if tiny else 8
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fwd(v, img, img)
+            np.asarray(out[0])
+            times[label] = (time.perf_counter() - t0) / n
+        return round(100.0 * (times["on"] - times["off"]) / times["off"],
+                     2)
+
+    stereo_metrics = {"stereo_pairs_per_s": 0.0,
+                      "stereo_pairs_per_s_per_chip": 0.0,
+                      "stereo_latency_p95_ms": 0.0}
+    try:
+        stereo_metrics["stereo_pairs_per_s"] = _stereo_train_lane()
+    except Exception as e:  # workload lanes never sink the scoreboard
+        print(f"stereo train bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        stereo_metrics.update(_stereo_serve_lane())
+    except Exception as e:
+        print(f"stereo serve bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    confidence_overhead_pct = 0.0
+    try:
+        confidence_overhead_pct = _confidence_overhead()
+    except Exception as e:
+        print(f"confidence overhead bench failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
     # The headline fed lane mirrors the train CLI's auto policy: device
     # aug on an accelerator, host aug on a CPU backend (where the
     # matmul resample loses — an RAFT_BENCH_ALLOW_CPU smoke must not
@@ -498,7 +631,9 @@ def main():
                         "fed_pairs_per_s_host":
                             round(fed_pairs_per_s_host, 3),
                         "fed_lane": fed_lane}
-                     | serve_metrics)
+                     | serve_metrics | stereo_metrics
+                     | {"confidence_overhead_pct":
+                            confidence_overhead_pct})
 
     print(json.dumps({
         "metric": "image-pairs/sec/chip",
@@ -517,6 +652,11 @@ def main():
         # serving lane: synthetic requests through the real FlowServer
         # (queue -> batcher -> AOT executor) at this resolution
         **serve_metrics,
+        # stereo workload lanes: the same architecture at 1D corr —
+        # train-step rate and serve rate through a stereo-engine server
+        **stereo_metrics,
+        # the uncertainty head's eval-forward cost (percent step delta)
+        "confidence_overhead_pct": confidence_overhead_pct,
         # which registered entry point each lane exercises
         "lane_entrypoints": lane_entries,
         "host_cores": os.cpu_count(),

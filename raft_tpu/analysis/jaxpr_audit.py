@@ -422,6 +422,43 @@ def audit_serve_forward() -> Tuple[List[Finding], Dict]:
     return _apply_waivers(findings), report
 
 
+def audit_workload_forward() -> Tuple[List[Finding], Dict]:
+    """GENERIC workload test-mode forward audit: every registry entry
+    declaring the ``workload_forward`` jaxpr kind (stereo disparity,
+    the uncertainty-head forward, whatever a future workload registers)
+    gets f64 hygiene under x64, the no-transfers-in-scan check, and the
+    declared-f32 output boundary (disparity/flow/confidence all leave
+    their graphs f32) — a new workload joins by registration alone, no
+    engine edits."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    findings: List[Finding] = []
+    report: Dict = {"traced": []}
+    for name, entry in registry.ENTRYPOINTS.items():
+        if "workload_forward" not in entry.jaxpr:
+            continue
+        fwd, args = entry.build()
+        with enable_x64():
+            jx = jax.make_jaxpr(fwd)(*args)
+        report["traced"].append(name)
+        findings.extend(_f64_findings(name, jx))
+        for prim, prov in find_loop_transfers(jx):
+            findings.append(_finding(
+                "scan-transfer", name,
+                f"{prim} inside a scan body at {prov}"))
+        outs = jax.eval_shape(fwd, *args)
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(outs)):
+            if leaf.dtype != jnp.float32:
+                findings.append(_finding(
+                    "bf16-policy", name,
+                    f"output leaf {i} leaves the workload forward as "
+                    f"{leaf.dtype}; workload outputs are a declared-f32 "
+                    f"boundary"))
+    return _apply_waivers(findings), report
+
+
 def audit_corr_lookups() -> Tuple[List[Finding], Dict]:
     """ops/corr.py + ops/corr_pallas.py lookup kernels, tiny shapes."""
     import jax
@@ -531,6 +568,7 @@ _AUDIT_IMPLS: Dict[str, Callable[[], Tuple[List[Finding], Dict]]] = {
     "parallel_step": audit_parallel_step,
     "eval_forward": audit_eval_forward,
     "serve_forward": audit_serve_forward,
+    "workload_forward": audit_workload_forward,
     "corr_lookups": audit_corr_lookups,
     "device_aug": audit_device_aug,
     "recompile_keys": audit_recompile_keys,

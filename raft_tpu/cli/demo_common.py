@@ -93,32 +93,11 @@ def infer_flow(evaluator, image1: np.ndarray, image2: np.ndarray,
     return np.asarray(flow_low)[0], np.asarray(padder.unpad(flow_up))[0]
 
 
-def warp_image(image: np.ndarray, flow: np.ndarray,
-               use_cv2: bool = False) -> Tuple[np.ndarray, np.ndarray]:
-    """Backward-warp ``image`` by ``flow`` (demo_warp.py:27-73 semantics).
-
-    use_cv2 selects the cv2.remap-equivalent path (same math, host-side).
-    Returns (warped, valid_mask).
-    """
-    if use_cv2:
-        import cv2
-
-        h, w = flow.shape[:2]
-        gx, gy = np.meshgrid(np.arange(w), np.arange(h))
-        map_x = (gx + flow[..., 0]).astype(np.float32)
-        map_y = (gy + flow[..., 1]).astype(np.float32)
-        warped = cv2.remap(image, map_x, map_y, cv2.INTER_LINEAR)
-        mask = ((map_x >= 0) & (map_x <= w - 1)
-                & (map_y >= 0) & (map_y <= h - 1)).astype(np.float32)
-        return warped, mask[..., None]
-
-    import jax.numpy as jnp
-
-    from raft_tpu.ops.warp import backward_warp
-
-    warped, mask = backward_warp(jnp.asarray(image[None]),
-                                 jnp.asarray(flow[None]))
-    return np.asarray(warped)[0], np.asarray(mask)[0]
+# THE warp op, shared with the uncertainty-head loss — the demos and
+# the trainable forward-backward consistency signal must render/train
+# on the same math (ops/consistency.py owns it; this name is kept for
+# the demo CLIs' historical import site).
+from raft_tpu.ops.consistency import warp_image  # noqa: E402,F401
 
 
 def flow_viz_image(flow: np.ndarray) -> np.ndarray:

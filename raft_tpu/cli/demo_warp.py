@@ -12,6 +12,8 @@ from __future__ import annotations
 import argparse
 import os
 
+import numpy as np
+
 from raft_tpu.cli.demo_common import (
     add_model_args, infer_flow, load_image, load_model, save_image,
     warp_collage, warp_image)
@@ -30,6 +32,11 @@ def parse_args(argv=None):
                         "the grid-sample path")
     p.add_argument("--backward", action="store_true",
                    help="also warp image1 toward image2 with -flow")
+    p.add_argument("--occlusion", action="store_true",
+                   help="infer flow BOTH directions and save the "
+                        "forward-backward occlusion mask "
+                        "(ops/consistency.py — the same op the "
+                        "uncertainty head trains against)")
     return p.parse_args(argv)
 
 
@@ -51,6 +58,18 @@ def main(argv=None):
     if args.backward:
         warped_b, _ = warp_image(image1, -flow, use_cv2=args.use_cv2)
         save_image(os.path.join(args.output, "warped_1to2.png"), warped_b)
+
+    if args.occlusion:
+        # true backward flow (a second inference, 2->1), then the shared
+        # forward-backward consistency rule — occluded pixels render
+        # black in the mask image
+        from raft_tpu.ops.consistency import fb_occlusion_mask
+
+        _, flow_bwd = infer_flow(evaluator, image2, image1,
+                                 iters=args.iters)
+        occ = fb_occlusion_mask(flow, flow_bwd)
+        save_image(os.path.join(args.output, "occlusion.png"),
+                   np.repeat((1.0 - occ[..., None]) * 255.0, 3, axis=-1))
     print(f"wrote {args.output}/")
 
 

@@ -135,6 +135,14 @@ class RAFTConfig:
     # doubling the mask bytes through the whole backward costs more
     # than the reduce pattern saves.  Default OFF by that measurement.
     mask_conv2_f32: bool = False
+    # Occlusion/uncertainty head (models/update.py UncertaintyHead): a
+    # small conv head off the context features predicting a per-pixel
+    # confidence logit, trained against forward-backward-consistency
+    # occlusion masks (ops/consistency.py, workloads/uncertainty.py).
+    # Default OFF so flow-only checkpoints keep loading byte-identically
+    # — enabling it adds ONLY the head's parameters (conf_head/*) and an
+    # extra output to __call__ (see models/raft.py).
+    uncertainty_head: bool = False
 
     def __post_init__(self):
         if self.lookup_impl not in ("einsum", "pallas", "pallas_stacked"):

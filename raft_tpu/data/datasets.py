@@ -446,6 +446,174 @@ class SyntheticShift(FlowDataset):
                           flow, valid)
 
 
+class SyntheticStereo(FlowDataset):
+    """Procedural rectified stereo pairs with exact dense disparity.
+
+    Two-layer scene: a textured background at disparity ``d_bg`` and a
+    textured foreground rectangle at a larger disparity ``d_fg`` (it is
+    closer), both exact by construction — the right image is assembled
+    by shifting each layer left by its disparity (``x_right = x_left -
+    d``), foreground painted last.  Left-edge pixels whose match falls
+    off the right frame, and background pixels occluded by the
+    foreground's right-image position, are marked invalid — exactly the
+    pixels rectified stereo cannot supervise.
+
+    Samples: ``image1`` (left) / ``image2`` (right) uint8,
+    ``disp`` (H, W) float32, ``valid`` (H, W) float32.
+    """
+
+    def __init__(self, image_size=(64, 64), length: int = 1000,
+                 max_disp: int = 16, seed: int = 0):
+        super().__init__(aug_params=None, seed=seed)
+        self.image_size = tuple(image_size)
+        self.length = length
+        self.max_disp = int(max_disp)
+        # The layer-sampling ranges below need md >= 4 (d_bg >= 1,
+        # d_fg >= d_bg + 2 <= md) and d_fg + rect width < W (the
+        # foreground's right-image position must fit the frame) — a
+        # config outside that surfaces here as a clear error, not a
+        # mid-epoch empty-range ValueError from rng.integers.
+        if self.max_disp < 4:
+            raise ValueError(
+                f"max_disp must be >= 4 (two separable layers), got "
+                f"{self.max_disp}")
+        if self.max_disp > self.image_size[1] // 4:
+            raise ValueError(
+                f"max_disp {self.max_disp} too large for width "
+                f"{self.image_size[1]}: need max_disp <= W//4 so the "
+                f"foreground's matched position stays in frame")
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, index) -> Dict[str, np.ndarray]:
+        if index >= self.length:
+            raise IndexError(index)
+        rng = np.random.default_rng(
+            abs(hash((self.seed, self.epoch, index))) % (2 ** 31))
+        H, W = self.image_size
+        md = self.max_disp
+
+        def texture(lo, hi):
+            import cv2
+            small = rng.uniform(lo, hi, (H // 8 + 2, W // 8 + 2, 3)) \
+                .astype(np.float32)
+            img = cv2.resize(small, (W, H),
+                             interpolation=cv2.INTER_NEAREST)
+            img += rng.random((H, W, 3), dtype=np.float32) * 30.0 - 15.0
+            return np.clip(img, 0, 255, out=img)
+
+        bg = texture(0, 200)
+        fg = texture(120, 255)   # brighter layer: the closer surface
+        d_bg = int(rng.integers(1, max(md // 2, 2)))
+        d_fg = int(rng.integers(d_bg + 2, md + 1))
+        rh = int(rng.integers(H // 4, H // 2))
+        rw = int(rng.integers(W // 4, W // 2))
+        ry = int(rng.integers(0, H - rh))
+        rx = int(rng.integers(d_fg, W - rw))  # fg match stays in frame
+
+        fg_mask = np.zeros((H, W), bool)
+        fg_mask[ry:ry + rh, rx:rx + rw] = True
+
+        left = np.where(fg_mask[..., None], fg, bg)
+        disp = np.where(fg_mask, np.float32(d_fg), np.float32(d_bg))
+
+        # right image: shift each layer LEFT by its disparity
+        right = np.roll(bg, -d_bg, axis=1)
+        fg_right = np.zeros((H, W), bool)
+        fg_right[ry:ry + rh, rx - d_fg:rx - d_fg + rw] = True
+        right = np.where(fg_right[..., None], np.roll(fg, -d_fg, axis=1),
+                         right)
+
+        # valid: match in frame, and (for background) the match not
+        # covered by the foreground's right-image position (occluded)
+        xs = np.broadcast_to(np.arange(W)[None, :], (H, W))
+        match_x = xs - disp                       # (H, W)
+        valid = match_x >= 0
+        mx = np.clip(match_x.astype(np.int64), 0, W - 1)
+        occluded = (~fg_mask) & fg_right[np.arange(H)[:, None], mx]
+        valid &= ~occluded
+
+        return {"image1": np.ascontiguousarray(left, np.uint8),
+                "image2": np.ascontiguousarray(right, np.uint8),
+                "disp": np.ascontiguousarray(disp, np.float32),
+                "valid": np.ascontiguousarray(valid, np.float32)}
+
+
+class SyntheticOcclusion(FlowDataset):
+    """Procedural consistency stage: exact forward AND backward flow
+    with content-predictable occlusion.
+
+    A static textured background plus a bright foreground rectangle
+    translating in +x (``dx`` px): background pixels the rectangle
+    slides onto are occluded — visible in frame 1, hidden in frame 2 —
+    and they sit directly right of the rectangle, so occlusion is
+    predictable from frame-1 content alone (what the uncertainty head
+    sees).  The forward-backward consistency of the EXACT flow pair
+    (``ops/consistency.py``) flags precisely those pixels, which is
+    what makes this the uncertainty-head gate's training stage.
+
+    Samples: ``image1``/``image2`` uint8, ``flow``/``flow_bwd``
+    (H, W, 2) float32 exact, ``valid`` (H, W) float32 (all ones — both
+    flows are exact everywhere; occlusion is the LABEL here, not a
+    supervision gap).
+    """
+
+    def __init__(self, image_size=(64, 64), length: int = 1000,
+                 max_shift: int = 12, seed: int = 0):
+        super().__init__(aug_params=None, seed=seed)
+        self.image_size = tuple(image_size)
+        self.length = length
+        self.max_shift = int(max_shift)
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, index) -> Dict[str, np.ndarray]:
+        if index >= self.length:
+            raise IndexError(index)
+        rng = np.random.default_rng(
+            abs(hash((self.seed, self.epoch, index))) % (2 ** 31))
+        H, W = self.image_size
+
+        import cv2
+        small = rng.uniform(0, 160, (H // 8 + 2, W // 8 + 2, 3)) \
+            .astype(np.float32)
+        bg = cv2.resize(small, (W, H), interpolation=cv2.INTER_NEAREST)
+        bg += rng.random((H, W, 3), dtype=np.float32) * 30.0 - 15.0
+        np.clip(bg, 0, 255, out=bg)
+
+        dx = int(rng.integers(4, self.max_shift + 1))
+        rh = int(rng.integers(H // 4, H // 2))
+        rw = int(rng.integers(W // 4, W // 2))
+        ry = int(rng.integers(0, H - rh))
+        rx = int(rng.integers(0, W - rw - dx))
+
+        fg_val = rng.uniform(200, 255, (1, 1, 3)).astype(np.float32)
+        fg_noise = rng.random((rh, rw, 3), dtype=np.float32) * 20.0
+
+        img1 = bg.copy()
+        img1[ry:ry + rh, rx:rx + rw] = np.clip(fg_val + fg_noise, 0, 255)
+        img2 = bg.copy()
+        img2[ry:ry + rh, rx + dx:rx + rw + dx] = np.clip(
+            fg_val + fg_noise, 0, 255)
+
+        fg1 = np.zeros((H, W), bool)
+        fg1[ry:ry + rh, rx:rx + rw] = True
+        fg2 = np.zeros((H, W), bool)
+        fg2[ry:ry + rh, rx + dx:rx + rw + dx] = True
+
+        flow = np.zeros((H, W, 2), np.float32)
+        flow[fg1, 0] = dx                          # the surface's motion
+        flow_bwd = np.zeros((H, W, 2), np.float32)
+        flow_bwd[fg2, 0] = -dx
+
+        valid = np.ones((H, W), np.float32)
+        return {"image1": np.ascontiguousarray(img1, np.uint8),
+                "image2": np.ascontiguousarray(img2, np.uint8),
+                "flow": flow, "flow_bwd": flow_bwd, "valid": valid}
+
+
 # Static raw-frame pad sizes for the device-augmentation wire, per
 # dataset family (the standard release dimensions; KITTI varies a few
 # px per frame, the pad covers the maxima).
@@ -527,6 +695,16 @@ def _fetch_dataset(stage: str, image_size, root: str,
             base, frames_dir=frames_dir, seed=seed,
             aug_params=dict(crop_size=crop, min_scale=-0.2, max_scale=0.4,
                             do_flip=True))
+    if stage == "stereo_synthetic":
+        # Dataset-free stereo stage: two-layer rectified pairs with
+        # exact disparity + occlusion-aware validity (SyntheticStereo)
+        # — the stereo workload's training/gate stage.
+        return SyntheticStereo(crop, seed=seed)
+    if stage == "consistency_synthetic":
+        # Dataset-free fwd+bwd flow pairs with content-predictable
+        # occlusion (SyntheticOcclusion) — the uncertainty head's
+        # training/gate stage.
+        return SyntheticOcclusion(crop, seed=seed)
     if stage == "chairs":
         aug = dict(crop_size=crop, min_scale=-0.1, max_scale=1.0, do_flip=True)
         return FlyingChairs(aug, split="training",

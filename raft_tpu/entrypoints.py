@@ -329,6 +329,103 @@ def _build_corr_ring():
     return abstract_ring_lookup(audit_mesh())
 
 
+def _build_stereo_forward():
+    from raft_tpu.workloads.stereo import abstract_stereo_forward
+
+    return abstract_stereo_forward(iters=2)
+
+
+def _hlo_stereo_forward():
+    from raft_tpu.workloads.stereo import abstract_stereo_forward
+
+    # `small` keeps the compile bounded; the 1D-corr/lookup structure
+    # and the disparity boundary are identical on the large model
+    # (which engines 2/4 trace via the canonical build)
+    return abstract_stereo_forward(iters=2, overrides={"small": True})
+
+
+def _build_stereo_forward_bf16():
+    from raft_tpu.workloads.stereo import abstract_stereo_forward
+
+    return abstract_stereo_forward(
+        iters=2,
+        overrides={"compute_dtype": "bfloat16", "corr_dtype": "bfloat16"})
+
+
+def _build_stereo_train_step():
+    from raft_tpu.workloads.stereo import abstract_stereo_train_step
+
+    return abstract_stereo_train_step(iters=2)
+
+
+def _hlo_stereo_train_step():
+    from raft_tpu.workloads.stereo import abstract_stereo_train_step
+
+    return abstract_stereo_train_step(iters=2, donate=True,
+                                      overrides={"small": True})
+
+
+def _build_stereo_serve_forward():
+    from raft_tpu.workloads.stereo import abstract_stereo_serve_forward
+
+    return abstract_stereo_serve_forward(iters=2)
+
+
+def _hlo_stereo_serve_forward():
+    from raft_tpu.workloads.stereo import abstract_stereo_serve_forward
+
+    return abstract_stereo_serve_forward(iters=2,
+                                         overrides={"small": True})
+
+
+def _build_stereo_serve_forward_warm():
+    # the disp_init warm-start variant: an extra (B, H/8, W/8, 1) input
+    # and the clamp-to-nonnegative init add only exist in THIS graph
+    from raft_tpu.workloads.stereo import abstract_stereo_serve_forward
+
+    return abstract_stereo_serve_forward(iters=2, warm=True)
+
+
+def _hlo_stereo_serve_forward_warm():
+    from raft_tpu.workloads.stereo import abstract_stereo_serve_forward
+
+    return abstract_stereo_serve_forward(iters=2, warm=True,
+                                         overrides={"small": True})
+
+
+def _build_corr_lookup_1d():
+    from raft_tpu.workloads.stereo import abstract_corr_lookup_1d
+
+    return abstract_corr_lookup_1d()
+
+
+def _build_uncertainty_forward():
+    from raft_tpu.workloads.uncertainty import abstract_uncertainty_forward
+
+    return abstract_uncertainty_forward(iters=2)
+
+
+def _hlo_uncertainty_forward():
+    from raft_tpu.workloads.uncertainty import abstract_uncertainty_forward
+
+    return abstract_uncertainty_forward(iters=2,
+                                        overrides={"small": True})
+
+
+def _build_uncertainty_forward_bf16():
+    from raft_tpu.workloads.uncertainty import abstract_uncertainty_forward
+
+    return abstract_uncertainty_forward(
+        iters=2,
+        overrides={"compute_dtype": "bfloat16", "corr_dtype": "bfloat16"})
+
+
+def _build_uncertainty_step():
+    from raft_tpu.workloads.uncertainty import abstract_uncertainty_step
+
+    return abstract_uncertainty_step(iters=2)
+
+
 def _build_device_aug():
     from raft_tpu.data.device_aug import abstract_device_aug
 
@@ -434,6 +531,73 @@ ENTRYPOINTS: Dict[str, EntryPoint] = {e.name: e for e in (
         build=_build_device_aug_sparse,
         jaxpr=("device_aug",), hlo=True, numerics=True,
         ranges="device_aug"),
+    # ------------------------------------------------------------------
+    # workloads (raft_tpu/workloads/): stereo disparity + the
+    # occlusion/uncertainty head — each a full record family (f32 +
+    # bf16 forward, train step, serve cold/warm) so audits, budgets,
+    # AOT keying and bench lanes follow from registration alone.
+    # "workload_forward" is engine 2's GENERIC forward audit (f64
+    # hygiene, no scan transfers, all-f32 output boundary) — a new
+    # workload joins it by declaring the kind, no engine edits.
+    # ------------------------------------------------------------------
+    EntryPoint(
+        "stereo_forward",
+        anchor=("raft_tpu.workloads.stereo", "abstract_stereo_forward"),
+        build=_build_stereo_forward, hlo_build=_hlo_stereo_forward,
+        jaxpr=("workload_forward",), hlo=True, numerics=True, deep=True),
+    EntryPoint(
+        "stereo_forward_bf16",
+        anchor=("raft_tpu.workloads.stereo", "abstract_stereo_forward"),
+        build=_build_stereo_forward_bf16,
+        jaxpr=("workload_forward",), numerics=True, deep=True),
+    EntryPoint(
+        "stereo_train_step",
+        anchor=("raft_tpu.workloads.stereo", "abstract_stereo_train_step"),
+        build=_build_stereo_train_step,
+        hlo_build=_hlo_stereo_train_step,
+        hlo=True, numerics=True, deep=True, donated=True,
+        bench_lane="stereo_train"),
+    EntryPoint(
+        "stereo_serve_forward",
+        anchor=("raft_tpu.workloads.stereo",
+                "abstract_stereo_serve_forward"),
+        build=_build_stereo_serve_forward,
+        hlo_build=_hlo_stereo_serve_forward,
+        jaxpr=("workload_forward",), hlo=True, numerics=True, deep=True,
+        cache_tag="stereo_serve", bench_lane="stereo_serve"),
+    EntryPoint(
+        "stereo_serve_forward_warm",
+        anchor=("raft_tpu.workloads.stereo",
+                "abstract_stereo_serve_forward"),
+        build=_build_stereo_serve_forward_warm,
+        hlo_build=_hlo_stereo_serve_forward_warm,
+        jaxpr=("workload_forward",), hlo=True, numerics=True, deep=True,
+        cache_tag="stereo_serve"),
+    EntryPoint(
+        "corr_lookup_1d",
+        anchor=("raft_tpu.workloads.stereo", "abstract_corr_lookup_1d"),
+        build=_build_corr_lookup_1d,
+        jaxpr=("corr_lookups",), hlo=True, numerics=True, ranges="fmap"),
+    EntryPoint(
+        "uncertainty_forward",
+        anchor=("raft_tpu.workloads.uncertainty",
+                "abstract_uncertainty_forward"),
+        build=_build_uncertainty_forward,
+        hlo_build=_hlo_uncertainty_forward,
+        jaxpr=("workload_forward",), hlo=True, numerics=True, deep=True,
+        bench_lane="uncertainty"),
+    EntryPoint(
+        "uncertainty_forward_bf16",
+        anchor=("raft_tpu.workloads.uncertainty",
+                "abstract_uncertainty_forward"),
+        build=_build_uncertainty_forward_bf16,
+        jaxpr=("workload_forward",), numerics=True, deep=True),
+    EntryPoint(
+        "uncertainty_train_step",
+        anchor=("raft_tpu.workloads.uncertainty",
+                "abstract_uncertainty_step"),
+        build=_build_uncertainty_step,
+        numerics=True, deep=True),
 )}
 
 # Engine-2 report-only audits that are not entry points (they audit

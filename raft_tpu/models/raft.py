@@ -31,7 +31,8 @@ from raft_tpu.config import RAFTConfig
 from raft_tpu.parallel.mesh import (DATA_AXIS, SPATIAL_AXIS, constrain,
                                     get_abstract_mesh)
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
-from raft_tpu.models.update import BasicUpdateBlock, MaskHead, SmallUpdateBlock
+from raft_tpu.models.update import (BasicUpdateBlock, MaskHead,
+                                    SmallUpdateBlock, UncertaintyHead)
 from raft_tpu.ops.corr import (
     alternate_corr_lookup,
     build_corr_pyramid_direct,
@@ -42,7 +43,7 @@ from raft_tpu.ops.corr import (
     stacked_pyramid_cotangent,
 )
 from raft_tpu.ops.grid import (convex_upsample, coords_grid, pack_fine,
-                               upflow8)
+                               upflow8, upsample8x)
 
 
 def _compute_dtype(cfg: RAFTConfig):
@@ -279,6 +280,16 @@ class RAFT(nn.Module):
         net = jnp.tanh(net)
         inp = nn.relu(inp)
 
+        # Optional occlusion/uncertainty head off the raw context
+        # features (pre-split: the head should not be confined to the
+        # GRU-state half).  Its logit is independent of the refinement
+        # scan, so it computes once per pair regardless of iters.
+        conf_up = None
+        if cfg.uncertainty_head:
+            conf_lr = UncertaintyHead(cfg.hidden_dim, dtype=dtype,
+                                      name="conf_head")(ctx)
+            conf_up = upsample8x(conf_lr)
+
         B, H8, W8 = fmap1.shape[0], fmap1.shape[1], fmap1.shape[2]
         coords0 = coords_grid(B, H8, W8)
         coords1 = coords0
@@ -401,6 +412,8 @@ class RAFT(nn.Module):
             # Use the final CARRY (value-identical to flows_lr[-1]/nets[-1])
             # so jit can DCE the stacked per-iterate scan outputs entirely.
             flow_lr = coords1 - coords0
+            if conf_up is not None:
+                return flow_lr, upsample(flow_lr, net), conf_up
             return flow_lr, upsample(flow_lr, net)
 
         # Batch the upsample over all iterates: (iters, B, ...) -> (iters*B, ...)
@@ -411,4 +424,7 @@ class RAFT(nn.Module):
         n_it = flows_lr.shape[0]
         flat = lambda x: x.reshape((n_it * B,) + x.shape[2:])
         ups = upsample(flat(flows_lr), flat(nets), packed=pack_output)
-        return ups.reshape((n_it, B) + ups.shape[1:])
+        ups = ups.reshape((n_it, B) + ups.shape[1:])
+        if conf_up is not None:
+            return ups, conf_up
+        return ups

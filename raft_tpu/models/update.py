@@ -58,15 +58,22 @@ def _fused_gate_conv(hx, z_name: str, r_name: str, hidden: int,
 
 
 class FlowHead(nn.Module):
-    """conv3x3 -> relu -> conv3x3 to 2 channels (update.py:6-14)."""
+    """conv3x3 -> relu -> conv3x3 to ``out_channels`` (update.py:6-14).
+
+    ``out_channels`` defaults to the reference's 2 (dx, dy); the stereo
+    workload instantiates the same head at 1 channel (disparity delta,
+    workloads/stereo.py) — the parameter names are unchanged, so flow
+    checkpoints are unaffected.
+    """
 
     hidden_dim: int = 256
     dtype: Any = jnp.float32
+    out_channels: int = 2
 
     @nn.compact
     def __call__(self, x):
         x = nn.relu(conv(self.hidden_dim, 3, dtype=self.dtype, name="conv1")(x))
-        return conv(2, 3, dtype=self.dtype, name="conv2")(x)
+        return conv(self.out_channels, 3, dtype=self.dtype, name="conv2")(x)
 
 
 class ConvGRU(nn.Module):
@@ -170,6 +177,31 @@ class MaskHead(nn.Module):
                            name="mask_conv2")(mask.astype(c2))
 
 
+class UncertaintyHead(nn.Module):
+    """Per-pixel flow-confidence head off the context features.
+
+    conv3x3 -> relu -> conv3x3 to ONE logit at 1/8 resolution; the
+    model upsamples (bilinear — logits are smooth fields) to image
+    resolution.  Trained against forward-backward-consistency occlusion
+    masks (ops/consistency.py, workloads/uncertainty.py): a positive
+    logit means "this flow vector has a visible correspondence and can
+    be trusted".  Optional by construction — it hangs off
+    ``RAFTConfig.uncertainty_head`` and flow-only checkpoints never see
+    its parameters.
+    """
+
+    hidden_dim: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, ctx):
+        x = nn.relu(conv(self.hidden_dim, 3, dtype=self.dtype,
+                         name="conf_conv1")(ctx))
+        # f32 final conv: the logit feeds a sigmoid/BCE boundary
+        return conv(1, 3, dtype=jnp.float32,
+                    name="conf_conv2")(x.astype(jnp.float32))
+
+
 class SmallUpdateBlock(nn.Module):
     """Motion encoder + ConvGRU + flow head; no upsample mask
     (update.py:99-112 — mask is None, so the model bilinearly upsamples)."""
@@ -177,6 +209,9 @@ class SmallUpdateBlock(nn.Module):
     corr_channels: int
     hidden_dim: int = 96
     dtype: Any = jnp.float32
+    # delta channels out of the head: 2 for flow (reference), 1 for the
+    # stereo disparity workload (epipolar-constrained motion)
+    head_channels: int = 2
 
     @nn.compact
     def __call__(self, net, inp, corr, flow):
@@ -184,7 +219,9 @@ class SmallUpdateBlock(nn.Module):
                                     name="encoder")(flow, corr)
         x = jnp.concatenate([inp, motion], axis=-1)
         net = ConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
-        delta = FlowHead(128, dtype=self.dtype, name="flow_head")(net)
+        delta = FlowHead(128, dtype=self.dtype,
+                         out_channels=self.head_channels,
+                         name="flow_head")(net)
         return net, delta
 
 
@@ -197,6 +234,9 @@ class BasicUpdateBlock(nn.Module):
     corr_channels: int
     hidden_dim: int = 128
     dtype: Any = jnp.float32
+    # delta channels out of the head: 2 for flow (reference), 1 for the
+    # stereo disparity workload (epipolar-constrained motion)
+    head_channels: int = 2
 
     @nn.compact
     def __call__(self, net, inp, corr, flow):
@@ -204,5 +244,7 @@ class BasicUpdateBlock(nn.Module):
                                     name="encoder")(flow, corr)
         x = jnp.concatenate([inp, motion], axis=-1)
         net = SepConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
-        delta = FlowHead(256, dtype=self.dtype, name="flow_head")(net)
+        delta = FlowHead(256, dtype=self.dtype,
+                         out_channels=self.head_channels,
+                         name="flow_head")(net)
         return net, delta

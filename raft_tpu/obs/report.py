@@ -487,6 +487,17 @@ def render_report(report: Dict) -> str:
             f"  latency    p50 {_ms(serving.get('latency_p50_ms'))}   "
             f"p95 {_ms(serving.get('latency_p95_ms'))}   "
             f"max {_ms(serving.get('latency_max_ms'))}{slo_s}")
+        # per-(workload, family) attribution: flow and stereo traffic
+        # (or any two bucket families) stay separable — the pooled
+        # percentiles above can hide a slow family behind a fast one
+        fams = serving.get("families") or {}
+        for label, row in sorted(fams.items()):
+            lines.append(
+                f"    {label:<18} {row.get('served', 0):>6} served in "
+                f"{row.get('batches', 0)} batch(es)   "
+                f"p50 {_ms(row.get('latency_p50_ms'))}   "
+                f"p95 {_ms(row.get('latency_p95_ms'))}   "
+                f"max {_ms(row.get('latency_max_ms'))}")
         deg = serving.get("degradation") or {}
         if deg:
             lines.append(

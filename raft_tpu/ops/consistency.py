@@ -1,0 +1,136 @@
+"""Forward-backward warp consistency — the ONE shared implementation.
+
+The warp demos (``cli/demo_warp*.py`` via ``cli/demo_common.py``) and
+the uncertainty-head loss (``workloads/uncertainty.py``) both need the
+same two pieces of math:
+
+- **backward warping** an image/field along a flow (the demo collage's
+  ``warp_image``), and
+- **forward-backward consistency**: warp the backward flow to the
+  forward flow's frame and measure ``|f_fwd(p) + f_bwd(p + f_fwd(p))|``
+  — where the round trip does not return to ``p``, the pixel has no
+  visible correspondence (occluded, or its target left the frame).
+  The thresholded form is UnFlow's occlusion rule (Meister et al.,
+  AAAI 2018): ``err^2 > alpha * (|f_fwd|^2 + |f_bwd_w|^2) + beta``.
+
+Before this module, the demo CLIs carried the warp math (host cv2 and
+jax paths) in ``cli/demo_common.py`` while the consistency rule only
+existed implicitly in what the demos rendered; promoting both HERE
+makes the trainable occlusion signal and the demo visualization
+provably the same computation.  ``demo_common.warp_image`` is now a
+re-export of :func:`warp_image`.
+
+Everything is pure jax (host callers pass numpy; ``jnp.asarray`` at the
+edges) except the optional cv2 warp path, which is host-only demo
+parity machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# jax imports are lazy (inside functions): the demo CLIs re-export
+# warp_image at module scope for their historical import site, and
+# their --help/arg-parse paths must not pay the jax import.
+
+# UnFlow's published constants (occlusion rule, Meister et al. 2018 eq. 2).
+FB_ALPHA = 0.01
+FB_BETA = 0.5
+
+
+def warp_backward_field(field, flow) -> Tuple:
+    """Sample ``field`` at ``p + flow(p)`` (align_corners=True).
+
+    The building block both consumers share: the demos warp IMAGE2 back
+    along the predicted flow; the consistency rule warps the BACKWARD
+    FLOW along the forward flow.  Returns ``(warped, in_bounds)`` where
+    ``in_bounds`` is the strict interior mask of the sample points
+    (B, ..., 1) — a tap outside it read zero-padded values and carries
+    no correspondence information.
+    """
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.grid import bilinear_sample, coords_grid
+
+    B, H, W, _ = field.shape
+    grid = coords_grid(B, H, W, dtype=jnp.float32)
+    target = grid + flow.astype(jnp.float32)
+    return bilinear_sample(field.astype(jnp.float32), target,
+                           return_mask=True)
+
+
+def fb_consistency(flow_fwd, flow_bwd,
+                   alpha: float = FB_ALPHA, beta: float = FB_BETA):
+    """Forward-backward consistency occlusion mask (UnFlow rule).
+
+    Args:
+      flow_fwd: (B, H, W, 2) flow from frame 1 to frame 2.
+      flow_bwd: (B, H, W, 2) flow from frame 2 to frame 1.
+      alpha, beta: threshold coefficients; the default is the published
+        UnFlow operating point.
+
+    Returns dict of (B, H, W) float32 maps:
+      ``occ``     1.0 where the pixel is occluded (round trip fails the
+                  threshold, or its target left the frame — no visible
+                  correspondence either way);
+      ``err2``    squared round-trip error |f_fwd + f_bwd_warped|^2
+                  (0 where the warp sampled out of frame);
+      ``inframe`` 1.0 where the forward target stayed strictly in
+                  frame (the warp's information mask).
+    """
+    import jax.numpy as jnp
+
+    bwd_w, inframe = warp_backward_field(flow_bwd, flow_fwd)
+    inframe = inframe[..., 0]
+    fwd = flow_fwd.astype(jnp.float32)
+    err2 = jnp.sum((fwd + bwd_w) ** 2, axis=-1)
+    mag2 = jnp.sum(fwd ** 2, axis=-1) + jnp.sum(bwd_w ** 2, axis=-1)
+    thresh = alpha * mag2 + beta
+    occ = jnp.where((err2 > thresh) | (inframe < 0.5), 1.0, 0.0)
+    return {"occ": occ, "err2": err2 * inframe, "inframe": inframe}
+
+
+def fb_occlusion_mask(flow_fwd: np.ndarray, flow_bwd: np.ndarray,
+                      alpha: float = FB_ALPHA,
+                      beta: float = FB_BETA) -> np.ndarray:
+    """Host-friendly wrapper for the demos: (H, W, 2) numpy flows in,
+    (H, W) float32 occlusion mask out (1.0 = occluded)."""
+    import jax.numpy as jnp
+
+    out = fb_consistency(jnp.asarray(flow_fwd)[None],
+                         jnp.asarray(flow_bwd)[None],
+                         alpha=alpha, beta=beta)
+    return np.asarray(out["occ"])[0]
+
+
+def warp_image(image: np.ndarray, flow: np.ndarray,
+               use_cv2: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    """Backward-warp ``image`` by ``flow`` (demo_warp.py:27-73 semantics).
+
+    THE warp op every demo CLI renders with (``demo_common.warp_image``
+    re-exports it).  ``use_cv2`` selects the cv2.remap-equivalent
+    host path (same math); the default is the jax grid-sample path
+    (ops/warp.py backward_warp, including the reference's 0.999
+    validity-mask threshold).  Returns ``(warped, valid_mask)``.
+    """
+    if use_cv2:
+        import cv2
+
+        h, w = flow.shape[:2]
+        gx, gy = np.meshgrid(np.arange(w), np.arange(h))
+        map_x = (gx + flow[..., 0]).astype(np.float32)
+        map_y = (gy + flow[..., 1]).astype(np.float32)
+        warped = cv2.remap(image, map_x, map_y, cv2.INTER_LINEAR)
+        mask = ((map_x >= 0) & (map_x <= w - 1)
+                & (map_y >= 0) & (map_y <= h - 1)).astype(np.float32)
+        return warped, mask[..., None]
+
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.warp import backward_warp
+
+    warped, mask = backward_warp(jnp.asarray(image[None]),
+                                 jnp.asarray(flow[None]))
+    return np.asarray(warped)[0], np.asarray(mask)[0]

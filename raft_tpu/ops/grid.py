@@ -116,6 +116,15 @@ def upsample2x(x: jax.Array) -> jax.Array:
     return _resize_align_corners(x, 2 * H, 2 * W)
 
 
+def upsample8x(x: jax.Array) -> jax.Array:
+    """8x align_corners=True bilinear upsample WITHOUT value scaling —
+    for smooth non-flow fields at 1/8 resolution (confidence logits;
+    ``upflow8`` additionally scales values by 8, which is a flow-vector
+    semantic)."""
+    B, H, W, _ = x.shape
+    return _resize_align_corners(x, 8 * H, 8 * W)
+
+
 def avg_pool2x(x: jax.Array) -> jax.Array:
     """2x2 stride-2 average pool, NHWC (floor division of odd dims, matching
     torch F.avg_pool2d(x, 2, stride=2) used for the corr pyramid, corr.py:25)."""

@@ -37,6 +37,12 @@ the dispatch watchdog declares a wedge; 2 usage.
 - ``stall``          wedge the first dispatch forever: the watchdog
                      must convert the hang into ``serve-stalled`` +
                      exit 14 (pair with --watchdog_timeout)
+
+``--stereo_every N`` makes the session heterogeneous: every Nth
+request routes to a stereo disparity engine (workloads/stereo.py)
+through the SAME server — per-(workload, family) batching, one queue,
+one degradation controller; the summary's ``families`` section carries
+the per-workload split.
 """
 
 from __future__ import annotations
@@ -88,6 +94,10 @@ def parse_args(argv=None):
     p.add_argument("--video_streams", type=int, default=0,
                    help="assign requests round-robin to N video streams "
                         "(flow_init warm-start chaining)")
+    p.add_argument("--stereo_every", type=int, default=0,
+                   help="route every Nth request to a STEREO disparity "
+                        "engine through the same server (heterogeneous "
+                        "per-family batching; 0 = flow only)")
     p.add_argument("--warm_iters", type=int, default=None,
                    help="iteration floor for fully-warm video batches")
     p.add_argument("--no_degrade", action="store_true")
@@ -170,9 +180,29 @@ def main(argv=None) -> int:
 
         engine.forward = wedged_forward
 
+    engines = {"flow": engine}
+    if args.stereo_every:
+        # heterogeneous session: a stereo disparity engine rides the
+        # SAME queue/batcher/controller; its requests batch in their
+        # own (workload, family) lane and dispatch its own executables
+        from raft_tpu.workloads.stereo import (STEREO_SERVE_OVERRIDES,
+                                               StereoRAFT,
+                                               compile_stereo_forward,
+                                               stereo_config)
+
+        stereo_model = StereoRAFT(stereo_config(
+            small=True, overrides=STEREO_SERVE_OVERRIDES))
+        stereo_vars = stereo_model.init(
+            jax.random.PRNGKey(args.seed + 1), init_img, init_img,
+            iters=2, train=True)
+        engines["stereo"] = ServeEngine(
+            stereo_model, stereo_vars, batch_size=args.batch_size,
+            aot_cache=aot, compile_fn=compile_stereo_forward,
+            cache_tag="stereo_serve", warm_channels=1)
+
     buckets = {"session": (H, W)}
     server = FlowServer(
-        engine, buckets=buckets, queue_capacity=args.queue_capacity,
+        engines, buckets=buckets, queue_capacity=args.queue_capacity,
         iter_levels=levels, slo_ms=args.slo_ms,
         degrade=not args.no_degrade, warm_iters=args.warm_iters,
         ledger=ledger, watchdog_timeout_s=args.watchdog_timeout)
@@ -200,6 +230,9 @@ def main(argv=None) -> int:
             img1[0, 0, 0] = np.nan
         stream = (f"s{i % args.video_streams}"
                   if args.video_streams else None)
+        workload = ("stereo" if args.stereo_every
+                    and (i % args.stereo_every) == args.stereo_every - 1
+                    else "flow")
         deadline = args.deadline_ms
         if inject == "deadline-storm":
             deadline = -1.0            # already expired at submit: the
@@ -208,7 +241,8 @@ def main(argv=None) -> int:
         try:
             futures.append(server.submit(img1, img2,
                                          deadline_ms=deadline,
-                                         stream=stream))
+                                         stream=stream,
+                                         workload=workload))
         except RequestError:           # typed shed (queue-full / bad
             futures.append(None)       # request), already counted
         if inject != "overload" and (i + 1) % args.batch_size == 0:

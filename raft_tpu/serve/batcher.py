@@ -78,7 +78,16 @@ class Request:
     t_submit: float
     deadline: Optional[float] = None     # absolute monotonic seconds
     stream: Optional[str] = None         # video stream id (warm start)
+    # which workload's executable serves this request ("flow",
+    # "stereo", ...): requests batch ONLY within one (workload,
+    # family) lane — a batch is one executable dispatch
+    workload: str = "flow"
     future: Future = field(default_factory=Future)
+
+    @property
+    def lane(self) -> Tuple[str, str]:
+        """The batching key: (workload, shape family)."""
+        return (self.workload, self.family)
 
 
 def validate_shape(image1: np.ndarray, image2: np.ndarray,
@@ -132,7 +141,10 @@ class RequestQueue:
             raise ValueError(f"queue capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self.buckets = dict(buckets)
-        self._lanes: Dict[str, collections.deque] = {}
+        # lanes keyed (workload, family): heterogeneous workloads share
+        # the queue's GLOBAL capacity (the device is one resource) but
+        # never share a batch (a batch is one executable dispatch)
+        self._lanes: Dict[Tuple[str, str], collections.deque] = {}
         self._size = 0
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
@@ -153,17 +165,21 @@ class RequestQueue:
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                deadline: Optional[float] = None,
                stream: Optional[str] = None,
+               workload: str = "flow",
                clock=time.monotonic) -> Request:
         """Admit a request or raise a typed :class:`RequestError`.
 
         Shape/bucket validation happens HERE (unservable work must not
         occupy capacity); the finiteness scan happens at assembly, off
-        the caller thread.
+        the caller thread.  ``workload`` picks the executable family
+        lane (the server validates it against its engine table before
+        calling in).
         """
         family = validate_shape(image1, image2, self.buckets)
         req = Request(rid=next(self._ids), image1=image1, image2=image2,
                       family=family, hw=tuple(image1.shape[:2]),
-                      t_submit=clock(), deadline=deadline, stream=stream)
+                      t_submit=clock(), deadline=deadline, stream=stream,
+                      workload=workload)
         with self._lock:
             if self._closed:
                 raise BadRequestError("server is shutting down")
@@ -172,25 +188,26 @@ class RequestQueue:
                     f"queue at capacity ({self.capacity}); shedding "
                     f"request {req.rid} typed instead of queueing "
                     f"unbounded")
-            self._lanes.setdefault(family, collections.deque()).append(req)
+            self._lanes.setdefault(req.lane,
+                                   collections.deque()).append(req)
             self._size += 1
             self._nonempty.notify()
         return req
 
     def pop_batch(self, max_batch: int,
                   timeout: Optional[float] = None) -> List[Request]:
-        """Up to ``max_batch`` requests from the family whose head is
-        oldest; blocks up to ``timeout`` for work.  Empty list on
-        timeout or close."""
+        """Up to ``max_batch`` requests from the (workload, family)
+        lane whose head is oldest; blocks up to ``timeout`` for work.
+        Empty list on timeout or close."""
         with self._lock:
             if not self._size:
                 self._nonempty.wait(timeout)
             if not self._size:
                 return []
-            family = min(
-                (f for f, lane in self._lanes.items() if lane),
-                key=lambda f: self._lanes[f][0].t_submit)
-            lane = self._lanes[family]
+            key = min(
+                (k for k, lane in self._lanes.items() if lane),
+                key=lambda k: self._lanes[k][0].t_submit)
+            lane = self._lanes[key]
             out = []
             while lane and len(out) < max_batch:
                 out.append(lane.popleft())

@@ -168,7 +168,9 @@ class ServeEngine:
     """
 
     def __init__(self, model, variables, batch_size: int = 4,
-                 aot_cache=None, spans=None):
+                 aot_cache=None, spans=None,
+                 compile_fn=None, cache_tag: str = "serve_forward",
+                 warm_channels: int = 2):
         import threading
 
         from raft_tpu.obs.spans import NULL
@@ -178,6 +180,16 @@ class ServeEngine:
         self.batch_size = int(batch_size)
         self.aot = aot_cache
         self.spans = spans if spans is not None else NULL
+        # Workload hooks: ``compile_fn`` is the lower->compile recipe
+        # (default: the flow forward; the stereo workload passes
+        # workloads.stereo.compile_stereo_forward), ``cache_tag``
+        # namespaces the AOT cache key per workload (two workloads'
+        # executables must never collide on a key), ``warm_channels``
+        # is the per-pixel width of the warm-start init (2 = flow_init,
+        # 1 = disp_init).
+        self.compile_fn = compile_fn or compile_test_forward
+        self.cache_tag = cache_tag
+        self.warm_channels = int(warm_channels)
         self._fns: Dict[tuple, object] = {}
         # the caller-thread warmup and the batcher thread can race the
         # same memo miss; serializing the compile path avoids paying
@@ -192,9 +204,10 @@ class ServeEngine:
             self._var_sig = _tree_signature(self.variables)
         H, W = hw
         img = ((self.batch_size, H, W, 3), "float32")
-        sig = (img, img) + ((((self.batch_size, H // 8, W // 8, 2),
+        sig = (img, img) + ((((self.batch_size, H // 8, W // 8,
+                               self.warm_channels),
                               "float32"),) if warm else ())
-        return forward_cache_key("serve_forward", self.model,
+        return forward_cache_key(self.cache_tag, self.model,
                                  self._var_sig, sig, iters, warm)
 
     def _build(self, hw: Tuple[int, int], iters: int, warm: bool):
@@ -204,10 +217,11 @@ class ServeEngine:
         H, W = hw
         B = self.batch_size
         img_sds = jax.ShapeDtypeStruct((B, H, W, 3), jnp.float32)
-        flow_sds = (jax.ShapeDtypeStruct((B, H // 8, W // 8, 2),
+        flow_sds = (jax.ShapeDtypeStruct((B, H // 8, W // 8,
+                                          self.warm_channels),
                                          jnp.float32) if warm else None)
-        return compile_test_forward(self.model, self.variables, img_sds,
-                                    img_sds, iters, flow_sds=flow_sds)
+        return self.compile_fn(self.model, self.variables, img_sds,
+                               img_sds, iters, flow_sds=flow_sds)
 
     def is_compiled(self, hw: Tuple[int, int], iters: int,
                     warm: bool = False) -> bool:
@@ -228,7 +242,7 @@ class ServeEngine:
             fn = self._fns.get(mkey)     # a racing thread compiled it
             if fn is not None:
                 return fn
-            label = (f"serve_forward B={self.batch_size} hw={hw} "
+            label = (f"{self.cache_tag} B={self.batch_size} hw={hw} "
                      f"iters={iters} warm={warm}")
             if self.aot is not None:
                 fn, was_warm = self.aot.get_or_compile(

@@ -67,7 +67,25 @@ class FlowServer:
         from raft_tpu.obs.spans import NULL, SpanRecorder
         from raft_tpu.serve.engine import default_buckets
 
-        self.engine = engine
+        # ``engine`` may be one engine (classic single-workload server:
+        # it serves as workload "flow") or a dict {workload: engine}
+        # (heterogeneous serving: flow + stereo through ONE queue,
+        # batcher and degradation controller — a batch never mixes
+        # workloads, see batcher.py lanes).  All engines must agree on
+        # batch_size: the batcher's pop quantum is one dispatch.
+        self.engines: Dict[str, object] = (
+            dict(engine) if isinstance(engine, dict)
+            else {"flow": engine})
+        if not self.engines:
+            raise ValueError("FlowServer needs at least one engine")
+        sizes = {e.batch_size for e in self.engines.values()}
+        if len(sizes) > 1:
+            raise ValueError(
+                f"engines disagree on batch_size ({sorted(sizes)}); the "
+                f"batcher's pop quantum is one dispatch")
+        # the default engine: single-engine servers keep the historic
+        # attribute; multi-engine servers use it for capacity numbers
+        self.engine = next(iter(self.engines.values()))
         self.buckets = dict(buckets or default_buckets())
         self.queue = RequestQueue(queue_capacity, self.buckets)
         self.slo_ms = slo_ms
@@ -77,9 +95,10 @@ class FlowServer:
         self._flush_every = int(flush_every)
         self.spans = (SpanRecorder(ledger=ledger, annotate=False)
                       if ledger is not None else NULL)
-        if getattr(engine, "spans", None) is NULL or \
-                getattr(engine, "spans", None) is None:
-            engine.spans = self.spans
+        for eng in self.engines.values():
+            if getattr(eng, "spans", None) is NULL or \
+                    getattr(eng, "spans", None) is None:
+                eng.spans = self.spans
 
         self.controller = IterationController(
             levels=iter_levels if degrade else iter_levels[:1],
@@ -92,6 +111,12 @@ class FlowServer:
             "rejected_deadline": 0, "rejected_bad_request": 0,
             "rejected_shutdown": 0, "batches": 0,
         }
+        # per-(workload, family) attribution: served counts + latency,
+        # so heterogeneous traffic stays separable in the obs report
+        # (one undifferentiated pool can hide a slow family behind a
+        # fast one).  Keys render as "workload/family".
+        self._family_latency: Dict[str, LatencyTracker] = {}
+        self._family_counts: Dict[str, Dict[str, int]] = {}
         self._incident_counts: Dict[str, int] = {}
         # stream -> last flow_low, LRU-bounded: stream ids are
         # client-chosen and unbounded in a long-lived server; an
@@ -162,10 +187,13 @@ class FlowServer:
             # first (completion flips the watchdog to steady state)
             token = self.watchdog.begin(
                 f"warmup compile of {len(fams)} family(ies) x "
-                f"{len(self.controller.levels)} level(s)", slow=True)
+                f"{len(self.controller.levels)} level(s) x "
+                f"{len(self.engines)} workload(s)", slow=True)
         try:
-            secs = self.engine.warmup(fams, self.controller.levels,
-                                      warm_too=warm_too)
+            secs = 0.0
+            for eng in self.engines.values():
+                secs += eng.warmup(fams, self.controller.levels,
+                                   warm_too=warm_too)
         finally:
             if token is not None:
                 self.watchdog.done(token)
@@ -176,11 +204,15 @@ class FlowServer:
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                deadline_ms: Optional[float] = None,
-               stream: Optional[str] = None):
+               stream: Optional[str] = None,
+               workload: str = "flow"):
         """Admit one request; returns its Future.  Raises the typed
         :class:`RequestError` subclasses on admission rejection (also
         counted + ledgered — the caller seeing the reason IS the typed
-        shed)."""
+        shed).  ``workload`` routes to that workload's executables
+        ("flow" by default; e.g. "stereo" on a server built with a
+        stereo engine) — an unknown workload is a typed bad-request,
+        it could never be served."""
         deadline = (self._clock() + deadline_ms / 1000.0
                     if deadline_ms is not None else None)
         # submitted and its admission outcome land under ONE lock hold
@@ -190,9 +222,14 @@ class FlowServer:
         with self._lock:
             self.counters["submitted"] += 1
             try:
+                if workload not in self.engines:
+                    raise BadRequestError(
+                        f"unknown workload {workload!r} (this server "
+                        f"serves: {sorted(self.engines)})")
                 req = self.queue.submit(image1, image2,
                                         deadline=deadline,
                                         stream=stream,
+                                        workload=workload,
                                         clock=self._clock)
             except RequestError as e:
                 key = ("rejected_queue_full" if e.kind == "queue-full"
@@ -237,37 +274,43 @@ class FlowServer:
             return
         req.future.set_exception(err)
 
-    def _warm_inits(self, kept, hw):
-        """Per-slot ``flow_init`` from each stream's previous
-        ``flow_low`` (forward-splatted — the paper's video warm start);
-        zero for cold slots (numerically the cold start).  Returns None
-        when NO slot is warm, so pure-cold batches use the cold
-        executable.  A stream whose stored state came from a DIFFERENT
-        bucket family (the client changed frame size mid-stream) is
-        dropped and cold-starts — a shape-mismatched warm init must
-        never kill the batcher."""
+    def _warm_inits(self, kept, hw, engine):
+        """Per-slot warm-start init from each stream's previous low-res
+        output: flow streams forward-splat it (the paper's video warm
+        start); 1-channel workloads (stereo disparity) reuse it as-is —
+        disparity carries no transport field to splat along.  Zero for
+        cold slots (numerically the cold start).  Returns None when NO
+        slot is warm, so pure-cold batches use the cold executable.  A
+        stream whose stored state came from a DIFFERENT bucket family
+        (the client changed frame size mid-stream) is dropped and
+        cold-starts — a shape-mismatched warm init must never kill the
+        batcher."""
         from raft_tpu.ops import forward_interpolate
 
         H, W = hw
-        B = self.engine.batch_size
+        B = engine.batch_size
+        wc = getattr(engine, "warm_channels", 2)
         any_warm = False
-        flow_init = np.zeros((B, H // 8, W // 8, 2), np.float32)
+        warm_init = np.zeros((B, H // 8, W // 8, wc), np.float32)
         for i, req in enumerate(kept):
             if req is None or req.stream is None:
                 continue
-            prev = self._streams.get(req.stream)
+            prev = self._streams.get((req.workload, req.stream))
             if prev is None:
                 continue
-            if prev.shape != (H // 8, W // 8, 2):
-                self._streams.pop(req.stream, None)
+            if prev.shape != (H // 8, W // 8, wc):
+                self._streams.pop((req.workload, req.stream), None)
                 continue
-            flow_init[i] = forward_interpolate(prev)
+            warm_init[i] = (forward_interpolate(prev) if wc == 2
+                            else prev)
             any_warm = True
-        return flow_init if any_warm else None
+        return warm_init if any_warm else None
 
-    def _remember_stream(self, stream: str, flow_low: np.ndarray) -> None:
-        self._streams[stream] = flow_low
-        self._streams.move_to_end(stream)
+    def _remember_stream(self, key, low: np.ndarray) -> None:
+        """``key`` is (workload, stream id): two workloads' client
+        stream namespaces must not collide on warm state."""
+        self._streams[key] = low
+        self._streams.move_to_end(key)
         while len(self._streams) > self._max_streams:
             self._streams.popitem(last=False)
 
@@ -304,7 +347,9 @@ class FlowServer:
                                    "%d; continuing", self._batch_no)
 
     def _process_batch(self, reqs, B: int) -> None:
+        workload = reqs[0].workload
         family = reqs[0].family
+        engine = self.engines[workload]
         hw = self.buckets[family]
         with self.spans.span("batch"):
             img1, img2, kept, rejected = assemble_batch(
@@ -325,9 +370,10 @@ class FlowServer:
                    / self.queue.capacity)
         iters = self.controller.observe(frac,
                                         self.latency.rolling_p95_ms())
-        flow_init = self._warm_inits(kept, hw)
+        flow_init = self._warm_inits(kept, hw, engine)
         if flow_init is not None and self.warm_iters is not None \
-                and all(r is None or (r.stream in self._streams)
+                and all(r is None
+                        or ((r.workload, r.stream) in self._streams)
                         for r in kept):
             # fully-warm video batch: flow_init starts the GRU at
             # last frame's solution, so the flat region extends
@@ -339,14 +385,15 @@ class FlowServer:
             # a not-yet-memoized executable pays a lazy compile (or
             # cache load) inside this bracket: grant it the compile
             # bound, not the dispatch bound
-            lazy = not self.engine.is_compiled(
+            lazy = not engine.is_compiled(
                 hw, iters, warm=flow_init is not None)
             token = self.watchdog.begin(
-                f"dispatch batch {self._batch_no} family={family} "
+                f"dispatch batch {self._batch_no} "
+                f"workload={workload} family={family} "
                 f"iters={iters} warm={flow_init is not None}"
                 + (" +compile" if lazy else ""), slow=lazy)
         try:
-            flow_low, flow_up = self.engine.forward(
+            flow_low, flow_up = engine.forward(
                 hw, iters, img1, img2, flow_init=flow_init)
         except Exception as e:  # noqa: BLE001 — a dispatch failure
             # must reject ITS requests typed, not kill the server
@@ -362,16 +409,23 @@ class FlowServer:
             self.watchdog.done(token)
 
         now = self._clock()
+        fam_label = f"{workload}/{family}"
         for i, req in enumerate(kept):
             if req is None:
                 continue
             h, w = req.hw
             if req.stream is not None:
-                self._remember_stream(req.stream, flow_low[i])
+                self._remember_stream((req.workload, req.stream),
+                                      flow_low[i])
             with self._lock:
                 self.counters["served"] += 1
                 self.counters["batches"] = self._batch_no
+                fc = self._family_counts.setdefault(
+                    fam_label, {"served": 0, "batches": 0})
+                fc["served"] += 1
             self.latency.add(now - req.t_submit)
+            self._family_latency.setdefault(
+                fam_label, LatencyTracker()).add(now - req.t_submit)
             if req.future.set_running_or_notify_cancel():
                 req.future.set_result(
                     {"flow": flow_up[i, :h, :w, :],
@@ -379,6 +433,9 @@ class FlowServer:
                      "iters": iters,
                      "warm": (flow_init is not None
                               and req.stream is not None)})
+        with self._lock:
+            if fam_label in self._family_counts:
+                self._family_counts[fam_label]["batches"] += 1
         self.spans.step_boundary()
 
     # -- shutdown ------------------------------------------------------------
@@ -400,6 +457,19 @@ class FlowServer:
             "slo_p95_ms": self.slo_ms,
             "degradation": self.controller.summary(),
         }
+        # per-(workload, family) attribution: the obs report renders
+        # one latency/throughput row per family, so flow and stereo
+        # traffic stay separable (a slow family cannot hide inside the
+        # pooled percentiles)
+        families = {}
+        for label, fc in sorted(self._family_counts.items()):
+            row = dict(fc)
+            lat = self._family_latency.get(label)
+            if lat is not None:
+                row.update(lat.percentiles_ms())
+            families[label] = row
+        if families:
+            summary["families"] = families
         if self.engine.aot is not None:
             summary["aot_cache"] = dict(self.engine.aot.stats)
         return summary

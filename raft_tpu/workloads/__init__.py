@@ -1,0 +1,60 @@
+"""Workloads grafted onto the corr/GRU machinery.
+
+The ops layer (corr pyramid, one-hot-lerp lookup, bilinear sampler,
+convex upsampler) is workload-agnostic; each module here is one
+product built on it:
+
+- ``stereo``: rectified stereo disparity — the 1D (epipolar-line)
+  correlation variant of the RAFT recurrence;
+- ``uncertainty``: per-pixel flow confidence trained against
+  forward-backward warp consistency (``ops/consistency.py``).
+
+Every lowerable graph a workload adds is a first-class record in
+``raft_tpu/entrypoints.py``: the five graftlint engines, the budget
+ledger, the AOT caches and the bench lanes iterate workloads from the
+registry, never from hand-maintained lists.
+"""
+
+from raft_tpu.workloads.stereo import (
+    StereoRAFT,
+    abstract_corr_lookup_1d,
+    abstract_stereo_forward,
+    abstract_stereo_serve_forward,
+    abstract_stereo_train_step,
+    build_corr_pyramid_1d,
+    compile_stereo_forward,
+    corr_lookup_1d,
+    disparity_sequence_loss,
+    make_stereo_test_forward,
+    make_stereo_train_step,
+    stereo_config,
+)
+from raft_tpu.workloads.uncertainty import (
+    abstract_uncertainty_forward,
+    abstract_uncertainty_step,
+    confidence_auc,
+    make_uncertainty_train_step,
+    uncertainty_config,
+    uncertainty_loss,
+)
+
+__all__ = [
+    "StereoRAFT",
+    "abstract_corr_lookup_1d",
+    "abstract_stereo_forward",
+    "abstract_stereo_serve_forward",
+    "abstract_stereo_train_step",
+    "build_corr_pyramid_1d",
+    "compile_stereo_forward",
+    "corr_lookup_1d",
+    "disparity_sequence_loss",
+    "make_stereo_test_forward",
+    "make_stereo_train_step",
+    "stereo_config",
+    "abstract_uncertainty_forward",
+    "abstract_uncertainty_step",
+    "confidence_auc",
+    "make_uncertainty_train_step",
+    "uncertainty_config",
+    "uncertainty_loss",
+]

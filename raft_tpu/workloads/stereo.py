@@ -1,0 +1,494 @@
+"""Stereo disparity: the corr/GRU machinery restricted to the epipolar
+line.
+
+RAFT-Stereo's observation (Lipson et al., 3DV 2021): rectified stereo
+is optical flow with the search space collapsed to one dimension — the
+matching pixel for left-image pixel ``(x, y)`` lies at ``(x - d, y)``
+in the right image, ``d >= 0``.  So the workload reuses everything the
+flow model already has — the feature/context encoders, the recurrent
+update block, the convex upsampler, the sequence loss — and swaps
+exactly two pieces:
+
+- the **correlation volume** is per-row: each left pixel correlates
+  only with its own epipolar row of the right image, ``(B, H, W1, W2)``
+  instead of ``(B, H1*W1, H2, W2)`` — H*W times smaller at level 0 —
+  and the pyramid pools the TARGET-x axis only (the epipolar line is a
+  structural invariant, pooling across rows would break rectification);
+- the **lookup** is the same one-hot-lerp gather-as-matmul machinery
+  with the y dimension gone: :func:`corr_lookup_1d` runs each level
+  through the existing 2D ``corr_lookup`` over a height-1 target row,
+  so the window weights, OOB-zero semantics and x-major tap order are
+  shared BY CONSTRUCTION, not re-implemented (the parity test pins the
+  dy=0 taps of a genuine 2D lookup bit-level against this path).
+
+The disparity head is the existing ``FlowHead`` at ``out_channels=1``
+(positive-only: the model clamps ``d <- max(d + delta, 0)`` each
+iteration — a negative disparity has no physical meaning under
+rectification).  Upsampling rides the existing convex upsampler by
+zero-padding disparity to the (dx, dy) channel pair it expects and
+keeping the dx half.
+
+Registry: ``stereo_forward`` / ``stereo_forward_bf16`` /
+``stereo_train_step`` / ``stereo_serve_forward`` /
+``stereo_serve_forward_warm`` / ``corr_lookup_1d`` in
+``raft_tpu/entrypoints.py`` — new builders here must register there.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
+from raft_tpu.models.update import (BasicUpdateBlock, MaskHead,
+                                    SmallUpdateBlock)
+from raft_tpu.ops.corr import corr_lookup, _check_pyramid_depth
+from raft_tpu.ops.grid import convex_upsample, upflow8
+
+# the serving default, mirrored from serve/engine.py's flow policy:
+# bf16 compute + corr, f32 disparity boundary
+STEREO_SERVE_OVERRIDES = {"compute_dtype": "bfloat16",
+                          "corr_dtype": "bfloat16"}
+
+
+# --------------------------------------------------------------------------
+# 1D correlation: per-row volumes, x-only pyramid, epipolar lookup
+# --------------------------------------------------------------------------
+
+def _avg_pool_w(x: jax.Array) -> jax.Array:
+    """2-wide stride-2 average pool along W only (floor crop of an odd
+    W, matching ``avg_pool2x``'s convention per axis)."""
+    B, H, W, C = x.shape
+    Wc = W // 2
+    x = x[:, :, : 2 * Wc, :]
+    return x.reshape(B, H, Wc, 2, C).mean(axis=3)
+
+
+def build_corr_pyramid_1d(fmap1: jax.Array, fmap2: jax.Array,
+                          num_levels: int = 4,
+                          dtype=jnp.float32) -> list:
+    """Per-row correlation pyramid: levels (B, H, W1, W2_l).
+
+    Level l is one matmul per row against the x-pooled fmap2 —
+    ``build_corr_pyramid_direct``'s recipe with the pooling restricted
+    to the epipolar axis.  Same dtype policy: bf16 storage implies bf16
+    matmul inputs (full MXU rate), accumulation always f32, and the
+    pooling CHAIN stays f32 so coarse levels don't compound a rounding
+    per level.  Normalized by sqrt(C).
+    """
+    B, H, W, C = fmap1.shape
+    # depth check on the pooled axis only: rows are never pooled
+    _check_pyramid_depth(2 ** (num_levels - 1), W, num_levels)
+    in_dt = jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
+    f1 = fmap1.astype(in_dt)
+    scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(C))
+    pyramid = []
+    f2 = fmap2.astype(jnp.float32)
+    for lvl in range(num_levels):
+        if lvl:
+            f2 = _avg_pool_w(f2)
+        corr = jnp.einsum("bhqc,bhtc->bhqt", f1, f2.astype(in_dt),
+                          preferred_element_type=jnp.float32)
+        pyramid.append((corr * scale).astype(dtype))
+    return pyramid
+
+
+def corr_lookup_1d(pyramid: Sequence[jax.Array], coords_x: jax.Array,
+                   radius: int) -> jax.Array:
+    """Epipolar correlation windows at each pyramid level.
+
+    Implemented BY the existing 2D lookup over a height-1 target row:
+    each level reshapes to a (B, H*W1, 1, W2_l) volume and runs
+    ``ops.corr.corr_lookup`` with the y coordinate pinned to the (only)
+    row — the bilinear row weights collapse to an exact 1.0 at dy=0, so
+    the dy=0 tap slice IS the epipolar window.  Sharing the machinery
+    is the point: window construction, OOB zeros, precision policy and
+    the x-major tap order cannot drift from the flow path.
+
+    Args:
+      pyramid: levels (B, H, W1, W2_l) from :func:`build_corr_pyramid_1d`.
+      coords_x: (B, H, W1) target x positions in image2 at level 0.
+      radius: window radius r.
+
+    Returns:
+      (B, H, W1, L*(2r+1)) float32, levels concatenated level-major.
+    """
+    B, H, W1 = coords_x.shape
+    k1 = 2 * radius + 1
+    zeros = jnp.zeros_like(coords_x, dtype=jnp.float32)
+    out = []
+    for i, corr in enumerate(pyramid):
+        W2 = corr.shape[3]
+        vol = corr.reshape(B, H * W1, 1, W2)
+        coords = jnp.stack(
+            [coords_x.astype(jnp.float32) / (2.0 ** i), zeros], axis=-1)
+        win = corr_lookup([vol], coords, radius)   # (B, H, W1, k1*k1)
+        # x-major window flattening (flat = kx*k1 + ky): the dy=0 taps
+        # sit at stride k1 starting at radius
+        out.append(win[..., radius::k1])
+    return jnp.concatenate(out, axis=-1).astype(jnp.float32)
+
+
+def abstract_corr_lookup_1d(batch: int = 1, hw=(8, 8), channels: int = 16,
+                            radius: int = 4, num_levels: int = 4):
+    """Lowerable 1D-lookup entry point behind the ``corr_lookup_1d``
+    record in ``raft_tpu/entrypoints.py``.  Shapes are the smallest
+    that keep every pooled-x level >= 1 px.
+
+    Returns ``(fn, (f1_sds, f2_sds, coords_x_sds))`` with ``fn``
+    supporting ``.lower()``.
+    """
+    H, W = hw
+    f_sds = jax.ShapeDtypeStruct((batch, H, W, channels), jnp.float32)
+    cx_sds = jax.ShapeDtypeStruct((batch, H, W), jnp.float32)
+
+    def fn(f1, f2, coords_x):
+        pyr = build_corr_pyramid_1d(f1, f2, num_levels)
+        return corr_lookup_1d(pyr, coords_x, radius=radius)
+
+    return jax.jit(fn), (f_sds, f_sds, cx_sds)
+
+
+# --------------------------------------------------------------------------
+# the model
+# --------------------------------------------------------------------------
+
+# ONE compute-dtype policy resolver (models/raft.py owns it): a policy
+# change must not leave the stereo workload resolving by an old rule
+from raft_tpu.models.raft import _compute_dtype  # noqa: E402
+
+
+class StereoRefinementStep(nn.Module):
+    """One GRU refinement iteration over disparity — the scan body.
+
+    The update block is the flow model's own (``BasicUpdateBlock`` /
+    ``SmallUpdateBlock``) at ``head_channels=1``; the 'flow' it sees is
+    the disparity expressed as epipolar motion ``(-d, 0)`` so the
+    motion encoder's input convention is unchanged.
+    """
+
+    cfg: RAFTConfig
+
+    @nn.compact
+    def __call__(self, carry, inp, pyramid, coords0_x):
+        cfg = self.cfg
+        dtype = _compute_dtype(cfg)
+        net, disp = carry
+
+        # per-iteration gradient cut, as on the flow path's coords1
+        disp = jax.lax.stop_gradient(disp)
+
+        corr = corr_lookup_1d(pyramid, coords0_x - disp[..., 0],
+                              cfg.corr_radius)
+        # disparity as epipolar flow: matching pixel sits at x - d
+        flow2 = jnp.concatenate([-disp, jnp.zeros_like(disp)], axis=-1)
+        corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1)
+        block_cls = SmallUpdateBlock if cfg.small else BasicUpdateBlock
+        block = block_cls(corr_ch, cfg.hidden_dim, dtype=dtype,
+                          head_channels=1, name="update_block")
+        net, delta = block(net, inp, corr.astype(dtype),
+                           flow2.astype(dtype))
+
+        # positive-only: a negative disparity has no physical meaning
+        # under rectification, and clamping here (not in the head)
+        # keeps the head's output an unconstrained delta
+        disp = nn.relu(disp + delta.astype(jnp.float32))
+        return (net, disp), (disp, net)
+
+
+class StereoRAFT(nn.Module):
+    """Disparity from the RAFT machinery: same encoders, 1D corr, same
+    GRU, 1-channel head, same convex upsampler.
+
+    Call convention mirrors :class:`~raft_tpu.models.raft.RAFT`: NHWC
+    uint8/float images in [0, 255], ``image1`` = left, ``image2`` =
+    right (rectified).  Train mode returns all ``iters`` upsampled
+    disparity iterates (iters, B, 8H, 8W, 1); test mode returns
+    ``(disp_low, disp_up)``.  ``disp_init`` (B, H/8, W/8, 1) warm-starts
+    the recurrence (the serving analogue of flow_init).
+    """
+
+    cfg: RAFTConfig = RAFTConfig()
+
+    @nn.compact
+    def __call__(self, image1, image2, iters: int = 12,
+                 disp_init: Optional[jax.Array] = None,
+                 train: bool = False, freeze_bn: bool = False,
+                 test_mode: bool = False):
+        cfg = self.cfg
+        dtype = _compute_dtype(cfg)
+        hdim, cdim = cfg.hidden_dim, cfg.context_dim
+        norm_train = train and not freeze_bn
+
+        image1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
+        image2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+
+        if cfg.small:
+            fnet = SmallEncoder(cfg.fnet_dim, "instance", cfg.dropout,
+                                dtype=dtype, train=train, name="fnet")
+            cnet = SmallEncoder(hdim + cdim, "none", cfg.dropout,
+                                dtype=dtype, train=train, name="cnet")
+        else:
+            fnet = BasicEncoder(cfg.fnet_dim, "instance", cfg.dropout,
+                                dtype=dtype, train=train, name="fnet")
+            cnet = BasicEncoder(hdim + cdim, "batch", cfg.dropout,
+                                dtype=dtype, train=train,
+                                norm_train=norm_train, name="cnet")
+
+        # both images as one 2B batch, as the flow model does
+        fmaps = fnet(jnp.concatenate([image1, image2], axis=0)
+                     .astype(dtype))
+        fmap1, fmap2 = jnp.split(fmaps.astype(jnp.float32), 2, axis=0)
+
+        corr_dt = (jnp.bfloat16 if cfg.corr_dtype == "bfloat16"
+                   else jnp.float32)
+        pyramid = tuple(build_corr_pyramid_1d(fmap1, fmap2,
+                                              cfg.corr_levels, corr_dt))
+
+        ctx = cnet(image1.astype(dtype))
+        net, inp = jnp.split(ctx, [hdim], axis=-1)
+        net = jnp.tanh(net)
+        inp = nn.relu(inp)
+
+        B, H8, W8 = fmap1.shape[0], fmap1.shape[1], fmap1.shape[2]
+        # level-0 x coordinate of each left pixel (the lookup center
+        # before subtracting disparity)
+        coords0_x = jnp.broadcast_to(
+            jnp.arange(W8, dtype=jnp.float32)[None, None, :], (B, H8, W8))
+        disp = jnp.zeros((B, H8, W8, 1), jnp.float32)
+        if disp_init is not None:
+            disp = nn.relu(disp + disp_init.astype(jnp.float32))
+
+        step_cls = StereoRefinementStep
+        if cfg.remat:
+            if cfg.remat_policy:
+                from raft_tpu.models.raft import resolve_remat_policy
+                step_cls = nn.remat(
+                    step_cls, policy=resolve_remat_policy(cfg.remat_policy))
+            else:
+                step_cls = nn.remat(step_cls)
+
+        scan = nn.scan(step_cls,
+                       variable_broadcast="params",
+                       split_rngs={"params": False},
+                       in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                       out_axes=0,
+                       length=iters)
+        (net, disp), (disps_lr, nets) = scan(cfg, name="refine")(
+            (net, disp), inp, pyramid, coords0_x)
+
+        mask_head = (None if cfg.small
+                     else MaskHead(dtype=dtype, name="mask_head"))
+
+        def upsample(d_lr, net_state):
+            # ride the 2-channel convex upsampler: disparity in the dx
+            # slot, zeros in dy, keep the dx half — upsampled disparity
+            # scales by 8 exactly like a flow vector (it is one)
+            d2 = jnp.concatenate([d_lr, jnp.zeros_like(d_lr)], axis=-1)
+            if mask_head is None:
+                return upflow8(d2)[..., :1]
+            return convex_upsample(d2, mask_head(net_state))[..., :1]
+
+        if test_mode:
+            # final carry (value-identical to disps_lr[-1]) so jit DCEs
+            # the stacked per-iterate outputs
+            return disp, upsample(disp, net)
+
+        n_it = disps_lr.shape[0]
+        flat = lambda x: x.reshape((n_it * B,) + x.shape[2:])
+        ups = upsample(flat(disps_lr), flat(nets))
+        return ups.reshape((n_it, B) + ups.shape[1:])
+
+
+# --------------------------------------------------------------------------
+# loss + train step (the existing sequence loss, disparity-shaped)
+# --------------------------------------------------------------------------
+
+def disparity_sequence_loss(disp_preds: jax.Array, disp_gt: jax.Array,
+                            valid: jax.Array, gamma: float = 0.8,
+                            max_disp: float = 400.0):
+    """``training.loss.sequence_loss`` over disparity iterates.
+
+    Disparity is zero-padded to the (dx, dy) channel pair the flow loss
+    expects — the y channel contributes exactly zero to both the L1 and
+    the EPE, so ``metrics['epe']`` is mean |d - d_gt| over valid pixels
+    and the 1/3/5px outlier rates keep their meaning.
+    """
+    from raft_tpu.training.loss import sequence_loss
+
+    if disp_gt.ndim == 3:
+        disp_gt = disp_gt[..., None]
+    flow_preds = jnp.concatenate(
+        [disp_preds, jnp.zeros_like(disp_preds)], axis=-1)
+    flow_gt = jnp.concatenate([disp_gt, jnp.zeros_like(disp_gt)], axis=-1)
+    return sequence_loss(flow_preds, flow_gt, valid, gamma=gamma,
+                         max_flow=max_disp)
+
+
+def make_stereo_train_step(model: StereoRAFT, iters: int,
+                           gamma: float = 0.8, max_disp: float = 400.0,
+                           freeze_bn: bool = False, donate: bool = False):
+    """Jitted stereo train step over ``training.state.TrainState``.
+
+    The flow step's shape minus the parts stereo doesn't need (wire
+    decode, accumulation, noise): forward through all iterates,
+    gamma-weighted disparity L1, AdamW update, the same in-graph
+    nonfinite sentinel the metrics bus inspects.  Batches carry
+    ``image1``/``image2``/``disp``/``valid``.
+    """
+    from raft_tpu.obs.health import nonfinite_sentinel
+    from raft_tpu.training.step import optax_global_norm
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state, batch: Dict[str, jax.Array]):
+        rng, step_rng = jax.random.split(state.rng)
+
+        def loss_fn(params, batch_stats):
+            variables = {"params": params}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+            out = model.apply(
+                variables, batch["image1"], batch["image2"], iters=iters,
+                train=True, freeze_bn=freeze_bn,
+                mutable=["batch_stats"] if batch_stats else [],
+                rngs={"dropout": step_rng})
+            preds, new_model_state = out
+            loss, metrics = disparity_sequence_loss(
+                preds, batch["disp"], batch["valid"], gamma=gamma,
+                max_disp=max_disp)
+            return loss, (metrics, new_model_state)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (metrics, new_model_state)), grads = grad_fn(
+            state.params, state.batch_stats)
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+        new_state = state.apply_gradients(grads=grads)
+        new_state = new_state.replace(
+            rng=rng,
+            batch_stats=new_model_state.get("batch_stats",
+                                            state.batch_stats))
+        metrics["grad_norm"] = optax_global_norm(grads)
+        metrics["nonfinite"] = nonfinite_sentinel(metrics["loss"],
+                                                  metrics["grad_norm"])
+        return new_state, metrics
+
+    return train_step
+
+
+# --------------------------------------------------------------------------
+# serving forwards (the graphs ServeEngine compiles for stereo buckets)
+# --------------------------------------------------------------------------
+
+def make_stereo_test_forward(model: StereoRAFT, iters: int, warm: bool):
+    """THE jitted stereo test_mode forward (cold, or the ``disp_init``
+    warm-start variant) — single definition shared by the serving
+    executors and ``abstract_stereo_serve_forward``, so the audited
+    graph is the served graph."""
+    if warm:
+        return jax.jit(lambda v, a, b, d: model.apply(
+            v, a, b, iters=iters, disp_init=d, test_mode=True))
+    return jax.jit(lambda v, a, b: model.apply(
+        v, a, b, iters=iters, test_mode=True))
+
+
+def compile_stereo_forward(model, variables, img1_sds, img2_sds,
+                           iters: int, flow_sds=None):
+    """lower -> compile :func:`make_stereo_test_forward` — the stereo
+    ServeEngine's build recipe (``compile_fn``).  ``flow_sds`` names
+    the warm-start init to keep the signature interchangeable with
+    ``serve.engine.compile_test_forward``; for stereo it is the
+    (B, H/8, W/8, 1) ``disp_init``."""
+    fn = make_stereo_test_forward(model, iters, warm=flow_sds is not None)
+    if flow_sds is not None:
+        return fn.lower(variables, img1_sds, img2_sds, flow_sds).compile()
+    return fn.lower(variables, img1_sds, img2_sds).compile()
+
+
+def stereo_config(small: bool = False,
+                  overrides: Optional[Dict] = None) -> RAFTConfig:
+    """The stereo model config builder (training defaults f32; serving
+    passes :data:`STEREO_SERVE_OVERRIDES`)."""
+    kw: Dict[str, Any] = {"small": small}
+    kw.update(overrides or {})
+    return RAFTConfig(**kw)
+
+
+def abstract_stereo_forward(iters: int = 2, hw: Tuple[int, int] = (64, 64),
+                            batch: int = 1,
+                            overrides: Optional[Dict] = None):
+    """The f32 test-mode stereo forward over abstract inputs: the
+    lowerable entry point behind the ``stereo_forward`` /
+    ``stereo_forward_bf16`` records in ``raft_tpu/entrypoints.py``.
+
+    Returns ``(fwd, (variables_sds, img_sds, img_sds))``.
+    """
+    model = StereoRAFT(stereo_config(overrides=dict(overrides or {})))
+    H, W = hw
+    img_sds = jax.ShapeDtypeStruct((batch, H, W, 3), jnp.float32)
+    variables_sds = jax.eval_shape(
+        lambda rng, a, b: model.init(rng, a, b, iters=iters, train=True),
+        jax.random.PRNGKey(0), img_sds, img_sds)
+    fwd = make_stereo_test_forward(model, iters, warm=False)
+    return fwd, (variables_sds, img_sds, img_sds)
+
+
+def abstract_stereo_serve_forward(iters: int = 2,
+                                  hw: Tuple[int, int] = (64, 64),
+                                  batch: int = 2, warm: bool = False,
+                                  overrides: Optional[Dict] = None):
+    """The stereo serving executor's batched bf16 forward over abstract
+    inputs — the ``stereo_serve_forward`` / ``stereo_serve_forward_warm``
+    records.  ``warm=True`` adds the (B, H/8, W/8, 1) ``disp_init``.
+
+    Returns ``(fwd, args_sds)``.
+    """
+    kw = dict(STEREO_SERVE_OVERRIDES)
+    kw.update(overrides or {})
+    model = StereoRAFT(stereo_config(overrides=kw))
+    H, W = hw
+    img_sds = jax.ShapeDtypeStruct((batch, H, W, 3), jnp.float32)
+    variables_sds = jax.eval_shape(
+        lambda rng, a, b: model.init(rng, a, b, iters=iters, train=True),
+        jax.random.PRNGKey(0), img_sds, img_sds)
+    fwd = make_stereo_test_forward(model, iters, warm=warm)
+    if warm:
+        disp_sds = jax.ShapeDtypeStruct((batch, H // 8, W // 8, 1),
+                                        jnp.float32)
+        return fwd, (variables_sds, img_sds, img_sds, disp_sds)
+    return fwd, (variables_sds, img_sds, img_sds)
+
+
+def abstract_stereo_train_step(iters: int = 2, batch_size: int = 2,
+                               hw: Tuple[int, int] = (64, 64),
+                               donate: bool = False,
+                               overrides: Optional[Dict] = None):
+    """The real jitted stereo train step over abstract inputs: the
+    lowerable entry point behind the ``stereo_train_step`` record.
+    Everything abstract — nothing allocates.
+
+    Returns ``(step, (state_sds, batch_sds))``.
+    """
+    from raft_tpu.training.optim import make_optimizer
+    from raft_tpu.training.state import create_train_state
+
+    model = StereoRAFT(stereo_config(overrides=dict(overrides or {})))
+    tx, _ = make_optimizer(lr=4e-4, num_steps=100, wdecay=1e-4)
+    H, W = hw
+    sds = jax.ShapeDtypeStruct
+    batch_sds = {
+        "image1": sds((batch_size, H, W, 3), jnp.float32),
+        "image2": sds((batch_size, H, W, 3), jnp.float32),
+        "disp": sds((batch_size, H, W), jnp.float32),
+        "valid": sds((batch_size, H, W), jnp.float32),
+    }
+    state_sds = jax.eval_shape(
+        lambda rng, b: create_train_state(model, tx, rng, b, iters=iters),
+        jax.random.PRNGKey(0), batch_sds)
+    step = make_stereo_train_step(model, iters=iters, donate=donate)
+    return step, (state_sds, batch_sds)

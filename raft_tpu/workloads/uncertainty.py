@@ -1,0 +1,251 @@
+"""Occlusion/uncertainty workload: a trainable per-pixel confidence
+signal for flow.
+
+Production consumers need to know WHERE a flow field can be trusted
+before they act on it: occluded pixels (and pixels whose target left
+the frame) have no visible correspondence, so their vectors are
+extrapolation.  The supervision signal already exists in the codebase —
+the forward-backward warp check the demo CLIs render
+(``ops/consistency.py``) — UnFlow's observation (Meister et al., AAAI
+2018) is that thresholding it yields a trainable occlusion label.
+
+The head itself is ``models/update.py UncertaintyHead`` hanging off the
+context features behind ``RAFTConfig.uncertainty_head`` (optional by
+construction: flow-only checkpoints never see its parameters, and the
+model's outputs only grow the extra logit when the flag is on).  This
+module owns the TRAINING side: the BCE loss against
+forward-backward-derived occlusion masks, the joint train step, the
+host-side AUC metric the acceptance gate scores, and the abstract
+builders behind the ``uncertainty_forward`` /
+``uncertainty_forward_bf16`` / ``uncertainty_train_step`` records in
+``raft_tpu/entrypoints.py`` — new builders here must register there.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+from raft_tpu.ops.consistency import fb_consistency
+
+
+def uncertainty_loss(conf_logits: jax.Array, flow_fwd: jax.Array,
+                     flow_bwd: jax.Array,
+                     alpha: Optional[float] = None,
+                     beta: Optional[float] = None):
+    """BCE of the confidence logit against the forward-backward
+    occlusion mask.
+
+    The target is derived INSIDE the loss from a (fwd, bwd) flow pair —
+    ground-truth flows on the synthetic consistency stage, or
+    stop-gradient model flows in self-supervised mode — via the same
+    :func:`~raft_tpu.ops.consistency.fb_consistency` op the demos
+    render, so what the head learns is exactly what the demo shows.
+
+    ``conf_logits``: (B, H, W, 1); positive = "trust this vector"
+    (i.e. the head predicts VISIBILITY, the complement of occlusion).
+
+    Returns ``(scalar BCE, dict(occ_target, occ_rate))``.
+    """
+    kw = {}
+    if alpha is not None:
+        kw["alpha"] = alpha
+    if beta is not None:
+        kw["beta"] = beta
+    fb = fb_consistency(jax.lax.stop_gradient(flow_fwd),
+                        jax.lax.stop_gradient(flow_bwd), **kw)
+    occ = fb["occ"]                                   # (B, H, W)
+    visible = 1.0 - occ
+    logits = conf_logits[..., 0].astype(jnp.float32)
+    # numerically-stable sigmoid BCE: max(x,0) - x*z + log1p(exp(-|x|))
+    bce = (jnp.maximum(logits, 0.0) - logits * visible
+           + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    return jnp.mean(bce), {"occ_target": occ,
+                           "occ_rate": jnp.mean(occ)}
+
+
+def make_uncertainty_train_step(model: RAFT, iters: int,
+                                gamma: float = 0.8,
+                                max_flow: float = 400.0,
+                                conf_weight: float = 1.0,
+                                flow_weight: float = 1.0,
+                                self_supervised: bool = False,
+                                donate: bool = False):
+    """Jitted joint train step: sequence flow loss + confidence BCE.
+
+    ``model.cfg.uncertainty_head`` must be True (the step consumes the
+    extra logit output).  The occlusion target comes from the batch's
+    ground-truth flow pair (``flow``/``flow_bwd`` — the synthetic
+    consistency stage ships both) unless ``self_supervised=True``, in
+    which case the model itself produces the backward flow with a
+    second stop-gradient test-mode forward (datasets without backward
+    ground truth).  ``flow_weight=0`` trains the head alone (the AUC
+    gate's fastest configuration) — the flow loss is still computed for
+    its metrics, it just doesn't move the encoder.
+    """
+    from raft_tpu.obs.health import nonfinite_sentinel
+    from raft_tpu.training.loss import sequence_loss
+    from raft_tpu.training.step import optax_global_norm
+
+    if not model.cfg.uncertainty_head:
+        raise ValueError("make_uncertainty_train_step needs a model with "
+                         "cfg.uncertainty_head=True — the step trains "
+                         "the confidence logit this config gates")
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state, batch: Dict[str, jax.Array]):
+        rng, step_rng = jax.random.split(state.rng)
+
+        def loss_fn(params, batch_stats):
+            variables = {"params": params}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+            out = model.apply(
+                variables, batch["image1"], batch["image2"], iters=iters,
+                train=True,
+                mutable=["batch_stats"] if batch_stats else [],
+                rngs={"dropout": step_rng})
+            (preds, conf), new_model_state = out
+            flow_loss, metrics = sequence_loss(
+                preds, batch["flow"], batch["valid"], gamma=gamma,
+                max_flow=max_flow)
+            if self_supervised:
+                # backward flow from the model itself, gradient-free:
+                # the target must not backprop into the forward it
+                # scores (a head that can move its own target collapses)
+                bwd_out = model.apply(
+                    jax.tree.map(jax.lax.stop_gradient, variables),
+                    batch["image2"], batch["image1"], iters=iters,
+                    test_mode=True)
+                flow_bwd = bwd_out[1]
+                flow_fwd = preds[-1]
+            else:
+                flow_fwd = batch["flow"]
+                flow_bwd = batch["flow_bwd"]
+            bce, conf_aux = uncertainty_loss(conf, flow_fwd, flow_bwd)
+            metrics = dict(metrics)
+            metrics["conf_bce"] = bce
+            metrics["occ_rate"] = conf_aux["occ_rate"]
+            total = flow_weight * flow_loss + conf_weight * bce
+            return total, (metrics, new_model_state)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (metrics, new_model_state)), grads = grad_fn(
+            state.params, state.batch_stats)
+        metrics["loss"] = loss
+        new_state = state.apply_gradients(grads=grads)
+        new_state = new_state.replace(
+            rng=rng,
+            batch_stats=new_model_state.get("batch_stats",
+                                            state.batch_stats))
+        metrics["grad_norm"] = optax_global_norm(grads)
+        metrics["nonfinite"] = nonfinite_sentinel(metrics["loss"],
+                                                  metrics["grad_norm"])
+        return new_state, metrics
+
+    return train_step
+
+
+def confidence_auc(conf_logits: np.ndarray, occ: np.ndarray) -> float:
+    """Host-side ROC AUC of the confidence logit as a VISIBILITY score
+    against the 0/1 occlusion mask (rank-based Mann-Whitney form — no
+    sklearn dependency).  A constant predictor scores exactly 0.5;
+    the acceptance gate demands the trained head beat it.
+
+    Returns NaN when either class is empty (no gradeable signal).
+    """
+    # graftlint: disable=f64-literal -- host-side AUC rank sums over up
+    # to millions of pixels; f32 rank accumulation loses integer
+    # exactness past 2^24 and never touches a device
+    scores = -np.asarray(conf_logits, np.float64).reshape(-1)  # occ score
+    labels = np.asarray(occ, np.float32).reshape(-1) >= 0.5
+    if scores.size != labels.size:
+        raise ValueError(
+            f"conf_logits ({scores.size} px) and occ ({labels.size} px) "
+            f"must cover the same pixels")
+    n_pos = int(labels.sum())
+    n_neg = labels.size - n_pos
+    if not n_pos or not n_neg:
+        return float("nan")
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(labels.size, np.float64)  # graftlint: disable=f64-literal -- host-side rank buffer (exact integer ranks past 2^24)
+    ranks[order] = np.arange(1, labels.size + 1)
+    # average ties so a constant predictor lands exactly at 0.5
+    uniq, inv = np.unique(scores, return_inverse=True)
+    if uniq.size != scores.size:
+        sums = np.zeros(uniq.size)
+        counts = np.zeros(uniq.size)
+        np.add.at(sums, inv, ranks)
+        np.add.at(counts, inv, 1.0)
+        ranks = (sums / counts)[inv]
+    rank_pos = ranks[labels].sum()
+    return float((rank_pos - n_pos * (n_pos + 1) / 2.0)
+                 / (n_pos * n_neg))
+
+
+# --------------------------------------------------------------------------
+# abstract builders (the registry records)
+# --------------------------------------------------------------------------
+
+def uncertainty_config(small: bool = False,
+                       overrides: Optional[Dict] = None) -> RAFTConfig:
+    kw = {"small": small, "uncertainty_head": True}
+    kw.update(overrides or {})
+    return RAFTConfig(**kw)
+
+
+def abstract_uncertainty_forward(iters: int = 2,
+                                 hw: Tuple[int, int] = (64, 64),
+                                 batch: int = 1,
+                                 overrides: Optional[Dict] = None):
+    """The test-mode forward WITH the confidence head: the lowerable
+    entry point behind the ``uncertainty_forward`` /
+    ``uncertainty_forward_bf16`` records — the graph whose extra logit
+    path (conf convs + bilinear upsample) only exists under
+    ``cfg.uncertainty_head``.
+
+    Returns ``(fwd, (variables_sds, img_sds, img_sds))``.
+    """
+    model = RAFT(uncertainty_config(overrides=dict(overrides or {})))
+    H, W = hw
+    img_sds = jax.ShapeDtypeStruct((batch, H, W, 3), jnp.float32)
+    variables_sds = jax.eval_shape(
+        lambda rng, a, b: model.init(rng, a, b, iters=iters, train=True),
+        jax.random.PRNGKey(0), img_sds, img_sds)
+    fwd = jax.jit(lambda v, a, b: model.apply(v, a, b, iters=iters,
+                                              test_mode=True))
+    return fwd, (variables_sds, img_sds, img_sds)
+
+
+def abstract_uncertainty_step(iters: int = 2, batch_size: int = 2,
+                              hw: Tuple[int, int] = (64, 64),
+                              overrides: Optional[Dict] = None):
+    """The joint train step over abstract inputs (GT-pair target mode):
+    the lowerable entry point behind the ``uncertainty_train_step``
+    record.  Returns ``(step, (state_sds, batch_sds))``.
+    """
+    from raft_tpu.training.optim import make_optimizer
+    from raft_tpu.training.state import create_train_state
+
+    model = RAFT(uncertainty_config(overrides=dict(overrides or {})))
+    tx, _ = make_optimizer(lr=4e-4, num_steps=100, wdecay=1e-4)
+    H, W = hw
+    sds = jax.ShapeDtypeStruct
+    batch_sds = {
+        "image1": sds((batch_size, H, W, 3), jnp.float32),
+        "image2": sds((batch_size, H, W, 3), jnp.float32),
+        "flow": sds((batch_size, H, W, 2), jnp.float32),
+        "flow_bwd": sds((batch_size, H, W, 2), jnp.float32),
+        "valid": sds((batch_size, H, W), jnp.float32),
+    }
+    state_sds = jax.eval_shape(
+        lambda rng, b: create_train_state(model, tx, rng, b, iters=iters),
+        jax.random.PRNGKey(0), batch_sds)
+    step = make_uncertainty_train_step(model, iters=iters)
+    return step, (state_sds, batch_sds)

@@ -65,6 +65,10 @@ through ``obs report --fail-on-incident fatal``:
                          dispatch
 - ``serve-poison``       a NaN-pixel request -> typed ``bad-request``,
                          the rest of the load served normally
+- ``serve-mixed-family`` flow + stereo requests interleaved through ONE
+                         server -> per-(workload, family) batching,
+                         conservation and attribution hold with
+                         heterogeneous workloads
 - ``serve-kill-restart-warm`` cold run writes the AOT cache; SIGKILL
                          mid-serve (no cleanup) -> restart loads the
                          cache warm (< 50% of the cold startup,
@@ -369,7 +373,8 @@ def serve_main(args, env, workdir):
             "16", "--iter_levels", "4,2"]
 
     all_names = ("serve-overload", "serve-deadline-storm", "serve-poison",
-                 "serve-kill-restart-warm", "serve-stall")
+                 "serve-mixed-family", "serve-kill-restart-warm",
+                 "serve-stall")
     if args.only and args.only not in all_names:
         print(f"unknown serve scenario {args.only!r} "
               f"(known: {', '.join(all_names)})")
@@ -457,6 +462,29 @@ def serve_main(args, env, workdir):
                     f"bad={summary and summary['rejected_bad_request']} "
                     f"served={summary and summary['served']}")
         finish(name, {"bad-request"}, False, fail, [ledger(name, "run")])
+
+    # -- mixed family: flow + stereo interleaved through ONE server —
+    # per-(workload, family) batching, degradation and conservation
+    # must hold with heterogeneous workloads (the PR-12 workload
+    # subsystem's serving acceptance row)
+    if want("serve-mixed-family"):
+        name, fail = "serve-mixed-family", None
+        rc, _, summary, tail = run_serve(
+            workdir, name, base + ["--stereo_every", "2"], env)
+        fams = (summary or {}).get("families") or {}
+        if rc != 0:
+            fail = f"exit {rc} != 0\n{tail}"
+        elif summary is None or summary["unaccounted"] != 0:
+            fail = f"silent drops: {summary and summary['unaccounted']}"
+        elif summary["served"] != 8:
+            fail = f"expected 8/8 served, got {summary['served']}"
+        elif set(fams) != {"flow/session", "stereo/session"}:
+            fail = (f"expected per-family attribution for both "
+                    f"workloads, got {sorted(fams)}")
+        elif any(f["served"] != 4 for f in fams.values()):
+            fail = (f"expected a 4/4 flow-stereo split, got "
+                    f"{ {k: f['served'] for k, f in fams.items()} }")
+        finish(name, set(), False, fail, [ledger(name, "run")])
 
     # -- kill + restart warm: the AOT cache survives SIGKILL (atomic
     # writes), the restart is measurably warm, and a TORN cache file

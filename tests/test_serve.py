@@ -630,13 +630,17 @@ def test_warm_init_family_change_drops_stream_instead_of_crashing(engine):
     server = FlowServer(engine, buckets={"t": HW}, queue_capacity=4,
                         iter_levels=(2,), degrade=False)
     try:
-        server._streams["cam0"] = np.zeros((4, 4, 2), np.float32)  # wrong
+        # stream state keys are (workload, stream id) since the
+        # heterogeneous-workload server
+        server._streams[("flow", "cam0")] = np.zeros((4, 4, 2),
+                                                     np.float32)  # wrong
         img = np.zeros(HW + (3,), np.float32)
         req = _req(img, img, rid=1)
         req.stream = "cam0"
-        flow_init = server._warm_inits([req, None], HW)
+        flow_init = server._warm_inits([req, None], HW, server.engine)
         assert flow_init is None, "mismatched stream state must cold-start"
-        assert "cam0" not in server._streams, "stale state must be evicted"
+        assert ("flow", "cam0") not in server._streams, \
+            "stale state must be evicted"
     finally:
         server.close()
 

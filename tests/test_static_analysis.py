@@ -1486,3 +1486,68 @@ def test_registry_gate_repo_clean(registry_results):
     # every registered entry actually traced (none skipped)
     assert set(report["trace"]["seconds"]) == set(ep.ENTRYPOINTS)
     assert report["coverage"]["call_sites_flagged"] == 0
+
+
+def test_registry_add_an_entry_contract(tmp_path, monkeypatch):
+    """SATELLITE (PR 12): registering a toy workload entry end-to-end —
+    engine-5 coverage, budgets sections, trace gate and bench_lane
+    stamping all pick it up with ZERO edits to analysis/ (the engines'
+    tables and checks derive from the registry; the only code below
+    that touches analysis/ calls its public derivation functions)."""
+    import shutil
+
+    def _build_toy():
+        def fn(x):
+            return x * 2.0 + 1.0
+
+        return jax.jit(fn), (jax.ShapeDtypeStruct((4, 4), jnp.float32),)
+
+    toy = ep.EntryPoint(
+        "toy_workload",
+        anchor=("toy_workload_mod", "abstract_toy_workload"),
+        build=_build_toy, hlo=True, bench_lane="toy_lane")
+    monkeypatch.setitem(ep.ENTRYPOINTS, "toy_workload", toy)
+    # the hlo engine's table is a registry derivation; re-derive the
+    # one new row exactly the way module import does
+    monkeypatch.setitem(ha.ENTRIES, "toy_workload",
+                        ha._from_registry(toy))
+
+    # (1) engine-5 coverage: the toy anchor joins the reachability
+    # roots, so a jit call site inside its builder is covered
+    assert "abstract_toy_workload" in ep.coverage_roots()
+    fixture = tmp_path / "toy_workload_mod.py"
+    fixture.write_text(textwrap.dedent("""\
+        import jax
+
+
+        def abstract_toy_workload():
+            return jax.jit(lambda x: x * 2.0), ()
+    """))
+    assert ra.scan_coverage([str(fixture)]) == []
+
+    # (2) budgets sections: the declared section demands a ledger row
+    # (missing-budget) until a re-baseline writes one, after which the
+    # cross-check is clean — no orphan, no missing
+    assert "toy_workload" in ep.expected_budget_rows("entries")
+    ledger = tmp_path / "budgets.json"
+    shutil.copy(bmod.default_budgets_path(), ledger)
+    missing = [f for f in ra.check_budgets(str(ledger))
+               if f.rule == "missing-budget"]
+    assert [f.data["row"] for f in missing if f.data] == ["toy_workload"]
+    findings, _ = ha.run_hlo_audit(names=["toy_workload"],
+                                   budgets_path=str(ledger), update=True)
+    assert fmod.gate(findings) == []
+    assert ra.check_budgets(str(ledger)) == []
+
+    # (3) trace gate: the toy entry traces like any registered graph
+    # (scoped to the toy alone — test_registry_gate_repo_clean already
+    # traces the full registry once; re-tracing 24 entries here would
+    # double-bill ~20 s of tier-1 wall clock)
+    with monkeypatch.context() as mctx:
+        mctx.setattr(ep, "ENTRYPOINTS", {"toy_workload": toy})
+        tf, treport = ra.check_traces()
+    assert fmod.gate(tf) == []
+    assert "toy_workload" in treport["seconds"]
+
+    # (4) bench stamping: the lane -> entry map the scoreboard embeds
+    assert ep.bench_lanes()["toy_lane"] == "toy_workload"
