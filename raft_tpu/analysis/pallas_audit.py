@@ -409,6 +409,30 @@ def _fixture_missized():
     return jax.jit(fn), (sds,), [VRange(-1.0, 1.0)]
 
 
+def _fixture_gru_oversized():
+    """The REAL fused-GRU line kernel (ops/gru_pallas.py) at a width
+    its band layout cannot fit: a 16-row band of a W=4096 hidden state
+    is a ~67 MB h-block alone — the cap finding must anchor file:line
+    INSIDE gru_pallas.py, proving the verifier reads the production
+    kernel's BlockSpecs, not a toy's."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.analysis.numerics_audit import VRange
+    from raft_tpu.ops.gru_pallas import gru_line_pallas
+
+    ch, cx, H, W = 256, 512, 16, 4096
+    sds = lambda *s: jax.ShapeDtypeStruct(tuple(s), jnp.float32)
+    w = lambda: sds(1, 5, ch + cx, ch)
+    args = (sds(1, H, W, ch), sds(1, H, W, cx),
+            w(), sds(ch), w(), sds(ch), w(), sds(ch))
+
+    def fn(h, x, wz, bz, wr, br, wq, bq):
+        return gru_line_pallas(h, x, wz, bz, wr, br, wq, bq)
+
+    return jax.jit(fn), args, [VRange(-1.0, 1.0)] * len(args)
+
+
 def _fixture_entries():
     from raft_tpu.analysis.numerics_audit import NumEntry
 
@@ -418,6 +442,9 @@ def _fixture_entries():
             budgeted=False),
         "seeded_pallas_missized": NumEntry(
             "seeded_pallas_missized", _fixture_missized, pallas=True,
+            budgeted=False),
+        "seeded_gru_oversized": NumEntry(
+            "seeded_gru_oversized", _fixture_gru_oversized, pallas=True,
             budgeted=False),
     }
 

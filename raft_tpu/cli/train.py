@@ -96,6 +96,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="deprecated no-op: the deferred cotangent has "
                         "defaulted OFF since the round-3 measurement; "
                         "kept so pre-flip launch scripts keep running")
+    p.add_argument("--fused_update_block", action="store_true",
+                   help="force the fused Pallas update block "
+                        "(ops/gru_pallas.py): motion encoder + GRU as "
+                        "VMEM-resident kernels, forward and backward.  "
+                        "Default is automatic — currently the flax conv "
+                        "path everywhere until the on-chip A/B lands "
+                        "(scripts/perf_probe.py fused_update family)")
+    p.add_argument("--no_fused_update_block", action="store_true",
+                   help="force the flax conv update block (the parity "
+                        "reference path)")
     p.add_argument("--datasets_root", default="datasets")
     p.add_argument("--checkpoint_dir", default="checkpoints")
     p.add_argument("--log_dir", default="runs")
@@ -218,6 +228,10 @@ def build_config(args):
             "--deferred_corr_grad and --no_deferred_corr_grad both given; "
             "drop the deprecated --no_deferred_corr_grad (a no-op: OFF is "
             "the default)")
+    if args.fused_update_block and args.no_fused_update_block:
+        raise SystemExit(
+            "--fused_update_block and --no_fused_update_block both "
+            "given; pick one")
     model = dataclasses.replace(
         preset.model,
         small=args.small,
@@ -227,6 +241,9 @@ def build_config(args):
         corr_shard=args.spatial_parallel > 1,
         corr_shard_impl=args.corr_shard_impl,
         deferred_corr_grad=args.deferred_corr_grad,
+        fused_update_block=(True if args.fused_update_block
+                            else False if args.no_fused_update_block
+                            else None),
         **({"corr_dtype": args.corr_dtype} if args.corr_dtype else {}),
     )
     if args.device_aug and args.no_device_aug:

@@ -135,6 +135,27 @@ class RAFTConfig:
     # doubling the mask bytes through the whole backward costs more
     # than the reduce pattern saves.  Default OFF by that measurement.
     mask_conv2_f32: bool = False
+    # Fused Pallas update block (ops/gru_pallas.py): the per-iteration
+    # motion encoder + GRU run as fused VMEM-resident kernels (forward
+    # AND backward) instead of the flax conv graph.  Tri-state like
+    # DataConfig.device_aug: None = auto — currently OFF everywhere
+    # (the kernels are parity- and gradient-proven in tier-1 but
+    # unmeasured on hardware; once the chip A/B lands, auto becomes
+    # backend-gated: on for TPU, off for CPU backends where the
+    # interpret-mode kernels lose to XLA convs); True forces the fused
+    # path (what the parity tests and loss-parity gates do, interpret
+    # mode off-TPU); False forces the flax reference path.  The switch
+    # is read once per trace (models/update.py
+    # resolve_fused_update_block), so the train step, eval/serve
+    # forwards and every workload's update block flip together.
+    fused_update_block: Optional[bool] = None
+    # Refinement-scan unroll factor (nn.scan unroll=): >1 trades
+    # compile time + code size for cross-iteration scheduling freedom.
+    # STAGE_PRESETS pin 1: the round-3 probe session wedged the remote
+    # XLA compile service ~45 min on an unroll>1 chairs-config compile,
+    # so the sweep (scripts/perf_probe.py unroll{1,2,4} family) must
+    # watch its printed compile seconds before promoting a winner.
+    scan_unroll: int = 1
     # Occlusion/uncertainty head (models/update.py UncertaintyHead): a
     # small conv head off the context features predicting a per-pixel
     # confidence logit, trained against forward-backward-consistency
@@ -187,6 +208,9 @@ class RAFTConfig:
             raise ValueError(
                 "corr_shard_impl='ring' requires corr_shard=True — "
                 "without it the ring construction is silently skipped")
+        if self.scan_unroll < 1:
+            raise ValueError(f"scan_unroll must be >= 1, got "
+                             f"{self.scan_unroll}")
         # corr_dtype applies to BOTH corr paths since round 4: the
         # all-pairs pyramid's storage/contraction dtype, and the
         # on-demand path's feature-block dtype (models/raft.py casts the
@@ -307,6 +331,15 @@ def _stage(model: RAFTConfig, data: DataConfig, train: TrainConfig) -> Config:
 
 # Stage presets replacing train_standard.sh:3-6 (2-GPU fp32 recipe) and
 # train_mixed.sh:3-6 (1-GPU bf16 recipe). Keys: f"{stage}" and f"{stage}_mixed".
+#
+# scan_unroll stays at its default 1 in every preset — the standing
+# winner of the refinement-scan unroll family: the one on-chip attempt
+# at unroll>1 (round 3) wedged the remote XLA compile service for ~45
+# minutes before producing a step time at all, so until the
+# perf_probe unroll{1,2,4} sweep (which now prints compile seconds so
+# a wedge is visible, run under RAFT_BENCH_LEDGER for the obs
+# stall-attribution report) measures a faster-and-compilable setting,
+# 1 is the only value with an acceptable compile budget.
 STAGE_PRESETS = {
     "chairs": _stage(
         RAFTConfig(remat=True, remat_policy="dots_saveable"),

@@ -426,6 +426,26 @@ def _build_uncertainty_step():
     return abstract_uncertainty_step(iters=2)
 
 
+def _build_update_block_pallas():
+    # grad=True: the backward kernels (_gru_line_bwd_kernel,
+    # _menc_bwd_kernel) ride the same trace for the Pallas verifier
+    from raft_tpu.ops.gru_pallas import abstract_fused_update_block
+
+    return abstract_fused_update_block(grad=True)
+
+
+def _hlo_update_block_pallas():
+    from raft_tpu.ops.gru_pallas import abstract_fused_update_block
+
+    return abstract_fused_update_block()
+
+
+def _build_update_block_pallas_small():
+    from raft_tpu.ops.gru_pallas import abstract_fused_update_block
+
+    return abstract_fused_update_block(small=True, grad=True)
+
+
 def _build_device_aug():
     from raft_tpu.data.device_aug import abstract_device_aug
 
@@ -509,6 +529,22 @@ ENTRYPOINTS: Dict[str, EntryPoint] = {e.name: e for e in (
         anchor=("raft_tpu.ops.corr_pallas", "abstract_pyramid_lookup"),
         build=_build_pyramid_pallas_stacked,
         numerics=True, pallas=True, ranges="fmap"),
+    # the fused GRU update block (ops/gru_pallas.py): motion encoder +
+    # GRU kernels behind RAFTConfig.fused_update_block — forward AND
+    # backward kernels audited from the grad=True build; the bench A/B
+    # sub-lane (fused_ab) measures this graph against the flax path
+    EntryPoint(
+        "update_block_pallas",
+        anchor=("raft_tpu.ops.gru_pallas", "abstract_fused_update_block"),
+        build=_build_update_block_pallas,
+        hlo_build=_hlo_update_block_pallas,
+        hlo=True, numerics=True, pallas=True,
+        bench_lane="fused_ab"),
+    EntryPoint(
+        "update_block_pallas_small",
+        anchor=("raft_tpu.ops.gru_pallas", "abstract_fused_update_block"),
+        build=_build_update_block_pallas_small,
+        numerics=True, pallas=True),
     EntryPoint(
         "corr_ring",
         anchor=("raft_tpu.parallel.ring", "abstract_ring_lookup"),

@@ -64,12 +64,15 @@ def resolve_remat_policy(name: str):
             jax.checkpoint_policies.save_only_these_names("conv_out"))
     else:
         base = getattr(jax.checkpoint_policies, name)
-    # Always also save the Pallas dense-lookup output (tag "corr_lookup",
-    # see RefinementStep): it is a custom call, not a dot, so dot-based
-    # policies would otherwise recompute the kernel in the backward scan.
-    # Harmless when the tag does not appear in the graph.
+    # Always also save the Pallas kernel outputs (tags "corr_lookup"
+    # for the dense lookup and "fused_update" for the fused update
+    # block, see RefinementStep / models/update.py): they are custom
+    # calls, not dots, so dot-based policies would otherwise recompute
+    # the kernels in the backward scan.  Harmless when the tags do not
+    # appear in the graph.
     return jax.checkpoint_policies.save_from_both_policies(
-        base, jax.checkpoint_policies.save_only_these_names("corr_lookup"))
+        base, jax.checkpoint_policies.save_only_these_names(
+            "corr_lookup", "fused_update"))
 
 
 class RefinementStep(nn.Module):
@@ -136,12 +139,14 @@ class RefinementStep(nn.Module):
 
         flow = coords1 - coords0
         corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1) ** 2
+        from raft_tpu.models.update import resolve_fused_update_block
+        fused = resolve_fused_update_block(cfg)
         if cfg.small:
             block = SmallUpdateBlock(corr_ch, cfg.hidden_dim, dtype=dtype,
-                                     name="update_block")
+                                     fused=fused, name="update_block")
         else:
             block = BasicUpdateBlock(corr_ch, cfg.hidden_dim, dtype=dtype,
-                                     name="update_block")
+                                     fused=fused, name="update_block")
         net, delta = block(net, inp, corr.astype(dtype), flow.astype(dtype))
 
         coords1 = coords1 + delta.astype(jnp.float32)
@@ -321,7 +326,8 @@ class RAFT(nn.Module):
                        split_rngs={"params": False},
                        in_axes=in_axes,
                        out_axes=0,
-                       length=iters)
+                       length=iters,
+                       unroll=cfg.scan_unroll)
         refine_mod = scan(cfg, name="refine")
 
         if use_deferred:

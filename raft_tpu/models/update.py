@@ -39,6 +39,36 @@ class ConvParams(nn.Module):
         return w, b
 
 
+def resolve_fused_update_block(cfg) -> bool:
+    """RAFTConfig.fused_update_block tri-state -> the traced truth.
+
+    ``None`` (auto) currently resolves OFF everywhere: the Pallas
+    kernels (ops/gru_pallas.py) are parity- and gradient-proven in
+    tier-1 but unmeasured on hardware, and — like DataConfig.device_aug
+    — auto will stay off on CPU backends even after the chip A/B flips
+    it on for TPU (interpret-mode kernels lose to XLA convs on CPU).
+    ``True`` forces the fused path (tests and loss-parity gates do
+    this; off-TPU it runs the kernels in interpret mode), ``False``
+    forces the flax reference path.
+    """
+    if cfg.fused_update_block is not None:
+        return bool(cfg.fused_update_block)
+    return False
+
+
+def _gru_params(hidden: int, cin: int, names_kernels, dtype):
+    """ConvParams for a fused GRU in the checkpoint's exact tree layout
+    (convz1/kernel etc.), cast to the compute dtype — the fused kernels
+    consume raw weights, but .pth import and existing checkpoints see
+    the same parameter names/shapes as the flax conv path.  Must be
+    called from inside the owning module's compact scope."""
+    out = {}
+    for name, ks in names_kernels:
+        w, b = ConvParams(hidden, ks, name=name)(cin)
+        out[name] = (w.astype(dtype), b.astype(dtype))
+    return out
+
+
 def _fused_gate_conv(hx, z_name: str, r_name: str, hidden: int,
                      kernel: Tuple[int, int], dtype):
     """sigmoid(conv_z(hx)), sigmoid(conv_r(hx)) as one fused conv."""
@@ -77,13 +107,33 @@ class FlowHead(nn.Module):
 
 
 class ConvGRU(nn.Module):
-    """3x3 convolutional GRU (update.py:16-31)."""
+    """3x3 convolutional GRU (update.py:16-31).
+
+    ``fused=True`` routes through the halo-banded Pallas kernel
+    (ops/gru_pallas.py conv_gru_pallas) — same math, same parameter
+    tree, one launch per application instead of ~8 HLO ops."""
 
     hidden_dim: int = 128
     dtype: Any = jnp.float32
+    fused: bool = False
 
     @nn.compact
     def __call__(self, h, x):
+        if self.fused:
+            from jax.ad_checkpoint import checkpoint_name
+
+            from raft_tpu.ops.gru_pallas import conv_gru_pallas
+
+            params = _gru_params(self.hidden_dim,
+                                 h.shape[-1] + x.shape[-1],
+                                 (("convz", (3, 3)), ("convr", (3, 3)),
+                                  ("convq", (3, 3))), self.dtype)
+            out = conv_gru_pallas(h.astype(self.dtype),
+                                  x.astype(self.dtype), params)
+            # not a dot: tag it saveable so dot-based remat policies
+            # don't recompute the kernel in the backward scan
+            # (resolve_remat_policy saves the name)
+            return checkpoint_name(out, "fused_update")
         hx = jnp.concatenate([h, x], axis=-1)
         z, r = _fused_gate_conv(hx, "convz", "convr", self.hidden_dim,
                                 (3, 3), self.dtype)
@@ -93,13 +143,33 @@ class ConvGRU(nn.Module):
 
 
 class SepConvGRU(nn.Module):
-    """Factorized 1x5 + 5x1 GRU (update.py:33-60)."""
+    """Factorized 1x5 + 5x1 GRU (update.py:33-60).
+
+    ``fused=True`` routes through the line-banded Pallas kernels
+    (ops/gru_pallas.py sepconv_gru_pallas): each half — both gates,
+    the q candidate and the convex update — is ONE launch with the
+    sigmoid/tanh epilogues fused into the conv accumulation, plus one
+    backward launch per half under AD.  Parameter tree unchanged."""
 
     hidden_dim: int = 128
     dtype: Any = jnp.float32
+    fused: bool = False
 
     @nn.compact
     def __call__(self, h, x):
+        if self.fused:
+            from jax.ad_checkpoint import checkpoint_name
+
+            from raft_tpu.ops.gru_pallas import sepconv_gru_pallas
+
+            params = _gru_params(
+                self.hidden_dim, h.shape[-1] + x.shape[-1],
+                (("convz1", (1, 5)), ("convr1", (1, 5)),
+                 ("convq1", (1, 5)), ("convz2", (5, 1)),
+                 ("convr2", (5, 1)), ("convq2", (5, 1))), self.dtype)
+            out = sepconv_gru_pallas(h.astype(self.dtype),
+                                     x.astype(self.dtype), params)
+            return checkpoint_name(out, "fused_update")
         # horizontal pass (1x5)
         hx = jnp.concatenate([h, x], axis=-1)
         z, r = _fused_gate_conv(hx, "convz1", "convr1", self.hidden_dim,
@@ -117,13 +187,36 @@ class SepConvGRU(nn.Module):
 
 
 class SmallMotionEncoder(nn.Module):
-    """Corr+flow feature mixer for the small model (update.py:62-77)."""
+    """Corr+flow feature mixer for the small model (update.py:62-77).
+
+    ``fused=True``: the whole stack as one halo-banded Pallas launch
+    (ops/gru_pallas.py small_motion_encoder_pallas); only the final
+    ``concat([out, flow])`` stays in XLA so its gradient is automatic.
+    """
 
     corr_channels: int  # corr_levels * (2r+1)^2
     dtype: Any = jnp.float32
+    fused: bool = False
 
     @nn.compact
     def __call__(self, flow, corr):
+        if self.fused:
+            from jax.ad_checkpoint import checkpoint_name
+
+            from raft_tpu.ops.gru_pallas import small_motion_encoder_pallas
+
+            wts = []
+            for name, co, k, ci in (("convc1", 96, 1, corr.shape[-1]),
+                                    ("convf1", 64, 7, 2),
+                                    ("convf2", 32, 3, 64),
+                                    ("conv", 80, 3, 128)):
+                w, b = ConvParams(co, (k, k), name=name)(ci)
+                wts += [w.astype(self.dtype), b.astype(self.dtype)]
+            flow = flow.astype(self.dtype)
+            out = small_motion_encoder_pallas(
+                flow, corr.astype(self.dtype), tuple(wts))
+            out = checkpoint_name(out, "fused_update")
+            return jnp.concatenate([out, flow], axis=-1)
         cor = nn.relu(conv(96, 1, dtype=self.dtype, name="convc1")(corr))
         flo = nn.relu(conv(64, 7, dtype=self.dtype, name="convf1")(flow))
         flo = nn.relu(conv(32, 3, dtype=self.dtype, name="convf2")(flo))
@@ -133,13 +226,37 @@ class SmallMotionEncoder(nn.Module):
 
 
 class BasicMotionEncoder(nn.Module):
-    """Corr+flow feature mixer for the large model (update.py:79-97)."""
+    """Corr+flow feature mixer for the large model (update.py:79-97).
+
+    ``fused=True``: the whole stack as one halo-banded Pallas launch
+    (ops/gru_pallas.py basic_motion_encoder_pallas); only the final
+    ``concat([out, flow])`` stays in XLA so its gradient is automatic.
+    """
 
     corr_channels: int
     dtype: Any = jnp.float32
+    fused: bool = False
 
     @nn.compact
     def __call__(self, flow, corr):
+        if self.fused:
+            from jax.ad_checkpoint import checkpoint_name
+
+            from raft_tpu.ops.gru_pallas import basic_motion_encoder_pallas
+
+            wts = []
+            for name, co, k, ci in (("convc1", 256, 1, corr.shape[-1]),
+                                    ("convc2", 192, 3, 256),
+                                    ("convf1", 128, 7, 2),
+                                    ("convf2", 64, 3, 128),
+                                    ("conv", 126, 3, 256)):
+                w, b = ConvParams(co, (k, k), name=name)(ci)
+                wts += [w.astype(self.dtype), b.astype(self.dtype)]
+            flow = flow.astype(self.dtype)
+            out = basic_motion_encoder_pallas(
+                flow, corr.astype(self.dtype), tuple(wts))
+            out = checkpoint_name(out, "fused_update")
+            return jnp.concatenate([out, flow], axis=-1)
         cor = nn.relu(conv(256, 1, dtype=self.dtype, name="convc1")(corr))
         cor = nn.relu(conv(192, 3, dtype=self.dtype, name="convc2")(cor))
         flo = nn.relu(conv(128, 7, dtype=self.dtype, name="convf1")(flow))
@@ -212,13 +329,18 @@ class SmallUpdateBlock(nn.Module):
     # delta channels out of the head: 2 for flow (reference), 1 for the
     # stereo disparity workload (epipolar-constrained motion)
     head_channels: int = 2
+    # route the motion encoder + GRU through the fused Pallas kernels
+    # (RAFTConfig.fused_update_block via resolve_fused_update_block)
+    fused: bool = False
 
     @nn.compact
     def __call__(self, net, inp, corr, flow):
         motion = SmallMotionEncoder(self.corr_channels, dtype=self.dtype,
+                                    fused=self.fused,
                                     name="encoder")(flow, corr)
         x = jnp.concatenate([inp, motion], axis=-1)
-        net = ConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
+        net = ConvGRU(self.hidden_dim, dtype=self.dtype,
+                      fused=self.fused, name="gru")(net, x)
         delta = FlowHead(128, dtype=self.dtype,
                          out_channels=self.head_channels,
                          name="flow_head")(net)
@@ -237,13 +359,18 @@ class BasicUpdateBlock(nn.Module):
     # delta channels out of the head: 2 for flow (reference), 1 for the
     # stereo disparity workload (epipolar-constrained motion)
     head_channels: int = 2
+    # route the motion encoder + GRU through the fused Pallas kernels
+    # (RAFTConfig.fused_update_block via resolve_fused_update_block)
+    fused: bool = False
 
     @nn.compact
     def __call__(self, net, inp, corr, flow):
         motion = BasicMotionEncoder(self.corr_channels, dtype=self.dtype,
+                                    fused=self.fused,
                                     name="encoder")(flow, corr)
         x = jnp.concatenate([inp, motion], axis=-1)
-        net = SepConvGRU(self.hidden_dim, dtype=self.dtype, name="gru")(net, x)
+        net = SepConvGRU(self.hidden_dim, dtype=self.dtype,
+                         fused=self.fused, name="gru")(net, x)
         delta = FlowHead(256, dtype=self.dtype,
                          out_channels=self.head_channels,
                          name="flow_head")(net)

@@ -189,8 +189,11 @@ class StereoRefinementStep(nn.Module):
         flow2 = jnp.concatenate([-disp, jnp.zeros_like(disp)], axis=-1)
         corr_ch = cfg.corr_levels * (2 * cfg.corr_radius + 1)
         block_cls = SmallUpdateBlock if cfg.small else BasicUpdateBlock
+        from raft_tpu.models.update import resolve_fused_update_block
         block = block_cls(corr_ch, cfg.hidden_dim, dtype=dtype,
-                          head_channels=1, name="update_block")
+                          head_channels=1,
+                          fused=resolve_fused_update_block(cfg),
+                          name="update_block")
         net, delta = block(net, inp, corr.astype(dtype),
                            flow2.astype(dtype))
 
@@ -278,7 +281,8 @@ class StereoRAFT(nn.Module):
                        split_rngs={"params": False},
                        in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                        out_axes=0,
-                       length=iters)
+                       length=iters,
+                       unroll=cfg.scan_unroll)
         (net, disp), (disps_lr, nets) = scan(cfg, name="refine")(
             (net, disp), inp, pyramid, coords0_x)
 

@@ -7,6 +7,18 @@ Variant families (see `variants` in main() for the full list):
   gradient-path knobs    no_remat_policy, convs_saved, deferred_grad,
                          no_deferred_grad, corr_f32
   dense-lookup kernels   pallas_lookup[_deferred], pallas_stacked[_deferred]
+  fused update block     fused_update / no_fused_update (the GRU+motion-
+                         encoder Pallas kernels, ops/gru_pallas.py) and
+                         fused_update_deferred — with deferred_grad /
+                         current this spans the full fused x deferred
+                         cross, the re-measure ISSUE 13 satellite 1
+                         demands before the round-3 "deferred loses"
+                         claim is trusted on the fused step
+  refinement-scan unroll unroll1 / unroll2 / unroll4 (RAFTConfig.
+                         scan_unroll; compile seconds are printed per
+                         variant — the round-3 unroll attempt wedged the
+                         remote compile service ~45 min, so watch that
+                         column and kill a variant that balloons)
   round-5 layout A/Bs    pad_lanes/no_pad_lanes, mask_f32/mask_bf16
   compiler options       xla_vmem{16,24,32,48,64,128}, xla_lhs_sched,
                          xla_vmem32_lhs (per-compile PJRT options, as is
@@ -15,6 +27,10 @@ Variant families (see `variants` in main() for the full list):
   shape sweeps           things_accum{1,2,3}, things_vmem32_accum2
                          (400x720 b6), chairs_b{12,16}[_accum2],
                          fwd_only, fwd_vmem32
+
+Run under RAFT_BENCH_LEDGER=<path> is not wired here — for the obs
+stall-attribution view of a variant, run bench.py with the variant's
+knobs instead; this probe is the raw same-process step timer.
 """
 
 import os
@@ -81,7 +97,12 @@ def time_step(cfg, batch, iters=12, n=10, fwd_only=False, accum_steps=1,
     step = make_train_step(model, iters=iters, gamma=0.8, max_flow=400.0,
                            donate=True, accum_steps=accum_steps,
                            compiler_options=compiler_options)
+    t_c = time.perf_counter()
     state, m = step(state, batch); float(m["loss"])
+    # compile+warmup seconds, printed per variant: the unroll family's
+    # wedge guard (see the module docstring) — a ballooning compile is
+    # visible BEFORE it eats the session
+    print(f"  [compile+warmup {time.perf_counter() - t_c:.1f}s]")
     t0 = time.perf_counter()
     for _ in range(n):
         state, m = step(state, batch)
@@ -149,6 +170,28 @@ def main():
         "pallas_stacked_deferred": lambda: RAFTConfig(
             **{**base, "lookup_impl": "pallas_stacked",
                "deferred_corr_grad": True}),
+        # fused Pallas update block (ops/gru_pallas.py): the GRU halves
+        # + motion encoder as VMEM-resident kernels, fwd AND bwd.  The
+        # _deferred combo completes the fused x deferred cross with
+        # deferred_grad/current above (satellite 1 of ISSUE 13: the
+        # round-3 "deferred loses by ~14 ms/step" measurement predates
+        # any step change — re-measure BOTH knobs together before
+        # promoting either default)
+        "fused_update": lambda: RAFTConfig(
+            **{**base, "fused_update_block": True}),
+        "no_fused_update": lambda: RAFTConfig(
+            **{**base, "fused_update_block": False}),
+        "fused_update_deferred": lambda: RAFTConfig(
+            **{**base, "fused_update_block": True,
+               "deferred_corr_grad": True}),
+        # refinement-scan unroll sweep (RAFTConfig.scan_unroll -> the
+        # nn.scan unroll= knob).  Watch the printed compile+warmup
+        # seconds: the round-3 unroll attempt wedged the remote XLA
+        # compile service ~45 min at the chairs config — kill the
+        # variant if that column balloons instead of waiting it out
+        "unroll1": lambda: RAFTConfig(**{**base, "scan_unroll": 1}),
+        "unroll2": lambda: RAFTConfig(**{**base, "scan_unroll": 2}),
+        "unroll4": lambda: RAFTConfig(**{**base, "scan_unroll": 4}),
         "convs_saved": lambda: RAFTConfig(
             **{**base, "remat_policy": "convs_and_dots_saveable"}),
         # round-5 lane-padded dense pyramid A/B (corr_pad_lanes).

@@ -1035,6 +1035,49 @@ def test_seeded_oversized_blockspec_trips_vmem_cap():
     assert hits and hits[0].data["vmem_bytes"] > pa.VMEM_CAP_BYTES
 
 
+def test_seeded_oversized_gru_blockspec_trips_vmem_cap():
+    """ISSUE 13: the REAL fused-GRU line kernel at a band its layout
+    cannot fit trips the 16 MiB cap, file:line-attributed INSIDE
+    ops/gru_pallas.py — the verifier audits the production kernel's
+    BlockSpecs, not a stand-in."""
+    out = _numerics_fixture_findings("seeded_gru_oversized")
+    hits = [f for f in out if f.rule == "pallas-vmem-cap"]
+    assert hits, [f.render() for f in out]
+    assert hits[0].data["vmem_bytes"] > pa.VMEM_CAP_BYTES
+    assert hits[0].path.endswith("ops/gru_pallas.py") and hits[0].line > 0
+
+
+def test_registry_pins_fused_update_block_audit_coverage():
+    """ISSUE 13 CI pin: the fused update-block entries must declare
+    Pallas participation and own pallas_vmem budget rows — a future
+    rename or participation edit cannot silently drop the kernels out
+    of engine-4/engine-5 audit coverage."""
+    for name in ("update_block_pallas", "update_block_pallas_small"):
+        entry = ep.ENTRYPOINTS[name]
+        assert entry.pallas and entry.numerics, name
+        assert "pallas_vmem" in entry.budget_sections, name
+        assert entry.anchor == ("raft_tpu.ops.gru_pallas",
+                                "abstract_fused_update_block"), name
+    # the grad=True canonical build is what engine 4 walks: the fwd AND
+    # bwd kernels must both appear in the sanctioned ledger rows
+    ledger = bmod.load_budgets(bmod.default_budgets_path())
+    rows = set(ledger.get("pallas_vmem", {}))
+    for want in ("update_block_pallas/_gru_line_kernel",
+                 "update_block_pallas/_gru_line_bwd_kernel",
+                 "update_block_pallas/_menc_fwd_kernel",
+                 "update_block_pallas/_menc_bwd_kernel",
+                 "update_block_pallas/_menc_dflow_kernel",
+                 "update_block_pallas_small/_gru_halo_kernel",
+                 "update_block_pallas_small/_gru_halo_bwd_kernel",
+                 "update_block_pallas_small/_menc_fwd_kernel",
+                 "update_block_pallas_small/_menc_bwd_kernel",
+                 "update_block_pallas_small/_menc_dflow_kernel"):
+        assert want in rows, f"missing pallas_vmem row {want}"
+    # engine 3 compiles the hlo_build and budget-gates the entries row
+    assert ep.ENTRYPOINTS["update_block_pallas"].hlo
+    assert "update_block_pallas" in ledger.get("entries", {})
+
+
 # --------------------------------------------------------------------------
 # numerics engine: pallas budget ledger (pure fixtures, no traces)
 # --------------------------------------------------------------------------
@@ -1541,7 +1584,7 @@ def test_registry_add_an_entry_contract(tmp_path, monkeypatch):
 
     # (3) trace gate: the toy entry traces like any registered graph
     # (scoped to the toy alone — test_registry_gate_repo_clean already
-    # traces the full registry once; re-tracing 24 entries here would
+    # traces the full registry once; re-tracing 26 entries here would
     # double-bill ~20 s of tier-1 wall clock)
     with monkeypatch.context() as mctx:
         mctx.setattr(ep, "ENTRYPOINTS", {"toy_workload": toy})
