@@ -507,6 +507,83 @@ def main():
         print(f"serve bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
 
+    # Fleet lane (raft_tpu/serve/fleet.py): N=3 local replicas behind
+    # the stream-affinity front door under a POISSON arrival process —
+    # aggregate requests/s and the fleet-wide p95 join the scoreboard
+    # next to the single-server serving lane.  The replicas share one
+    # AOT cache (replica 0 compiles, the rest verify-and-load), and the
+    # load runs video streams so the routing/spill path is exercised,
+    # not just the dispatch path.
+    def _fleet_lane(n_replicas=3):
+        import tempfile
+
+        from raft_tpu.serve.aot import AOTCache
+        from raft_tpu.serve.engine import ServeEngine
+        from raft_tpu.serve.fleet import FleetServer
+        from raft_tpu.serve.server import FlowServer
+
+        serve_vars = {"params": state.params}
+        bs = getattr(state, "batch_stats", None)
+        if bs:
+            serve_vars["batch_stats"] = bs
+        serve_b = min(2, B)
+        td = tempfile.mkdtemp(prefix="bench_fleet_")
+        aot = AOTCache(os.path.join(td, "aot"))
+
+        def factory(rid, spill):
+            eng = ServeEngine(RAFT(cfg), serve_vars, batch_size=serve_b,
+                              aot_cache=aot)
+            return FlowServer(eng, buckets={"bench": (H, W)},
+                              queue_capacity=max(8, 4 * serve_b),
+                              iter_levels=(iters,), degrade=False,
+                              spill_store=spill)
+
+        fleet = FleetServer(factory, n_replicas=n_replicas,
+                            spill_dir=os.path.join(td, "spill"))
+        try:
+            fleet.warmup()
+            rng_f = np.random.default_rng(13)
+
+            def frame():
+                return rng_f.uniform(0, 255, (H, W, 3)).astype(np.float32)
+
+            # poisson arrivals at ~1.5x the measured single-server
+            # rate (or a nominal rate when that lane failed): the lane
+            # measures the fleet absorbing MORE than one replica's
+            # capacity, which is the point of having a fleet
+            single = serve_metrics.get("requests_per_s_per_chip") or 0.0
+            rate = 1.5 * single if single > 0 else 10.0
+            n_req = 6 if tiny else 36
+            futs = []
+            t0 = time.perf_counter()
+            for i in range(n_req):
+                futs.append(fleet.submit(frame(), frame(),
+                                         stream=f"b{i % 6}"))
+                time.sleep(float(rng_f.exponential(1.0 / rate)))
+            for f in futs:
+                f.result(timeout=600)
+            wall = time.perf_counter() - t0
+            summary = fleet.close()
+            fleet = None
+            return {
+                "fleet_requests_per_s": round(n_req / wall, 3),
+                "fleet_latency_p95_ms":
+                    summary.get("latency_p95_ms", 0.0),
+                "fleet_replicas": n_replicas,
+            }
+        finally:
+            if fleet is not None:
+                fleet.close()
+
+    fleet_metrics = {"fleet_requests_per_s": 0.0,
+                     "fleet_latency_p95_ms": 0.0,
+                     "fleet_replicas": 0}
+    try:
+        fleet_metrics = _fleet_lane()
+    except Exception as e:  # the fleet lane must never sink the scoreboard
+        print(f"fleet bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # Stereo workload lanes (raft_tpu/workloads/stereo): the SAME
     # architecture at 1D correlation, measured both ways the flow graph
     # is — a train-step lane at the bench config and a serving lane
@@ -683,6 +760,10 @@ def main():
     # budget/audit ledgers talk about the same graphs by construction
     from raft_tpu.entrypoints import bench_lanes
     lane_entries = bench_lanes()
+    # the fleet lane dispatches the same registered serve_forward
+    # graphs as the single-server serving lane (the fleet is a routing
+    # layer, not a new lowerable graph)
+    lane_entries["fleet"] = "serve_forward"
 
     if ledger is not None:
         ledger.close(summary=health.summary()
@@ -692,7 +773,7 @@ def main():
                         "fed_pairs_per_s_host":
                             round(fed_pairs_per_s_host, 3),
                         "fed_lane": fed_lane}
-                     | serve_metrics | stereo_metrics
+                     | serve_metrics | fleet_metrics | stereo_metrics
                      | {"confidence_overhead_pct":
                             confidence_overhead_pct,
                         "fused_update_block": fused}
@@ -715,6 +796,9 @@ def main():
         # serving lane: synthetic requests through the real FlowServer
         # (queue -> batcher -> AOT executor) at this resolution
         **serve_metrics,
+        # fleet lane: N=3 local replicas behind the stream-affinity
+        # front door under poisson arrivals (serve/fleet.py)
+        **fleet_metrics,
         # stereo workload lanes: the same architecture at 1D corr —
         # train-step rate and serve rate through a stereo-engine server
         **stereo_metrics,

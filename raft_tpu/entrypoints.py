@@ -286,6 +286,20 @@ def _build_serve_forward_warm():
     return abstract_serve_forward(iters=2, warm=True)
 
 
+def _build_tiled_serve_forward():
+    from raft_tpu.serve.tiled import abstract_tiled_forward
+
+    return abstract_tiled_forward(iters=2)
+
+
+def _hlo_tiled_serve_forward():
+    from raft_tpu.serve.tiled import abstract_tiled_forward
+
+    # `small` bounds engine 3's compile; the tile graph's structure
+    # (collective-free, bf16 policy, f32 flow boundary) is identical
+    return abstract_tiled_forward(iters=2, overrides={"small": True})
+
+
 def _build_corr_dense():
     from raft_tpu.ops.corr import abstract_corr_lookup
 
@@ -501,6 +515,18 @@ ENTRYPOINTS: Dict[str, EntryPoint] = {e.name: e for e in (
         "serve_forward_warm",
         anchor=("raft_tpu.serve.engine", "abstract_serve_forward"),
         build=_build_serve_forward_warm,
+        jaxpr=("serve_forward",), hlo=True, numerics=True, deep=True,
+        cache_tag="serve_forward"),
+    # the tiled 4K family (serve/tiled.py): the serve forward at the
+    # tile bucket's static shape — tiles ride the ordinary batcher, so
+    # the only new lowerable graph is the tile-shaped executable, and
+    # registering it keeps "every family the fleet compiles is audited
+    # and budgeted" structural
+    EntryPoint(
+        "tiled_serve_forward",
+        anchor=("raft_tpu.serve.tiled", "abstract_tiled_forward"),
+        build=_build_tiled_serve_forward,
+        hlo_build=_hlo_tiled_serve_forward,
         jaxpr=("serve_forward",), hlo=True, numerics=True, deep=True,
         cache_tag="serve_forward"),
     EntryPoint(

@@ -88,11 +88,15 @@ def run_report(path: str, as_json: bool,
 
 
 def run_merged_report(path: str, as_json: bool,
-                      fail_on_incident: Optional[str]) -> int:
+                      fail_on_incident: Optional[str],
+                      fail_on_slo: bool = False) -> int:
     """Pod report: merge the per-process suffixed ledgers
     (``<name>.jsonl.p<N>``) a multihost run writes into one view with
     per-process incident attribution; the severity gate spans ALL
-    processes (one host's fatal fails the pod)."""
+    processes (one host's fatal fails the pod).  A fleet serving run's
+    per-replica ledgers merge the same way, and ``--fail-on-slo``
+    gates the FLEET-wide p95 (pooled latency sketches) against the
+    configured SLO."""
     from raft_tpu.obs.events import read_ledger, sanitize_json
     from raft_tpu.obs.report import (build_pod_report,
                                      find_process_ledgers,
@@ -121,7 +125,8 @@ def run_merged_report(path: str, as_json: bool,
                          allow_nan=False))
     else:
         print(render_pod_report(report))
-    return _gate(report["incidents"], fail_on_incident)
+    return (_gate(report["incidents"], fail_on_incident)
+            or _slo_gate(report, fail_on_slo))
 
 
 def run_selfcheck() -> int:
@@ -242,11 +247,15 @@ def main(argv=None) -> int:
                                    "log dir or any one per-process "
                                    "ledger)")
     rp.add_argument("--merge", action="store_true",
-                    help="pod report: merge the per-process suffixed "
-                         "ledgers (<name>.jsonl.p<N>) a multihost run "
-                         "writes, with per-process incident "
-                         "attribution; --fail-on-incident gates across "
-                         "ALL processes")
+                    help="pod/fleet report: merge the per-process "
+                         "suffixed ledgers (<name>.jsonl.p<N>) a "
+                         "multihost run or a serving fleet writes, "
+                         "with per-process incident attribution and — "
+                         "for serve ledgers — merged conservation "
+                         "counters, per-replica attribution and a "
+                         "fleet-wide p95 from the pooled latency "
+                         "sketches; --fail-on-incident and "
+                         "--fail-on-slo gate across ALL processes")
     rp.add_argument("--json", action="store_true",
                     help="machine-readable report")
     rp.add_argument("--fail-on-incident", nargs="?", const="any",
@@ -270,13 +279,9 @@ def main(argv=None) -> int:
         return run_selfcheck()
     if args.cmd == "report":
         if args.merge:
-            if args.fail_on_slo:
-                print("obs report: --fail-on-slo is a single-run gate "
-                      "(serve runs are single-process); drop --merge",
-                      file=sys.stderr)
-                return 2
             return run_merged_report(args.ledger, args.json,
-                                     args.fail_on_incident)
+                                     args.fail_on_incident,
+                                     args.fail_on_slo)
         return run_report(args.ledger, args.json, args.fail_on_incident,
                           args.fail_on_slo)
     p.print_help()

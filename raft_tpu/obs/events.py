@@ -115,6 +115,33 @@ incident                severity  meaning
                                   rejected): a silent drop happened —
                                   the invariant the serving layer
                                   exists to make impossible
+``fleet-replica-lost``  recovered a fleet replica died/was killed; its
+                                  queued requests were re-placed on
+                                  survivors and its streams re-route
+                                  via the consistent-hash ring
+``fleet-reroute``       recovered a stream or rescued request moved to
+                                  a different replica (ring change or
+                                  replica death) — the typed, counted
+                                  form of a migration
+``fleet-warm-adopt``    recovered a re-routed stream's warm state was
+                                  verified and adopted from the shared
+                                  spill store; the video warm-start
+                                  chain continues across replicas
+``fleet-cold-start``    recovered a re-routed stream had no verifiable
+                                  spill state (missing or corrupt at
+                                  rest); typed re-cold-start — the
+                                  request is still served
+``fleet-drain``         warn      a replica entered drain for a rolling
+                                  restart; the router stopped
+                                  assigning new work to it
+``fleet-restart``       recovered a drained replica restarted and
+                                  rejoined; detail carries the
+                                  measured warm-restore vs cold-start
+                                  seconds (the <50% gate's numbers)
+``fleet-conservation``  fatal     fleet-wide request conservation
+                                  violated at close (submitted !=
+                                  served + typed rejects): a silent
+                                  drop crossed the fleet front door
 ======================  ========  =====================================
 
 Append-only by construction: the file is opened in append mode and
@@ -170,6 +197,13 @@ DEFAULT_INCIDENT_SEVERITY = {
     "serve-restored": "recovered",
     "serve-stalled": "fatal",
     "serve-conservation": "fatal",
+    "fleet-replica-lost": "recovered",
+    "fleet-reroute": "recovered",
+    "fleet-warm-adopt": "recovered",
+    "fleet-cold-start": "recovered",
+    "fleet-drain": "warn",
+    "fleet-restart": "recovered",
+    "fleet-conservation": "fatal",
 }
 
 

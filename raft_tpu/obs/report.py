@@ -224,11 +224,18 @@ def find_process_ledgers(path: str) -> Dict[int, str]:
     the suffixed files; siblings are discovered by the ``.p<int>``
     suffix AND the shared stem.  A suffix-less ``events.jsonl`` alone
     is NOT a pod run — callers fall back to the single-ledger report
-    for that.  A directory holding several runs' suffixed ledgers is
-    ambiguous: silently merging unrelated runs into one "pod" would
-    gate and attribute a chimera, so that raises ``ValueError`` unless
-    ``path`` itself named one of the files (its stem disambiguates).
+    for that.  When suffixed siblings DO exist and the suffix-less stem
+    file exists too (a serving fleet: per-replica ``.p<i>`` ledgers
+    plus the front door's own), the stem joins the merge as pid ``-1``
+    ("front" in the rendered report) — the front door is where the
+    fleet-level FATAL incidents (``fleet-conservation``) land, and a
+    merge that skipped it could not gate on them.  A directory holding
+    several runs' suffixed ledgers is ambiguous: silently merging
+    unrelated runs into one "pod" would gate and attribute a chimera,
+    so that raises ``ValueError`` unless ``path`` itself named one of
+    the files (its stem disambiguates).
     """
+    import json
     import os
     import re
 
@@ -244,17 +251,136 @@ def find_process_ledgers(path: str) -> Dict[int, str]:
                 int(m.group("pid"))] = os.path.join(d, f)
     if not by_stem:
         return {}
+
+    def is_fleet_front(path: str) -> bool:
+        # only a ledger that declares itself the fleet front door
+        # (run_start meta entry "serve-fleet", serve/__main__.py) may
+        # join the merge as pid -1: a stale suffix-less ledger from an
+        # UNRELATED earlier run sharing the stem would otherwise be
+        # silently adopted, gated, and attributed as part of this pod
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    if '"run_start"' not in line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (rec.get("kind") == "run_start"
+                            and rec.get("meta", {}).get("entry")
+                            == "serve-fleet"):
+                        return True
+        except OSError:
+            return False
+        return False
+
+    def with_front(stem: str) -> Dict[int, str]:
+        procs = dict(by_stem.get(stem, {}))
+        front = os.path.join(d, stem)
+        if procs and os.path.isfile(front) and is_fleet_front(front):
+            procs[-1] = front
+        return procs
+
     if not os.path.isdir(path):
         m = pat.match(os.path.basename(path))
         want = m.group("stem") if m else os.path.basename(path)
-        return by_stem.get(want, {})
+        return with_front(want)
     if len(by_stem) > 1:
         raise ValueError(
             f"{path} holds per-process ledgers from {len(by_stem)} "
             f"different runs ({', '.join(sorted(by_stem))}); pass one "
             f"of the files (its stem picks the run) instead of the "
             f"directory")
-    return next(iter(by_stem.values()))
+    return with_front(next(iter(by_stem)))
+
+
+def merge_serving_sections(per_process_serving: Dict[int, object]) -> Dict:
+    """One fleet serving view from per-replica serving summaries.
+
+    Each value is ONE serving summary dict or a LIST of them — a
+    replica that went through a rolling restart appends a second run
+    (with its own ``run_end`` serving summary) to the SAME ``.p<i>``
+    ledger, and counting only the last run would silently drop all
+    pre-restart traffic from the "aggregate conservation" view.
+    Conservation counters SUM (each replica's books must balance; the
+    fleet's are their union plus the front door's own ledger).  The
+    fleet-wide percentiles come from pooling each replica's
+    ``latency_samples_ms`` quantile sketch — per-replica percentiles
+    cannot be merged, which is exactly why the summaries carry the
+    sketch.  ``slo_ok`` is derived against the configured SLO whenever
+    pooled samples exist, so ``--fail-on-slo`` gates across ALL
+    replicas: one slow replica fails the fleet even if the others'
+    p95s look fine pooled... and vice versa — the fleet number is the
+    one users experience."""
+    counter_keys = ("submitted", "served", "rejected_queue_full",
+                    "rejected_deadline", "rejected_bad_request",
+                    "rejected_shutdown", "rejected_total", "unaccounted")
+    merged: Dict = {k: 0 for k in counter_keys}
+    pooled: List[float] = []
+    pooled_w: List[float] = []
+    slo = None
+    replicas: Dict[str, Dict] = {}
+    for pid, runs in sorted(per_process_serving.items()):
+        if isinstance(runs, dict):
+            runs = [runs]
+        row = {k: 0 for k in ("served", "submitted", "rejected_total",
+                              "unaccounted")}
+        row_last_p95 = None
+        for s in runs:
+            for k in counter_keys:
+                v = s.get(k, 0)
+                if isinstance(v, (int, float)):
+                    merged[k] += int(v)
+            for k in row:
+                v = s.get(k, 0)
+                if isinstance(v, (int, float)):
+                    row[k] += int(v)
+            samples = [x for x in (s.get("latency_samples_ms") or [])
+                       if isinstance(x, (int, float)) and x == x]
+            pooled.extend(samples)
+            # traffic weighting: each run's sketch is capped, so a
+            # sample stands for served/len(sketch) real requests —
+            # without the weight, a 20-request replica's tail would
+            # count the same as a 10k-request replica's in the fleet
+            # percentile
+            served_n = s.get("served", 0)
+            w = (served_n / len(samples)
+                 if isinstance(served_n, (int, float)) and served_n > 0
+                 and samples else 1.0)
+            pooled_w.extend([w] * len(samples))
+            if slo is None and isinstance(s.get("slo_p95_ms"),
+                                          (int, float)):
+                slo = s["slo_p95_ms"]
+            p95 = s.get("latency_p95_ms")
+            if isinstance(p95, (int, float)) and p95 == p95:
+                row_last_p95 = p95
+        if row_last_p95 is not None:
+            row["latency_p95_ms"] = row_last_p95
+        if len(runs) > 1:
+            row["runs"] = len(runs)
+        replicas[f"p{pid}"] = row
+    merged["replicas"] = replicas
+    merged["slo_p95_ms"] = slo
+    if pooled:
+        # graftlint: disable=f64-literal -- host-side latency math
+        arr = np.asarray(pooled, dtype=np.float64)
+        warr = np.asarray(pooled_w, dtype=np.float64)  # graftlint: disable=f64-literal -- host-side latency weights; never reaches a device
+        order = np.argsort(arr)
+        arr, warr = arr[order], warr[order]
+        cw = np.cumsum(warr)
+
+        def wpct(q: float) -> float:
+            i = int(np.searchsorted(cw, q / 100.0 * cw[-1]))
+            return float(arr[min(i, arr.size - 1)])
+
+        merged["latency_p50_ms"] = round(wpct(50), 3)
+        merged["latency_p95_ms"] = round(wpct(95), 3)
+        merged["latency_max_ms"] = round(float(arr.max()), 3)
+        merged["pooled_samples"] = int(arr.size)
+        if isinstance(slo, (int, float)):
+            merged["slo_ok"] = bool(merged["latency_p95_ms"] <= slo)
+    return merged
 
 
 def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
@@ -264,7 +390,13 @@ def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
     the pod view adds per-process incident ATTRIBUTION (every incident
     row carries its ``process``), pod-wide severity counts, and merged
     fault/recovery counters — the inputs ``--fail-on-incident fatal``
-    needs to gate across the whole pod instead of one host.
+    needs to gate across the whole pod instead of one host.  When the
+    per-process ledgers carry SERVING summaries (a fleet run's
+    per-replica ledgers), the pod view also merges them into one fleet
+    serving section (:func:`merge_serving_sections`) — aggregate
+    conservation counters, per-replica attribution, and a genuine
+    fleet-wide p95 from the pooled latency sketches, which is what
+    ``--fail-on-slo`` gates on across replicas.
     """
     processes = {pid: build_report(recs)
                  for pid, recs in sorted(per_process_records.items())}
@@ -283,11 +415,29 @@ def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
         for k, v in (res.get("recovery") or {}).items():
             recovery[k] = recovery.get(k, 0) + v
     incidents.sort(key=lambda r: (r.get("step") or 0, r["process"]))
+    # serving summaries come from the RAW records, every run of each
+    # ledger (a rolling-restarted replica appends a second run to the
+    # same .p<i> file; build_report's last-run scope would drop its
+    # pre-restart counters).  The front door (pid -1) is excluded:
+    # its summary is the FLEET-level view of the same requests the
+    # replica books already count — summing both would double-count.
+    per_serving: Dict[int, List[Dict]] = {}
+    for pid, recs in sorted(per_process_records.items()):
+        if pid < 0:
+            continue
+        runs = [rec["summary"]["serving"] for rec in recs
+                if rec.get("kind") == "run_end"
+                and isinstance(rec.get("summary"), dict)
+                and isinstance(rec["summary"].get("serving"), dict)]
+        if runs:
+            per_serving[pid] = runs
     return {
         "processes": processes,
         "process_count": len(processes),
         "steps": max((r["steps"] for r in processes.values()), default=0),
         "incidents": incidents,
+        "serving": (merge_serving_sections(per_serving)
+                    if per_serving else None),
         "resilience": {
             "faults_injected": faults,
             "incidents_by_severity": by_severity,
@@ -295,6 +445,12 @@ def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
             "recovery": recovery,
         },
     }
+
+
+def _plabel(pid: int) -> str:
+    """Process label: ``p<N>`` for replicas/hosts, ``front`` for the
+    fleet front door's own ledger (pid -1)."""
+    return "front" if isinstance(pid, int) and pid < 0 else f"p{pid}"
 
 
 def render_pod_report(report: Dict) -> str:
@@ -313,7 +469,7 @@ def render_pod_report(report: Dict) -> str:
         inc = ("  ".join(f"{k}={v}" for k, v in sorted(sev.items()))
                or "clean")
         lines.append(
-            f"  p{pid}: steps {rep['steps']}  wall "
+            f"  {_plabel(pid)}: steps {rep['steps']}  wall "
             f"{rep['wall_seconds']:.2f}s  step p50 {_fmt_ms(pct['p50'])}"
             f"  incidents: {inc}"
             + (f"  [{meta.get('entry', '?')}]" if meta else ""))
@@ -323,11 +479,47 @@ def render_pod_report(report: Dict) -> str:
         lines.append(f"pod incidents: {len(incidents)}")
         for row in incidents:
             lines.append(
-                f"  [p{row['process']}] [{row['kind']}/"
+                f"  [{_plabel(row['process'])}] [{row['kind']}/"
                 f"{row.get('severity', 'warn')}] step {row['step']}: "
                 f"{row['detail']}")
     else:
         lines.append("pod incidents: none")
+    serving = report.get("serving")
+    if serving:
+        def _ms(v):
+            return (f"{v:.1f} ms" if isinstance(v, (int, float))
+                    and v == v else "n/a")
+
+        lines.append("")
+        lines.append("fleet serving (merged across replicas):")
+        lines.append(
+            f"  requests: {serving.get('submitted', 0)} submitted  "
+            f"{serving.get('served', 0)} served  "
+            f"{serving.get('rejected_total', 0)} rejected typed")
+        if serving.get("unaccounted"):
+            lines.append(f"  SILENT DROPS: {serving['unaccounted']} "
+                         f"request(s) unaccounted for — conservation "
+                         f"violated")
+        slo = serving.get("slo_p95_ms")
+        slo_s = ""
+        if isinstance(slo, (int, float)):
+            if "slo_ok" in serving:
+                verdict = "met" if serving["slo_ok"] else "VIOLATED"
+            else:
+                verdict = "no latency samples"
+            slo_s = f"   SLO p95 {_ms(slo)}: {verdict}"
+        lines.append(
+            f"  fleet latency (pooled "
+            f"{serving.get('pooled_samples', 0)} sample(s))  "
+            f"p50 {_ms(serving.get('latency_p50_ms'))}   "
+            f"p95 {_ms(serving.get('latency_p95_ms'))}   "
+            f"max {_ms(serving.get('latency_max_ms'))}{slo_s}")
+        for label, row in sorted((serving.get("replicas") or {}).items()):
+            lines.append(
+                f"    {label:<4} {row.get('served', 0):>6} served / "
+                f"{row.get('submitted', 0)} submitted  "
+                f"{row.get('rejected_total', 0)} rejected  "
+                f"p95 {_ms(row.get('latency_p95_ms'))}")
     res = report["resilience"]
     lines.append("")
     lines.append("pod resilience:")

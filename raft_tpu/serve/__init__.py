@@ -15,7 +15,16 @@ Pieces (one module each, composable and individually testable):
 - :mod:`~raft_tpu.serve.watchdog` — wedged compile/dispatch -> typed
   ``serve-stalled`` + nonzero exit;
 - :mod:`~raft_tpu.serve.server` — the FlowServer composition with
-  health/readiness probes and the obs-ledger serving summary.
+  health/readiness probes, the obs-ledger serving summary, and
+  continuous batching (iteration-boundary admission into in-flight
+  batch slots);
+- :mod:`~raft_tpu.serve.router` — consistent-hash stream-affinity
+  routing over a PodChannel-backed membership/health view;
+- :mod:`~raft_tpu.serve.fleet` — the FleetServer front door: N
+  replicas, warm-state spill store, typed rescue on replica death,
+  zero-downtime rolling restarts;
+- :mod:`~raft_tpu.serve.tiled` — tiled high-res (4K) inference:
+  overlap-blend seams over tiles fed through the bucketed batcher.
 
 ``python -m raft_tpu.serve`` drives a synthetic load session (the
 chaos-matrix and bench harness target); see ``--help``.
@@ -30,11 +39,17 @@ from raft_tpu.serve.degrade import (DEFAULT_ITER_LEVELS, IterationController,
 from raft_tpu.serve.engine import (ServeEngine, abstract_serve_forward,
                                    bucket_for, default_buckets,
                                    pad_to_bucket, serve_config)
+from raft_tpu.serve.fleet import FleetServer, SpillStore
+from raft_tpu.serve.router import (FleetMembership, FleetRouter, HashRing,
+                                   LocalKVStore, NoReplicaError)
 from raft_tpu.serve.server import FlowServer
 from raft_tpu.serve.watchdog import (SERVE_WATCHDOG_EXIT_CODE,
                                      DispatchWatchdog)
 
 __all__ = [
+    "FleetServer", "SpillStore",
+    "FleetMembership", "FleetRouter", "HashRing", "LocalKVStore",
+    "NoReplicaError",
     "AOTCache", "cache_key", "env_fingerprint",
     "BadRequestError", "DeadlineExceededError", "QueueFullError",
     "Request", "RequestError", "RequestQueue",

@@ -43,6 +43,28 @@ request routes to a stereo disparity engine (workloads/stereo.py)
 through the SAME server — per-(workload, family) batching, one queue,
 one degradation controller; the summary's ``families`` section carries
 the per-workload split.
+
+``--fleet N`` runs the session against N replicas behind the fleet
+front door (serve/fleet.py): stream-affinity routing, a shared AOT
+cache (replica 0 compiles, the rest and every restart load warm), a
+shared spill store, per-replica ledgers at ``<ledger>.p<i>`` (render
+with ``obs report --merge``), and a ``fleet_summary`` JSON line.  Two
+fleet-only injects:
+
+- ``kill-replica@K``   after K served requests, hard-kill the
+                       busiest replica: queued work re-places typed on
+                       survivors, its streams re-route and adopt
+                       spilled warm state
+- ``rolling-restart[@K]`` start a zero-downtime rolling restart
+                       (drain -> close -> warm AOT restore -> rejoin,
+                       one replica at a time) while the load runs; the
+                       summary's steady_p95_ms / post_event_p95_ms
+                       carry the p95-flat-through-the-roll measurement
+
+``--continuous`` switches every server (single or fleet) to
+continuous batching: requests join in-flight batches at GRU iteration
+boundaries (``--segment_iters`` per segment) instead of waiting out
+FIFO assembly barriers.
 """
 
 from __future__ import annotations
@@ -60,17 +82,54 @@ def parse_inject(spec):
     if not spec:
         return None, 0
     kind, _, arg = spec.partition("@")
-    kinds = ("overload", "deadline-storm", "poison", "sigkill", "stall")
+    kinds = ("overload", "deadline-storm", "poison", "sigkill", "stall",
+             "kill-replica", "rolling-restart")
     if kind not in kinds:
         raise ValueError(f"unknown serve inject {kind!r} "
                          f"(known: {', '.join(kinds)})")
-    if kind in ("poison", "sigkill"):
+    if kind in ("poison", "sigkill", "kill-replica"):
         if not arg.isdigit():
             raise ValueError(f"inject {kind} needs @K (request ordinal)")
         return kind, int(arg)
+    if kind == "rolling-restart":
+        if arg and not arg.isdigit():
+            raise ValueError("inject rolling-restart takes an optional "
+                             "@K (served ordinal to start the roll at)")
+        return kind, int(arg) if arg else 0
     if arg:
         raise ValueError(f"inject {kind} takes no @arg")
     return kind, 0
+
+
+def _stereo_engine_builder(init_img, seed: int, batch_size: int, aot):
+    """ONE stereo serving recipe for both session shapes: the fleet
+    factory and the single-server session must serve the SAME audited
+    stereo graph (model config, cache tag, warm channels) — two
+    hand-copied construction blocks would silently drift, and the
+    fleet's AOT cache entries would stop matching the registered
+    ``stereo_serve`` entry.  Inits the model once; the returned
+    closure builds one ServeEngine per call (the fleet factory calls
+    it per replica)."""
+    import jax
+
+    from raft_tpu.serve.engine import ServeEngine
+    from raft_tpu.workloads.stereo import (STEREO_SERVE_OVERRIDES,
+                                           StereoRAFT,
+                                           compile_stereo_forward,
+                                           stereo_config)
+
+    model = StereoRAFT(stereo_config(small=True,
+                                     overrides=STEREO_SERVE_OVERRIDES))
+    variables = model.init(jax.random.PRNGKey(seed + 1), init_img,
+                           init_img, iters=2, train=True)
+
+    def make():
+        return ServeEngine(model, variables, batch_size=batch_size,
+                           aot_cache=aot,
+                           compile_fn=compile_stereo_forward,
+                           cache_tag="stereo_serve", warm_channels=1)
+
+    return make
 
 
 def parse_args(argv=None):
@@ -98,6 +157,19 @@ def parse_args(argv=None):
                    help="route every Nth request to a STEREO disparity "
                         "engine through the same server (heterogeneous "
                         "per-family batching; 0 = flow only)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="run N FlowServer replicas behind the fleet "
+                        "front door (stream-affinity routing, shared "
+                        "warm-state spill store, per-replica ledgers "
+                        "<ledger>.p<i>); 0 = single server.  Enables "
+                        "--inject kill-replica@K / rolling-restart[@K]")
+    p.add_argument("--continuous", action="store_true",
+                   help="continuous batching: admit requests into "
+                        "in-flight batch slots at GRU iteration "
+                        "boundaries instead of FIFO assembly barriers")
+    p.add_argument("--segment_iters", type=int, default=None,
+                   help="iterations per continuous-batching segment "
+                        "(default: the ladder's smallest level)")
     p.add_argument("--warm_iters", type=int, default=None,
                    help="iteration floor for fully-warm video batches")
     p.add_argument("--no_degrade", action="store_true")
@@ -114,12 +186,235 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def fleet_main(args, inject, inject_arg) -> int:
+    """The fleet session: N in-process replicas behind the front door
+    (serve/fleet.py), a shared AOT cache (restarts restore warm) and a
+    shared spill store (streams survive replica changes), driven by
+    the same synthetic load.  Prints ``serve_startup`` after warmup and
+    ``fleet_summary`` at exit; with ``--inject rolling-restart`` the
+    summary carries ``steady_p95_ms`` / ``post_event_p95_ms`` — the
+    client-measured p95 before vs after the event started, the
+    "p95 flat through the roll" number."""
+    if inject in ("sigkill", "stall"):
+        print(f"serve: inject {inject} is a single-server scenario; "
+              f"drop --fleet", file=sys.stderr)
+        return 2
+
+    import tempfile
+
+    import numpy as np
+
+    from raft_tpu.utils.platform import ensure_platform
+
+    ensure_platform(honor_device_count_flag=False)
+
+    import jax
+
+    from raft_tpu.models import RAFT
+    from raft_tpu.obs import RunLedger
+    from raft_tpu.serve import (AOTCache, FleetServer, RequestError,
+                                ServeEngine, serve_config)
+    from raft_tpu.serve.engine import _round8
+    from raft_tpu.serve.server import FlowServer
+
+    H, W = (_round8(x) for x in args.image_size)
+    levels = tuple(int(x) for x in args.iter_levels.split(","))
+    cfg = serve_config(small=True)
+    model = RAFT(cfg)
+    rng = np.random.default_rng(args.seed)
+
+    workdir = tempfile.mkdtemp(prefix="fleet_session_")
+    cache_dir = args.aot_cache or os.path.join(workdir, "aot")
+    ledger = None
+    if args.ledger:
+        ledger = RunLedger(args.ledger, meta={
+            "entry": "serve-fleet", "image_size": [H, W],
+            "batch_size": args.batch_size, "iter_levels": list(levels),
+            "replicas": args.fleet, "slo_ms": args.slo_ms,
+            "backend": jax.devices()[0].platform,
+            "devices": jax.device_count(),
+        })
+
+    def fleet_incident(kind, detail):
+        if ledger is not None:
+            ledger.incident(kind, step=0, detail=detail)
+
+    # ONE cache for the whole fleet: replica 0 pays the compiles, the
+    # others (and every restart) verify-and-load warm
+    aot = AOTCache(cache_dir, on_incident=fleet_incident)
+    init_img = np.zeros((1, H, W, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(args.seed), init_img,
+                           init_img, iters=2, train=True)
+    make_stereo = None
+    if args.stereo_every:
+        make_stereo = _stereo_engine_builder(init_img, args.seed,
+                                             args.batch_size, aot)
+
+    buckets = {"session": (H, W)}
+
+    def factory(rid, spill):
+        engines = {"flow": ServeEngine(model, variables,
+                                       batch_size=args.batch_size,
+                                       aot_cache=aot)}
+        if make_stereo is not None:
+            engines["stereo"] = make_stereo()
+        rep_ledger = None
+        if args.ledger:
+            rep_ledger = RunLedger(
+                f"{args.ledger}.p{rid[1:]}",
+                meta={"entry": "serve", "replica": rid,
+                      "image_size": [H, W]})
+        return FlowServer(
+            engines, buckets=buckets,
+            queue_capacity=args.queue_capacity, iter_levels=levels,
+            slo_ms=args.slo_ms, degrade=not args.no_degrade,
+            warm_iters=args.warm_iters, ledger=rep_ledger,
+            watchdog_timeout_s=args.watchdog_timeout,
+            spill_store=spill, continuous=args.continuous,
+            segment_iters=args.segment_iters)
+
+    fleet = FleetServer(factory, n_replicas=args.fleet,
+                        spill_dir=os.path.join(workdir, "spill"),
+                        ledger=ledger, slo_ms=args.slo_ms)
+    t0 = time.perf_counter()
+    fleet.warmup()
+    startup_s = time.perf_counter() - t0
+    stats = dict(aot.stats)
+    print(json.dumps({"serve_startup": {
+        "startup_s": round(startup_s, 3),
+        "cold_startup_s": round(fleet.cold_startup_s or 0.0, 3),
+        "warm_hits": int(stats.get("hits", 0)),
+        "cold_compiles": int(stats.get("misses", 0)),
+        "cache_corrupt": int(stats.get("corrupt", 0)),
+        "replicas": args.fleet,
+    }}), flush=True)
+
+    def frame():
+        return rng.integers(0, 255, (H, W, 3)).astype(np.float32)
+
+    event_fired = [False]
+    roll_thread = None
+    lat_steady: list = []
+    lat_after: list = []
+    served = 0
+    futures = []
+    reaped_upto = 0
+
+    def reap(upto):
+        nonlocal served, reaped_upto
+        for f, t_sub in futures[reaped_upto:upto]:
+            if f is None:
+                continue
+            try:
+                f.result(timeout=600)
+            except RequestError:
+                continue
+            (lat_after if event_fired[0] else lat_steady).append(
+                time.perf_counter() - t_sub)
+            served += 1
+        reaped_upto = max(reaped_upto, upto)
+
+    def maybe_fire_event():
+        nonlocal roll_thread
+        if event_fired[0] or inject not in ("kill-replica",
+                                            "rolling-restart"):
+            return
+        threshold = (inject_arg if inject_arg > 0
+                     else max(args.batch_size, args.requests // 2))
+        if served < threshold:
+            return
+        event_fired[0] = True
+        if inject == "kill-replica":
+            by_served = fleet.fleet_summary()["replicas"]
+            victim = max(by_served,
+                         key=lambda r: by_served[r]["served"])
+            print(f"serve: killing replica {victim} after "
+                  f"{served} served", file=sys.stderr)
+            fleet.kill_replica(victim)
+        else:
+            print(f"serve: starting rolling restart after "
+                  f"{served} served", file=sys.stderr)
+            import threading
+            # the summary reads the roll's rows from fleet._restarts
+            # (fleet_summary); the return value is not needed here
+            roll_thread = threading.Thread(
+                target=fleet.rolling_restart, daemon=True)
+            roll_thread.start()
+
+    for i in range(args.requests):
+        img1, img2 = frame(), frame()
+        if inject == "poison" and i == inject_arg:
+            img1 = img1.copy()
+            img1[0, 0, 0] = np.nan
+        stream = (f"s{i % args.video_streams}"
+                  if args.video_streams else None)
+        workload = ("stereo" if args.stereo_every
+                    and (i % args.stereo_every) == args.stereo_every - 1
+                    else "flow")
+        deadline = args.deadline_ms
+        if inject == "deadline-storm":
+            deadline = -1.0
+        try:
+            futures.append((fleet.submit(img1, img2,
+                                         deadline_ms=deadline,
+                                         stream=stream,
+                                         workload=workload),
+                            time.perf_counter()))
+        except RequestError:
+            futures.append((None, 0.0))
+        if inject != "overload" and (i + 1) % args.batch_size == 0:
+            reap(len(futures))
+        maybe_fire_event()
+    reap(len(futures))
+    if roll_thread is not None:
+        roll_thread.join(timeout=600)
+
+    summary = fleet.close()
+    from raft_tpu.obs.events import sanitize_json
+
+    def p95_ms(xs):
+        return (round(1000.0 * float(np.percentile(np.asarray(xs), 95)),
+                      3) if xs else None)
+
+    summary["steady_p95_ms"] = p95_ms(lat_steady)
+    summary["post_event_p95_ms"] = p95_ms(lat_after)
+    if summary["steady_p95_ms"] and summary["post_event_p95_ms"]:
+        summary["p95_ratio"] = round(
+            summary["post_event_p95_ms"] / summary["steady_p95_ms"], 3)
+    print(json.dumps({"fleet_summary": sanitize_json(summary)},
+                     default=str, allow_nan=False), flush=True)
+
+    if summary["unaccounted"]:
+        print(f"serve: FLEET request conservation VIOLATED "
+              f"({summary['unaccounted']} unaccounted)", file=sys.stderr)
+        return 1
+    if args.fail_on_slo:
+        if args.slo_ms is None:
+            print("serve: --fail-on-slo needs --slo_ms", file=sys.stderr)
+            return 2
+        p95 = summary.get("latency_p95_ms")
+        if p95 is None or p95 != p95:
+            print("serve: --fail-on-slo but the fleet measured no "
+                  "latency (zero served requests)", file=sys.stderr)
+            return 2
+        if p95 > args.slo_ms:
+            print(f"serve: fleet p95 {p95:.1f}ms exceeds SLO "
+                  f"{args.slo_ms:.1f}ms", file=sys.stderr)
+            return 1
+    return 0
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     try:
         inject, inject_arg = parse_inject(args.inject)
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
+        return 2
+    if args.fleet:
+        return fleet_main(args, inject, inject_arg)
+    if inject in ("kill-replica", "rolling-restart"):
+        print(f"serve: inject {inject} needs --fleet N", file=sys.stderr)
         return 2
 
     import numpy as np
@@ -185,27 +480,16 @@ def main(argv=None) -> int:
         # heterogeneous session: a stereo disparity engine rides the
         # SAME queue/batcher/controller; its requests batch in their
         # own (workload, family) lane and dispatch its own executables
-        from raft_tpu.workloads.stereo import (STEREO_SERVE_OVERRIDES,
-                                               StereoRAFT,
-                                               compile_stereo_forward,
-                                               stereo_config)
-
-        stereo_model = StereoRAFT(stereo_config(
-            small=True, overrides=STEREO_SERVE_OVERRIDES))
-        stereo_vars = stereo_model.init(
-            jax.random.PRNGKey(args.seed + 1), init_img, init_img,
-            iters=2, train=True)
-        engines["stereo"] = ServeEngine(
-            stereo_model, stereo_vars, batch_size=args.batch_size,
-            aot_cache=aot, compile_fn=compile_stereo_forward,
-            cache_tag="stereo_serve", warm_channels=1)
+        engines["stereo"] = _stereo_engine_builder(
+            init_img, args.seed, args.batch_size, aot)()
 
     buckets = {"session": (H, W)}
     server = FlowServer(
         engines, buckets=buckets, queue_capacity=args.queue_capacity,
         iter_levels=levels, slo_ms=args.slo_ms,
         degrade=not args.no_degrade, warm_iters=args.warm_iters,
-        ledger=ledger, watchdog_timeout_s=args.watchdog_timeout)
+        ledger=ledger, watchdog_timeout_s=args.watchdog_timeout,
+        continuous=args.continuous, segment_iters=args.segment_iters)
 
     t0 = time.perf_counter()
     server.warmup(warm_too=args.video_streams > 0)

@@ -214,6 +214,28 @@ class RequestQueue:
             self._size -= len(out)
             return out
 
+    def pop_lane(self, lane: Tuple[str, str], max_n: int) -> List[Request]:
+        """Up to ``max_n`` requests from ONE (workload, family) lane,
+        non-blocking — the continuous batcher's admission pop: free
+        slots of an in-flight batch can only take requests that match
+        its executable (same workload, same shape family)."""
+        with self._lock:
+            q = self._lanes.get(lane)
+            out: List[Request] = []
+            while q and len(out) < max_n:
+                out.append(q.popleft())
+            self._size -= len(out)
+            return out
+
+    def other_lane_waiting(self, lane: Tuple[str, str]) -> bool:
+        """True when any lane OTHER than ``lane`` has queued work —
+        the continuous batcher's fairness signal: while another lane
+        waits, the in-flight batch stops admitting same-lane joiners
+        and drains, so one busy lane can never starve the rest."""
+        with self._lock:
+            return any(q and k != lane
+                       for k, q in self._lanes.items())
+
     def drain(self) -> List[Request]:
         """Close the queue and return everything still queued (the
         server rejects them typed at shutdown — no silent drops)."""

@@ -167,6 +167,24 @@ class LatencyTracker:
 
         return 1000.0 * float(np.percentile(list(self.window), 95))
 
+    def sample_ms(self, cap: int = 256) -> List[float]:
+        """Bounded quantile sketch for cross-replica pooling: up to
+        ``cap`` evenly-spaced order statistics of the reservoir (all
+        samples below the cap, so small runs pool exactly).  The fleet
+        report computes its fleet-wide percentiles from the pooled
+        sketches — per-replica percentiles cannot be merged."""
+        import numpy as np
+
+        if not self.samples:
+            return []
+        # graftlint: disable=f64-literal -- host-side latency seconds;
+        # never reaches a device
+        arr = np.sort(np.asarray(self.samples, dtype=np.float64))
+        if arr.size > cap:
+            idx = np.linspace(0, arr.size - 1, cap).round().astype(int)
+            arr = arr[idx]
+        return [round(1000.0 * float(x), 3) for x in arr]
+
     def percentiles_ms(self) -> Dict[str, float]:
         import numpy as np
 

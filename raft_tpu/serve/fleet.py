@@ -1,0 +1,763 @@
+"""The serving fleet: N FlowServer replicas behind one front door.
+
+PR 10's FlowServer is a single-process story (one queue, one batcher,
+one warm-state LRU, one AOT cache); millions of concurrent video
+streams need a FLEET.  This module is the composition layer that turns
+N replicas into one service without giving up any of the single-server
+guarantees:
+
+- **Stream-affinity routing** (router.py): streams ride a consistent-
+  hash ring over the live membership view (PR 7's PodChannel as the
+  health transport), so a stream's ``flow_init`` warm-start chain keeps
+  landing where its state lives, and a replica death moves only that
+  replica's streams.
+- **Warm-state spill** (:class:`SpillStore`): every served stream frame
+  writes its low-res state through a shared on-disk store under the
+  PR 6 manifest discipline (atomic fsync'd-tmp+rename, sha256 sidecar,
+  verify-before-trust).  A rerouted stream's new replica ADOPTS the
+  verified state (typed ``fleet-warm-adopt``) or re-cold-starts typed
+  (``fleet-cold-start``) — never an error, never a silent drop of the
+  warm chain.
+- **Typed rescue**: killing a replica returns its queued requests to
+  the front door, which re-places each on a surviving replica
+  (``fleet-reroute``); fleet-wide request conservation —
+  ``submitted == served + typed rejects + in-flight`` — is a structural
+  invariant with its own FATAL ``fleet-conservation`` incident, exactly
+  the single-server contract lifted one level.
+- **Zero-downtime rolling restart** (:meth:`FleetServer.
+  rolling_restart`): drain -> close -> rebuild -> warm AOT restore
+  (the shared executable cache makes the restart measurably cheaper
+  than the cold start — the warm/cold ratio is recorded per restart),
+  one replica at a time, while the rest keep serving.
+
+The replicas here are in-process FlowServers (each with its own
+batcher thread) — the CPU test/bench/chaos shape.  The same
+composition runs replicas-as-hosts by backing the membership channel
+with the real jax.distributed KV client and pointing the spill store
+and AOT cache at shared storage; nothing in this module assumes a
+shared address space beyond the replica handle's ``submit``/``kill``/
+``close`` surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import logging
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.serve.batcher import (BadRequestError, DeadlineExceededError,
+                                    QueueFullError, RequestError)
+from raft_tpu.serve.degrade import LatencyTracker
+from raft_tpu.serve.router import (FleetMembership, FleetRouter,
+                                   LocalKVStore, NoReplicaError,
+                                   ReplicaHeartbeat, fleet_channel)
+from raft_tpu.serve.server import INCIDENT_SAMPLE
+
+logger = logging.getLogger(__name__)
+
+SPILL_SUFFIX = ".state"
+SPILL_MANIFEST_VERSION = 1
+
+
+class ReplicaLostError(RequestError):
+    """A replica died with this request still queued.  Internal to the
+    fleet: the front door's completion callback converts it into a
+    re-placement on a survivor (the typed rescue), so a caller only
+    ever sees it if every survivor also rejects."""
+
+    kind = "fleet-replica-lost"
+
+
+class SpillStore:
+    """Shared on-disk warm-state store, verify-on-load.
+
+    One entry per (workload, stream) key: the stream's latest low-res
+    state (``flow_low`` / ``disp_low``), serialized as ``.npy`` bytes
+    with the PR 6 manifest discipline — atomic write, sidecar manifest
+    (size + sha256 + shape/dtype), blob before manifest.  ``get``
+    verifies BEFORE deserializing; a torn/flipped/manifest-less entry
+    fires a typed ``fleet-cold-start`` through ``on_incident``, is
+    quarantined, and returns None — the stream re-cold-starts, the
+    request is still served.  A missing key is a silent None (every
+    new stream is legitimately cold)."""
+
+    def __init__(self, store_dir: str,
+                 on_incident: Optional[Callable[[str, str], None]] = None):
+        self.store_dir = store_dir
+        self._on_incident = on_incident
+        self.stats: Dict[str, int] = {"puts": 0, "hits": 0, "misses": 0,
+                                      "corrupt": 0}
+        os.makedirs(store_dir, exist_ok=True)
+
+    def path(self, key: Tuple[str, str]) -> str:
+        name = hashlib.sha256(
+            f"{key[0]}/{key[1]}".encode("utf-8")).hexdigest()[:24]
+        return os.path.join(self.store_dir, name + SPILL_SUFFIX)
+
+    def _manifest_path(self, key: Tuple[str, str]) -> str:
+        from raft_tpu.training.state import manifest_path
+
+        return manifest_path(self.path(key))
+
+    def _incident(self, detail: str) -> None:
+        self.stats["corrupt"] += 1
+        logger.warning("spill store: %s", detail)
+        if self._on_incident is not None:
+            self._on_incident("fleet-cold-start", detail)
+
+    @staticmethod
+    def _atomic_write(path: str, data: bytes) -> None:
+        """Atomic rename WITHOUT fsync: spill writes run on the
+        serving hot path (every served stream frame), and warm state
+        is ADVISORY — get() verifies size+sha before trusting, so a
+        power-loss-torn entry degrades to a typed cold start, never
+        corruption.  Paying an fsync per frame would tax the p95 the
+        SLO gate measures for durability the design doesn't need
+        (checkpoints, which DO need it, use training/state.py's
+        fsync'd writer).  Unique tmp names: replicas' batcher threads
+        may spill the same (workload, stream) key concurrently."""
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                logger.warning("spill store: orphan tmp %s", tmp)
+            raise
+
+    def put(self, key: Tuple[str, str], state: np.ndarray) -> None:
+        """Write-through from a replica's ``_remember_stream``: atomic
+        blob, then manifest (a kill between the renames leaves an
+        unverifiable blob that ``get`` refuses — never a torn adopt)."""
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(state), allow_pickle=False)
+        data = buf.getvalue()
+        manifest = {
+            "v": SPILL_MANIFEST_VERSION,
+            "workload": key[0], "stream": key[1],
+            "size": len(data),
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "shape": list(np.shape(state)),
+            "dtype": str(np.asarray(state).dtype),
+        }
+        self._atomic_write(self.path(key), data)
+        self._atomic_write(
+            self._manifest_path(key),
+            json.dumps(manifest, sort_keys=True).encode("utf-8"))
+        self.stats["puts"] += 1
+
+    def _read_verified(self, key: Tuple[str, str]) -> np.ndarray:
+        """One manifest+blob read with full verification; raises on any
+        mismatch or decode failure."""
+        with open(self._manifest_path(key), encoding="utf-8") as f:
+            manifest = json.load(f)
+        with open(self.path(key), "rb") as f:
+            data = f.read()
+        if manifest.get("size") != len(data):
+            raise ValueError(
+                f"size mismatch: manifest {manifest.get('size')} vs "
+                f"{len(data)} bytes — torn write")
+        if hashlib.sha256(data).hexdigest() != manifest.get("sha256"):
+            raise ValueError("sha256 mismatch — corrupted at rest")
+        return np.load(io.BytesIO(data), allow_pickle=False)
+
+    def get(self, key: Tuple[str, str]) -> Optional[np.ndarray]:
+        """The verified state for ``key``, or None (missing: silent
+        miss; unverifiable: typed ``fleet-cold-start`` + quarantine)."""
+        path = self.path(key)
+        if not os.path.exists(path):
+            self.stats["misses"] += 1
+            return None
+        label = f"{key[0]}/{key[1]}"
+        try:
+            try:
+                arr = self._read_verified(key)
+            except Exception as first:  # noqa: BLE001 — one retry:
+                # put() writes blob-then-manifest as two atomic renames,
+                # so a reader landing between them pairs the NEW blob
+                # with the OLD manifest; that transient must not
+                # quarantine a valid fresh entry (the dying replica's
+                # last spill is exactly what a kill-replica adoption is
+                # reading for).  The short backoff gives a preempted
+                # writer time to land its second rename — a bounded
+                # grace, not a guarantee: a writer stalled longer
+                # presents as torn and quarantines, which costs one
+                # typed cold start (the store's documented degradation),
+                # not correctness.
+                logger.debug("spill store: %s verify failed once (%s); "
+                             "re-reading after grace", label, first)
+                time.sleep(0.05)
+                arr = self._read_verified(key)
+        except Exception as e:  # noqa: BLE001 — any verify/decode
+            # failure means the warm state cannot be trusted; the typed
+            # re-cold-start (not an error) is the contract
+            self._incident(
+                f"stream {label} spill state failed verification "
+                f"({type(e).__name__}: {e}); typed re-cold-start — the "
+                f"stream restarts its warm chain, the request is served")
+            for p in (path, self._manifest_path(key)):
+                try:
+                    if os.path.exists(p):
+                        os.replace(p, p + ".corrupt")
+                except OSError:
+                    logger.warning("spill store: could not quarantine %s",
+                                   p)
+            return None
+        self.stats["hits"] += 1
+        return arr
+
+
+@dataclasses.dataclass
+class _Pending:
+    """One fleet request's bookkeeping between submit and terminal."""
+
+    fid: int
+    image1: np.ndarray
+    image2: np.ndarray
+    deadline_abs: Optional[float]
+    stream: Optional[str]
+    workload: str
+    t_submit: float
+    future: Future
+    replica: Optional[str] = None
+    rfut: Optional[Future] = None
+    moved_from: Optional[str] = None
+    attempts: int = 0
+    # terminal-ownership flag, guarded by the fleet lock: exactly ONE
+    # path (completion callback or typed rejection) may count and
+    # resolve this request — close()'s leftover sweep racing a late
+    # completion would otherwise count it BOTH served and rejected,
+    # driving "unaccounted" negative and firing a false FATAL
+    # fleet-conservation on a run with zero silent drops
+    done: bool = False
+
+
+@dataclasses.dataclass
+class _Replica:
+    """A live replica handle: the server, its heartbeat publisher, and
+    its measured warmup cost."""
+
+    rid: str
+    server: object
+    heartbeat: ReplicaHeartbeat
+    startup_s: float = 0.0
+    restarts: int = 0
+
+
+class FleetServer:
+    """N FlowServer replicas under one stream-affinity front door.
+
+    ``replica_factory(rid, spill_store)`` builds one UN-warmed
+    FlowServer (the fleet warms it and measures the startup — pass a
+    shared :class:`~raft_tpu.serve.aot.AOTCache` into the factory's
+    engines to make restarts warm).  ``warmup()`` starts every replica
+    and its heartbeat; the largest initial warmup is remembered as the
+    cold-start baseline the rolling-restart gate compares against.
+    """
+
+    def __init__(self, replica_factory, n_replicas: int = 3,
+                 spill_dir: Optional[str] = None,
+                 ledger=None,
+                 slo_ms: Optional[float] = None,
+                 heartbeat_interval: float = 0.2,
+                 kv=None,
+                 max_place_attempts: int = 3,
+                 clock=time.monotonic):
+        if n_replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {n_replicas}")
+        self._factory = replica_factory
+        self.replica_ids: Tuple[str, ...] = tuple(
+            f"r{i}" for i in range(int(n_replicas)))
+        self.ledger = ledger
+        self.slo_ms = slo_ms
+        self._clock = clock
+        self._kv = kv if kv is not None else LocalKVStore()
+        self._hb_interval = float(heartbeat_interval)
+        self._max_attempts = int(max_place_attempts)
+        self.spill_store = (SpillStore(spill_dir,
+                                       on_incident=self._incident)
+                            if spill_dir else None)
+        self.membership = FleetMembership(
+            fleet_channel(self._kv, 0, len(self.replica_ids)),
+            self.replica_ids, interval=self._hb_interval, clock=clock)
+        self.router = FleetRouter(self.membership)
+        self.latency = LatencyTracker()
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "served": 0, "rejected_queue_full": 0,
+            "rejected_deadline": 0, "rejected_bad_request": 0,
+            "rejected_shutdown": 0, "rerouted": 0, "stream_moves": 0,
+        }
+        self._replica_served: Dict[str, int] = {}
+        self._incident_counts: Dict[str, int] = {}
+        self._restarts: List[Dict] = []
+        self._pending: Dict[int, _Pending] = {}
+        self._next_fid = 0
+        self._lock = threading.Lock()
+        self._closed = False
+        self.cold_startup_s: Optional[float] = None
+        self._replicas: Dict[str, _Replica] = {}
+        for rid in self.replica_ids:
+            self._replicas[rid] = self._build_replica(rid)
+
+    # -- telemetry (the FlowServer sampling discipline) ---------------------
+
+    def _incident(self, kind: str, detail: str,
+                  sample: bool = True) -> None:
+        with self._lock:
+            n = self._incident_counts.get(kind, 0) + 1
+            self._incident_counts[kind] = n
+        if self.ledger is None:
+            return
+        if sample and n > 1 and (n % INCIDENT_SAMPLE) != 0:
+            return
+        if sample and n > 1:
+            detail = f"[{n} total so far, 1-in-{INCIDENT_SAMPLE} " \
+                     f"sampled] {detail}"
+        try:
+            self.ledger.incident(kind, step=0, detail=detail)
+        except (ValueError, OSError):
+            logger.warning("fleet: incident %s not ledgered; counters "
+                           "carry it", kind)
+
+    # -- replica lifecycle ---------------------------------------------------
+
+    def _build_replica(self, rid: str) -> _Replica:
+        server = self._factory(rid, self.spill_store)
+        idx = self.replica_ids.index(rid)
+        channel = fleet_channel(self._kv, idx, len(self.replica_ids))
+        hb = ReplicaHeartbeat(
+            channel, lambda s=server: bool(s.health()["ok"]),
+            interval=self._hb_interval, clock=self._clock)
+        return _Replica(rid=rid, server=server, heartbeat=hb)
+
+    def warmup(self) -> float:
+        """Warm every replica (compile or AOT-load its executables),
+        start heartbeats, record the cold-start baseline.  Returns
+        total wall seconds."""
+        total = 0.0
+        for rid in self.replica_ids:
+            rep = self._replicas[rid]
+            t0 = time.perf_counter()
+            rep.server.warmup()
+            rep.startup_s = time.perf_counter() - t0
+            total += rep.startup_s
+            rep.heartbeat.start()
+        # the largest initial warmup is the one that paid the compiles
+        # (with a shared AOT cache the rest warm-load from its stores)
+        self.cold_startup_s = max(
+            self._replicas[r].startup_s for r in self.replica_ids)
+        return total
+
+    def _depths(self) -> Dict[str, int]:
+        out = {}
+        for rid, rep in self._replicas.items():
+            try:
+                out[rid] = len(rep.server.queue)
+            except Exception as e:  # noqa: BLE001 — a dying replica's
+                # depth read may fail mid-teardown; report it as
+                # unplaceable rather than failing the routing decision
+                logger.warning("fleet: depth read for %s failed (%s); "
+                               "treating as full", rid,
+                               type(e).__name__)
+                out[rid] = 1 << 30
+        return out
+
+    # -- the admission edge --------------------------------------------------
+
+    def submit(self, image1: np.ndarray, image2: np.ndarray,
+               deadline_ms: Optional[float] = None,
+               stream: Optional[str] = None,
+               workload: str = "flow") -> Future:
+        """Admit one request fleet-wide; returns the FLEET's future
+        (replica reroutes are invisible to the caller).  Raises the
+        typed :class:`RequestError` subclasses on admission rejection,
+        same contract as :meth:`FlowServer.submit`."""
+        with self._lock:
+            self.counters["submitted"] += 1
+            if self._closed:
+                self.counters["rejected_shutdown"] += 1
+                err: Optional[RequestError] = \
+                    BadRequestError("fleet is shutting down")
+            else:
+                err = None
+                fid = self._next_fid
+                self._next_fid += 1
+        if err is not None:
+            self._incident(err.kind, str(err))
+            raise err
+        pend = _Pending(
+            fid=fid, image1=image1, image2=image2,
+            deadline_abs=(self._clock() + deadline_ms / 1000.0
+                          if deadline_ms is not None else None),
+            stream=stream, workload=workload,
+            t_submit=self._clock(), future=Future())
+        try:
+            self._place(pend)
+        except RequestError as e:
+            self._finish_rejected(pend, e)
+            raise
+        return pend.future
+
+    def _reject_counter(self, err: RequestError) -> str:
+        return {"queue-full": "rejected_queue_full",
+                "deadline-exceeded": "rejected_deadline"}.get(
+                    err.kind, "rejected_bad_request")
+
+    def _finish_rejected(self, pend: _Pending, err: RequestError) -> None:
+        with self._lock:
+            if pend.done:
+                return       # a completion already owned the terminal
+            pend.done = True
+            self._pending.pop(pend.fid, None)
+            self.counters[self._reject_counter(err)] += 1
+        self._incident(err.kind, f"request {pend.fid}: {err}")
+        if not pend.future.done() \
+                and pend.future.set_running_or_notify_cancel():
+            pend.future.set_exception(err)
+
+    def _place(self, pend: _Pending, exclude: Tuple[str, ...] = ()) -> None:
+        """Route + submit to a replica; retries across replicas when
+        the chosen one died under us.  Raises typed on rejection."""
+        last_err: Optional[RequestError] = None
+        for _ in range(self._max_attempts):
+            pend.attempts += 1
+            if pend.deadline_abs is not None:
+                left_ms = 1000.0 * (pend.deadline_abs - self._clock())
+                if left_ms <= 0:
+                    raise DeadlineExceededError(
+                        f"request {pend.fid} expired before placement")
+            else:
+                left_ms = None
+            try:
+                target, moved = self.router.route(
+                    pend.stream, self._depths(), pend.workload)
+            except NoReplicaError as e:
+                # admission-control shed: the fleet cannot place work
+                # anywhere right now — same contract as a full queue
+                raise QueueFullError(
+                    f"no live replica to place request {pend.fid} "
+                    f"({e})") from e
+            if target in exclude:
+                live = [r for r in self.membership.live()
+                        if r not in exclude]
+                if not live:
+                    raise QueueFullError(
+                        f"no live replica outside {sorted(exclude)} for "
+                        f"request {pend.fid}")
+                depths = self._depths()
+                target = min(live, key=lambda r: (depths.get(r, 0), r))
+            if moved is not None:
+                with self._lock:
+                    self.counters["stream_moves"] += 1
+                pend.moved_from = moved
+                self._incident(
+                    "fleet-reroute",
+                    f"stream {pend.workload}/{pend.stream} re-routed "
+                    f"{moved} -> {target} (consistent-hash ring over "
+                    f"the live membership)")
+            rep = self._replicas[target]
+            try:
+                rfut = rep.server.submit(
+                    pend.image1, pend.image2, deadline_ms=left_ms,
+                    stream=pend.stream, workload=pend.workload)
+            except RequestError as e:
+                if self._replicas.get(target) is not rep:
+                    # raced a rolling-restart swap: the handle read
+                    # above is the CLOSED pre-restart server but the
+                    # replica itself is live again — retry on it (the
+                    # fresh handle), don't reject or exclude it
+                    last_err = e
+                    continue
+                if self.membership.mark(target) != "up":
+                    # raced a death/drain: try the survivors
+                    last_err = e
+                    exclude = exclude + (target,)
+                    continue
+                raise
+            pend.replica = target
+            with self._lock:
+                self._pending[pend.fid] = pend
+                pend.rfut = rfut
+            rfut.add_done_callback(
+                lambda f, fid=pend.fid: self._on_replica_done(fid, f))
+            return
+        raise (last_err if last_err is not None else QueueFullError(
+            f"request {pend.fid} could not be placed after "
+            f"{self._max_attempts} attempt(s)"))
+
+    def _on_replica_done(self, fid: int, rfut: Future) -> None:
+        with self._lock:
+            pend = self._pending.pop(fid, None)
+        if pend is None:
+            return                      # already rescued or finished
+        exc = rfut.exception()
+        if isinstance(exc, ReplicaLostError):
+            # the typed rescue: the request was queued on a replica
+            # that died — re-place it on a survivor.  Routing this
+            # through the FUTURE (not a scan of the pending map) makes
+            # rescue immune to the submit-vs-kill race: a callback
+            # attached after the future already failed still fires.
+            with self._lock:
+                closed = self._closed
+                if not closed:
+                    self.counters["rerouted"] += 1
+            if closed:
+                # a rescue landing mid-shutdown rejects typed instead
+                # of re-placing on replicas that are being closed
+                self._finish_rejected(pend, exc)
+                return
+            self._incident(
+                "fleet-reroute",
+                f"request {pend.fid} rescued from dead replica "
+                f"{pend.replica}; re-placed on a survivor")
+            try:
+                self._place(pend, exclude=(pend.replica,))
+            except RequestError as e:
+                self._finish_rejected(pend, e)
+            return
+        if exc is None:
+            res = dict(rfut.result())
+            res["replica"] = pend.replica
+            with self._lock:
+                if pend.done:
+                    return   # close()'s leftover sweep already
+                             # rejected this request typed; counting it
+                             # served TOO would double its terminal
+                pend.done = True
+                self.counters["served"] += 1
+                self._replica_served[pend.replica] = \
+                    self._replica_served.get(pend.replica, 0) + 1
+                # under the lock: completions arrive from EVERY
+                # replica's batcher thread, and the tracker's reservoir
+                # bookkeeping is not itself thread-safe
+                self.latency.add(self._clock() - pend.t_submit)
+            if pend.moved_from is not None and not res.get("warm"):
+                # the stream moved but no verified spill state was
+                # there to adopt: the typed re-cold-start leg
+                self._incident(
+                    "fleet-cold-start",
+                    f"stream {pend.workload}/{pend.stream} re-routed "
+                    f"from {pend.moved_from} with no adoptable warm "
+                    f"state; typed re-cold-start (request served)")
+            if pend.future.set_running_or_notify_cancel():
+                pend.future.set_result(res)
+            return
+        err = (exc if isinstance(exc, RequestError)
+               else BadRequestError(f"replica failure: "
+                                    f"{type(exc).__name__}: {exc}"))
+        self._finish_rejected(pend, err)
+
+    # -- failure + restart choreography --------------------------------------
+
+    def kill_replica(self, rid: str) -> int:
+        """Crash one replica and rescue its queued work.  Returns the
+        number of orphaned requests handed to re-placement.  Each
+        orphan's replica future fails with :class:`ReplicaLostError`;
+        the completion callback (:meth:`_on_replica_done`) re-places it
+        on a survivor — going through the future means a request whose
+        callback attachment RACES this kill is still rescued (callbacks
+        on an already-failed future fire immediately).  Streams owned
+        by the dead replica re-route on their next frame
+        (consistent-hash ring over the survivors) and adopt their
+        spilled warm state."""
+        if rid not in self._replicas:
+            raise KeyError(f"unknown replica {rid!r}")
+        self.membership.mark_dead(rid)
+        rep = self._replicas[rid]
+        rep.heartbeat.stop()
+        self._incident(
+            "fleet-replica-lost",
+            f"replica {rid} lost; membership pruned, its queued "
+            f"requests re-placed on survivors, its streams re-route "
+            f"via the ring", sample=False)
+        orphans = rep.server.kill()
+        for req in orphans:
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(ReplicaLostError(
+                    f"replica {rid} died with request {req.rid} still "
+                    f"queued; the fleet re-places it on a survivor"))
+        return len(orphans)
+
+    def _await_drained(self, rid: str, timeout: float) -> bool:
+        deadline = self._clock() + timeout
+        rep = self._replicas[rid]
+        while self._clock() < deadline:
+            with self._lock:
+                pending_here = any(p.replica == rid
+                                   for p in self._pending.values())
+            if not pending_here and len(rep.server.queue) == 0:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def rolling_restart(self, drain_timeout: float = 60.0) -> List[Dict]:
+        """Zero-downtime rolling restart: one replica at a time —
+        drain (router stops assigning to it; its streams re-route and
+        adopt spilled state), close, rebuild through the factory, warm
+        restore (measured against the cold baseline), rejoin.  The
+        other replicas serve throughout; the chaos row gates the fleet
+        p95 staying flat through the roll."""
+        results: List[Dict] = []
+        for rid in self.replica_ids:
+            rep = self._replicas[rid]
+            if self.membership.mark(rid) == "dead":
+                # a replica killed BEFORE the roll has crash semantics:
+                # nothing to drain, and its server must NOT be closed —
+                # a post-mortem run_end would book its rescued orphans
+                # as unaccounted and fire a false FATAL
+                # serve-conservation on the replica ledger.  The roll
+                # just rebuilds it (same as the undrained branch below).
+                drained = False
+                rep.heartbeat.stop()
+            else:
+                self._incident(
+                    "fleet-drain",
+                    f"replica {rid} draining for rolling restart; new "
+                    f"work routes to {len(self.replica_ids) - 1} "
+                    f"peer(s)", sample=False)
+                self.membership.mark_draining(rid)
+                drained = self._await_drained(rid, drain_timeout)
+                rep.heartbeat.stop()
+                if not drained:
+                    # rescue anything still stuck (a wedged replica
+                    # must not block the roll): crash-path semantics
+                    self.kill_replica(rid)
+                else:
+                    rep.server.close()
+            new = self._build_replica(rid)
+            t0 = time.perf_counter()
+            new.server.warmup()
+            new.startup_s = time.perf_counter() - t0
+            new.restarts = rep.restarts + 1
+            self._replicas[rid] = new
+            self.membership.mark_live(rid)
+            new.heartbeat.start()
+            cold = self.cold_startup_s or float("nan")
+            row = {"replica": rid, "warm_restore_s": round(new.startup_s, 3),
+                   "cold_startup_s": round(cold, 3),
+                   "warm_frac": (round(new.startup_s / cold, 3)
+                                 if cold == cold and cold > 0
+                                 else None),
+                   "drained": drained}
+            self._incident(
+                "fleet-restart",
+                f"replica {rid} restarted: warm restore "
+                f"{row['warm_restore_s']}s vs cold startup "
+                f"{row['cold_startup_s']}s "
+                f"({row['warm_frac']}x); drained={drained}",
+                sample=False)
+            with self._lock:
+                self._restarts.append(row)
+            results.append(row)
+        return results
+
+    # -- probes / summary / shutdown -----------------------------------------
+
+    def health(self) -> Dict:
+        live = self.membership.live()
+        return {
+            "ok": bool(live),
+            "live_replicas": live,
+            "replicas": {rid: self.membership.mark(rid)
+                         for rid in self.replica_ids},
+            "queue_depths": self._depths(),
+            "counters": dict(self.counters),
+        }
+
+    def fleet_summary(self) -> Dict:
+        """The front-door ledger's ``run_end`` serving section: fleet-
+        level conservation + latency, per-replica attribution, restart
+        and spill economics."""
+        with self._lock:
+            counters = dict(self.counters)
+            in_flight = len(self._pending)
+            replica_served = dict(self._replica_served)
+            restarts = list(self._restarts)
+        rejected = (counters["rejected_queue_full"]
+                    + counters["rejected_deadline"]
+                    + counters["rejected_bad_request"]
+                    + counters["rejected_shutdown"])
+        summary = {
+            **counters,
+            "rejected_total": rejected,
+            "in_flight": in_flight,
+            "unaccounted": (counters["submitted"] - counters["served"]
+                            - rejected - in_flight),
+            **self.latency.percentiles_ms(),
+            "latency_samples_ms": self.latency.sample_ms(),
+            "slo_p95_ms": self.slo_ms,
+            "replicas": {
+                rid: {"status": self.membership.mark(rid),
+                      "served": replica_served.get(rid, 0),
+                      "startup_s": round(self._replicas[rid].startup_s, 3),
+                      "restarts": self._replicas[rid].restarts}
+                for rid in self.replica_ids},
+            "cold_startup_s": (round(self.cold_startup_s, 3)
+                               if self.cold_startup_s is not None
+                               else None),
+        }
+        if restarts:
+            summary["restarts"] = restarts
+        if self.spill_store is not None:
+            summary["spill_store"] = dict(self.spill_store.stats)
+        return summary
+
+    def close(self, timeout: float = 30.0) -> Dict:
+        """Drain in-flight work, close every live replica, write the
+        fleet summary (with the FATAL ``fleet-conservation`` incident
+        if the books don't balance), return it."""
+        with self._lock:
+            self._closed = True
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
+            with self._lock:
+                if not self._pending:
+                    break
+            time.sleep(0.01)
+        for rid in self.replica_ids:
+            rep = self._replicas[rid]
+            rep.heartbeat.stop()
+            if self.membership.mark(rid) != "dead":
+                try:
+                    rep.server.close()
+                except Exception:  # noqa: BLE001 — one replica's bad
+                    # shutdown must not eat the fleet summary
+                    logger.exception("fleet: replica %s close failed",
+                                     rid)
+        # anything STILL pending after the drain window is rejected
+        # typed (no silent drops at fleet shutdown either)
+        with self._lock:
+            leftovers = list(self._pending.values())
+        for pend in leftovers:
+            self._finish_rejected(pend, BadRequestError(
+                f"request {pend.fid} still in flight at fleet "
+                f"shutdown; rejected typed (no silent drops)"))
+        summary = self.fleet_summary()
+        if summary["unaccounted"]:
+            self._incident(
+                "fleet-conservation",
+                f"fleet request conservation violated at close: "
+                f"{summary['unaccounted']} request(s) unaccounted for "
+                f"(submitted != served + typed rejects) — a silent "
+                f"drop crossed the fleet", sample=False)
+        if self.ledger is not None:
+            try:
+                self.ledger.close(summary={"serving": summary})
+            except (ValueError, OSError):
+                logger.warning("fleet: final ledger close failed")
+        return summary

@@ -1,0 +1,332 @@
+"""Stream-affinity routing for the serving fleet.
+
+RAFT video serving is *stateful*: each stream's ``flow_init`` warm
+start lives on whichever replica served its last frame, so a fleet
+front door cannot spray requests round-robin — a stream must keep
+landing on the same replica while that replica is alive, and must move
+to exactly ONE new replica (not a reshuffle) when it dies.  That is
+the textbook consistent-hashing contract, and this module provides the
+three host-side pieces the fleet composition (fleet.py) routes with:
+
+- :class:`HashRing` — a deterministic consistent-hash ring (sha256
+  points, virtual nodes).  ``assign(stream)`` is stable across calls
+  and processes; removing a node moves only the streams that node
+  owned (``~1/N`` of them), which is what keeps a replica death a
+  bounded warm-state migration instead of a fleet-wide cold restart.
+- :class:`LocalKVStore` — an in-process implementation of the
+  jax.distributed coordination-service KV client surface
+  (``key_value_set`` / ``key_value_delete`` / ``key_value_dir_get`` /
+  ``blocking_key_value_get``), so :class:`~raft_tpu.parallel.elastic.
+  PodChannel` — the PR 7 pod-agreement protocol — runs UNCHANGED as
+  the fleet's membership/health transport.  A fleet of in-process
+  replicas (the CPU test/bench/chaos shape) and a fleet of real hosts
+  (the production shape, where the jax.distributed client backs the
+  same four methods) share one membership code path.
+- :class:`FleetMembership` — the live-replica view: every replica's
+  heartbeat thread ``put``\\ s its health snapshot through its own
+  PodChannel; the router reads ``poll("hb")`` and calls a replica live
+  iff its heartbeat is fresh AND healthy AND it is not explicitly
+  marked dead/draining (the kill/rolling-restart paths mark
+  synchronously — detection must not wait out a heartbeat interval
+  when the fleet itself did the killing).
+
+Routing policy (:class:`FleetRouter`): a request WITH a stream id goes
+to ``ring.assign(stream)`` over the live set; a stateless request goes
+to the live replica with the shallowest queue (pure load balancing —
+there is no state to keep together).  The router remembers each
+stream's last target so a changed assignment is a *detected* event
+(``fleet-reroute`` — the fleet ledgers it typed) rather than a silent
+move.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu.parallel.elastic import PodChannel
+
+logger = logging.getLogger(__name__)
+
+# A replica is dead when its last heartbeat is older than this many
+# heartbeat intervals (the membership view's staleness bound).  3x
+# tolerates one missed beat under scheduler jitter without calling a
+# healthy replica dead.
+HEARTBEAT_STALE_FACTOR = 3.0
+
+
+def _point(key: str) -> int:
+    """Deterministic 64-bit ring position (sha256 prefix — stable
+    across processes and Python hash randomization)."""
+    return int.from_bytes(
+        hashlib.sha256(key.encode("utf-8")).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over replica ids.
+
+    ``vnodes`` virtual points per node smooth the ownership split
+    (64 keeps the max/min stream share within ~2x at N=3).  The ring
+    is immutable; membership changes build a new one (``without``, or
+    the constructor with the grown node list) so a routing decision
+    never sees a half-updated ring.
+    """
+
+    def __init__(self, nodes: Sequence[str], vnodes: int = 64):
+        self.nodes: Tuple[str, ...] = tuple(sorted(set(nodes)))
+        self.vnodes = int(vnodes)
+        points: List[Tuple[int, str]] = []
+        for node in self.nodes:
+            for v in range(self.vnodes):
+                points.append((_point(f"{node}#{v}"), node))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [n for _, n in points]
+
+    def assign(self, key: str) -> str:
+        """The owning node for ``key`` (first ring point clockwise)."""
+        if not self.nodes:
+            raise ValueError("hash ring has no nodes")
+        i = bisect.bisect_right(self._points, _point(key))
+        if i == len(self._points):
+            i = 0
+        return self._owners[i]
+
+    def without(self, *nodes: str) -> "HashRing":
+        return HashRing([n for n in self.nodes if n not in nodes],
+                        vnodes=self.vnodes)
+
+
+class LocalKVStore:
+    """In-process stand-in for the jax.distributed coordination-service
+    KV client — the four methods :class:`PodChannel` calls, with the
+    same semantics (``set`` refuses overwrites with an ALREADY_EXISTS
+    error, ``dir_get`` is a prefix scan, ``blocking_key_value_get``
+    waits).  Lets the fleet reuse the PR 7 agreement protocol verbatim
+    when the replicas are threads of one process instead of hosts."""
+
+    def __init__(self):
+        self._store: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._changed = threading.Condition(self._lock)
+
+    def key_value_set(self, key: str, value: str) -> None:
+        with self._changed:
+            if key in self._store:
+                raise RuntimeError(f"ALREADY_EXISTS: {key}")
+            self._store[key] = str(value)
+            self._changed.notify_all()
+
+    def key_value_delete(self, key: str) -> None:
+        with self._changed:
+            self._store.pop(key, None)
+
+    def key_value_dir_get(self, prefix: str) -> List[Tuple[str, str]]:
+        with self._lock:
+            return [(k, v) for k, v in sorted(self._store.items())
+                    if k.startswith(prefix)]
+
+    def blocking_key_value_get(self, key: str, timeout_ms: int) -> str:
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._changed:
+            while key not in self._store:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"key {key} not posted within "
+                                       f"{timeout_ms}ms")
+                self._changed.wait(left)
+            return self._store[key]
+
+
+def fleet_channel(kv, replica_index: int, replica_count: int,
+                  namespace: str = "fleet") -> PodChannel:
+    """The PR 7 :class:`PodChannel` speaking for one fleet replica —
+    same protocol, the fleet namespace, any KV client (the in-process
+    :class:`LocalKVStore` or the real jax.distributed client)."""
+    return PodChannel(kv, replica_index, replica_count,
+                      namespace=namespace)
+
+
+class FleetMembership:
+    """The live-replica view the router reads.
+
+    Sources, in precedence order:
+
+    1. explicit marks (``mark_dead`` / ``mark_draining`` /
+       ``mark_live``) — the kill and rolling-restart choreography is
+       fleet-initiated, so detection is synchronous;
+    2. the heartbeat channel: each replica publishes
+       ``"<ok>:<monotonic>"`` through its PodChannel every
+       ``interval`` seconds; a stale or not-ok heartbeat makes the
+       replica not live (the crash-detection path for deaths the
+       fleet did NOT cause).
+    """
+
+    def __init__(self, channel: PodChannel, replica_ids: Sequence[str],
+                 interval: float = 0.2, clock=time.monotonic):
+        self.channel = channel
+        self.replica_ids: Tuple[str, ...] = tuple(replica_ids)
+        self.interval = float(interval)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # replica id -> "up" | "draining" | "dead"
+        self._marks: Dict[str, str] = {r: "up" for r in replica_ids}
+
+    def _index(self, rid: str) -> int:
+        return self.replica_ids.index(rid)
+
+    def mark_dead(self, rid: str) -> None:
+        with self._lock:
+            self._marks[rid] = "dead"
+
+    def mark_draining(self, rid: str) -> None:
+        with self._lock:
+            self._marks[rid] = "draining"
+
+    def mark_live(self, rid: str) -> None:
+        with self._lock:
+            self._marks[rid] = "up"
+
+    def mark(self, rid: str) -> str:
+        with self._lock:
+            return self._marks.get(rid, "dead")
+
+    def heartbeats(self) -> Dict[int, Tuple[bool, float]]:
+        """{replica index: (ok, age_seconds)} from the channel."""
+        out: Dict[int, Tuple[bool, float]] = {}
+        now = self._clock()
+        for pid, value in self.channel.poll("hb").items():
+            try:
+                ok_s, t_s = str(value).split(":", 1)
+                out[pid] = (ok_s == "1", now - float(t_s))
+            except ValueError:
+                out[pid] = (False, float("inf"))
+        return out
+
+    def live(self) -> List[str]:
+        """Replica ids that may receive NEW work right now: marked up,
+        with a fresh healthy heartbeat (or no heartbeat expected yet —
+        a replica that never beat is trusted until its first interval
+        elapses, so startup is not a routing dead zone)."""
+        hbs = self.heartbeats()
+        stale = HEARTBEAT_STALE_FACTOR * self.interval
+        out = []
+        for rid in self.replica_ids:
+            if self.mark(rid) != "up":
+                continue
+            hb = hbs.get(self._index(rid))
+            if hb is not None and (not hb[0] or hb[1] > stale):
+                continue
+            out.append(rid)
+        return out
+
+
+class ReplicaHeartbeat:
+    """Per-replica publisher thread: ``health_fn() -> bool`` becomes
+    ``"<ok>:<monotonic>"`` on the channel every ``interval`` seconds.
+    ``stop()`` both joins the thread and leaves the LAST beat in place
+    — a dead replica is detected by staleness, exactly like a host
+    that stopped beating."""
+
+    def __init__(self, channel: PodChannel, health_fn: Callable[[], bool],
+                 interval: float = 0.2, clock=time.monotonic):
+        self.channel = channel
+        self._health = health_fn
+        self.interval = float(interval)
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat_once(self) -> None:
+        ok = "1" if self._health() else "0"
+        self.channel.put("hb", f"{ok}:{self._clock():.4f}")
+
+    def start(self) -> None:
+        self.beat_once()               # membership sees us immediately
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"fleet-hb-{self.channel.process_index}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.beat_once()
+            except Exception as e:  # noqa: BLE001 — a heartbeat RPC
+                # failure must not kill the publisher thread; a replica
+                # that cannot beat goes STALE, which is the signal the
+                # membership view already acts on
+                logger.warning("fleet heartbeat %d: beat failed (%s: "
+                               "%s); membership will see staleness",
+                               self.channel.process_index,
+                               type(e).__name__, e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 4)
+            self._thread = None
+
+
+class FleetRouter:
+    """Stream-affinity routing decisions over the membership view.
+
+    ``route(stream, depths)``: streams ride the consistent-hash ring
+    over the LIVE replicas; stateless requests go to the shallowest
+    live queue.  The per-stream last-target memory (LRU-bounded, same
+    rationale as the server's warm-state LRU) turns an assignment
+    change into a reported reroute: ``route`` returns
+    ``(replica_id, moved_from)`` with ``moved_from`` non-None exactly
+    when a previously-routed stream changed owner."""
+
+    def __init__(self, membership: FleetMembership,
+                 vnodes: int = 64, max_streams: int = 4096):
+        import collections
+
+        self.membership = membership
+        self._vnodes = int(vnodes)
+        self._rings: Dict[Tuple[str, ...], HashRing] = {}
+        self._last: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self._max_streams = int(max_streams)
+        self._lock = threading.Lock()
+
+    def _ring(self, live: List[str]) -> HashRing:
+        key = tuple(sorted(live))
+        ring = self._rings.get(key)
+        if ring is None:
+            ring = HashRing(key, vnodes=self._vnodes)
+            self._rings[key] = ring
+        return ring
+
+    def route(self, stream: Optional[str],
+              depths: Dict[str, int],
+              workload: str = "flow") -> Tuple[str, Optional[str]]:
+        """(target replica id, moved_from).  Raises
+        :class:`NoReplicaError` when no replica is live."""
+        live = self.membership.live()
+        if not live:
+            raise NoReplicaError("no live replica in the fleet")
+        if stream is None:
+            target = min(live, key=lambda r: (depths.get(r, 0), r))
+            return target, None
+        target = self._ring(live).assign(f"{workload}/{stream}")
+        with self._lock:
+            key = f"{workload}/{stream}"
+            prev = self._last.get(key)
+            self._last[key] = target
+            self._last.move_to_end(key)
+            while len(self._last) > self._max_streams:
+                self._last.popitem(last=False)
+        moved_from = prev if prev is not None and prev != target else None
+        return target, moved_from
+
+
+class NoReplicaError(RuntimeError):
+    """Every replica is dead or draining — the fleet cannot place the
+    request anywhere; the front door converts this into a typed
+    rejection (never a hang or a silent drop)."""

@@ -34,8 +34,9 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from raft_tpu.serve.batcher import (BadRequestError, RequestError,
-                                    RequestQueue, assemble_batch)
+from raft_tpu.serve.batcher import (BadRequestError, DeadlineExceededError,
+                                    RequestError, RequestQueue,
+                                    assemble_batch)
 from raft_tpu.serve.degrade import (DEFAULT_ITER_LEVELS, IterationController,
                                     LatencyTracker)
 from raft_tpu.serve.watchdog import DispatchWatchdog
@@ -63,7 +64,10 @@ class FlowServer:
                  flush_every: int = 8,
                  max_streams: int = 256,
                  clock=time.monotonic,
-                 exit_fn=None):
+                 exit_fn=None,
+                 spill_store=None,
+                 continuous: bool = False,
+                 segment_iters: Optional[int] = None):
         from raft_tpu.obs.spans import NULL, SpanRecorder
         from raft_tpu.serve.engine import default_buckets
 
@@ -125,6 +129,26 @@ class FlowServer:
         self._streams: "collections.OrderedDict[str, np.ndarray]" = \
             collections.OrderedDict()
         self._max_streams = int(max_streams)
+        # fleet integration: the shared on-disk warm-state spill store
+        # (serve/fleet.py SpillStore, duck-typed: get/put over
+        # (workload, stream) keys).  _remember_stream writes THROUGH to
+        # it, so another replica can adopt this stream's warm state
+        # after a death or a drain; _warm_inits falls back to it when
+        # the local LRU misses (the verified warm-state adoption path).
+        self.spill_store = spill_store
+        # continuous batching: dispatch SEGMENTS of `segment_iters` GRU
+        # iterations through the warm executable (flow_low re-fed as
+        # flow_init) and admit new requests into freed/empty slots at
+        # every segment boundary, instead of holding a FIFO assembly
+        # barrier until a whole batch completes its full ladder depth.
+        self.continuous = bool(continuous)
+        if segment_iters is not None and int(segment_iters) < 1:
+            raise ValueError(f"segment_iters must be >= 1, "
+                             f"got {segment_iters}")
+        # default segment = the ladder's smallest level: the executable
+        # the degradation path already proves exists and warms
+        self._segment = int(segment_iters if segment_iters is not None
+                            else self.controller.levels[-1])
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._warm = False
@@ -138,8 +162,10 @@ class FlowServer:
                     kind, detail, sample=False),
                 on_trip=lambda kind: self._flush_ledger(), **kw)
             self.watchdog.start()
-        self._thread = threading.Thread(target=self._serve_loop,
-                                        daemon=True, name="serve-batcher")
+        self._thread = threading.Thread(
+            target=(self._serve_loop_continuous if self.continuous
+                    else self._serve_loop),
+            daemon=True, name="serve-batcher")
         self._thread.start()
 
     # -- telemetry -----------------------------------------------------------
@@ -192,8 +218,18 @@ class FlowServer:
         try:
             secs = 0.0
             for eng in self.engines.values():
-                secs += eng.warmup(fams, self.controller.levels,
-                                   warm_too=warm_too)
+                if self.continuous:
+                    # continuous batching dispatches ONLY warm-variant
+                    # segments (flow state re-fed each boundary), so
+                    # startup compiles exactly one executable per
+                    # family — none of the ladder's per-level variants
+                    t0 = time.perf_counter()
+                    for hw in fams.values():
+                        eng.executable(hw, self._segment, warm=True)
+                    secs += time.perf_counter() - t0
+                else:
+                    secs += eng.warmup(fams, self.controller.levels,
+                                       warm_too=warm_too)
         finally:
             if token is not None:
                 self.watchdog.done(token)
@@ -279,32 +315,66 @@ class FlowServer:
         output: flow streams forward-splat it (the paper's video warm
         start); 1-channel workloads (stereo disparity) reuse it as-is —
         disparity carries no transport field to splat along.  Zero for
-        cold slots (numerically the cold start).  Returns None when NO
-        slot is warm, so pure-cold batches use the cold executable.  A
+        cold slots (numerically the cold start).  Returns
+        ``(warm_init, warm_slots)`` with ``warm_init`` None when NO
+        slot is warm (pure-cold batches use the cold executable) and
+        ``warm_slots`` the slot indices that actually GOT warm state —
+        the per-slot truth the result's ``warm`` flag reports (a cold
+        stream batched next to a warm neighbor is still cold).  A
         stream whose stored state came from a DIFFERENT bucket family
         (the client changed frame size mid-stream) is dropped and
         cold-starts — a shape-mismatched warm init must never kill the
         batcher."""
-        from raft_tpu.ops import forward_interpolate
-
         H, W = hw
         B = engine.batch_size
         wc = getattr(engine, "warm_channels", 2)
         any_warm = False
+        warm_slots = set()
         warm_init = np.zeros((B, H // 8, W // 8, wc), np.float32)
         for i, req in enumerate(kept):
             if req is None or req.stream is None:
                 continue
-            prev = self._streams.get((req.workload, req.stream))
-            if prev is None:
+            warm = self._warm_state((req.workload, req.stream), hw, wc)
+            if warm is None:
                 continue
-            if prev.shape != (H // 8, W // 8, wc):
-                self._streams.pop((req.workload, req.stream), None)
-                continue
-            warm_init[i] = (forward_interpolate(prev) if wc == 2
-                            else prev)
+            warm_init[i] = warm
             any_warm = True
-        return warm_init if any_warm else None
+            warm_slots.add(i)
+        return (warm_init if any_warm else None), warm_slots
+
+    def _warm_state(self, key, hw, wc: int) -> Optional[np.ndarray]:
+        """ONE stream's warm-start init ((H/8, W/8, wc) splatted state)
+        or None when it is cold — the single-key lookup both batchers
+        share (the continuous admission path calls this per joiner; a
+        full-batch assembly would allocate and scan B slots to warm
+        one)."""
+        from raft_tpu.ops import forward_interpolate
+
+        H, W = hw
+        prev = self._streams.get(key)
+        if prev is None and self.spill_store is not None:
+            # fleet adoption: this stream last ran on ANOTHER
+            # replica (death, drain, or ring move) and spilled its
+            # warm state through the shared store — a verified load
+            # continues the video warm-start chain; a miss or a
+            # corrupt entry is the typed re-cold-start (the store
+            # fires fleet-cold-start itself on corruption)
+            prev = self.spill_store.get(key)
+            if prev is not None and prev.shape == (H // 8, W // 8, wc):
+                self._streams[key] = prev
+                self._streams.move_to_end(key)
+                self._incident(
+                    "fleet-warm-adopt",
+                    f"stream {key[0]}/{key[1]} warm state "
+                    f"adopted from the spill store (verified); video "
+                    f"warm-start chain continues across the replica "
+                    f"change")
+        if prev is None:
+            return None
+        if prev.shape != (H // 8, W // 8, wc):
+            self._streams.pop(key, None)
+            return None
+        return forward_interpolate(prev) if wc == 2 else prev
 
     def _remember_stream(self, key, low: np.ndarray) -> None:
         """``key`` is (workload, stream id): two workloads' client
@@ -313,6 +383,16 @@ class FlowServer:
         self._streams.move_to_end(key)
         while len(self._streams) > self._max_streams:
             self._streams.popitem(last=False)
+        if self.spill_store is not None:
+            try:
+                self.spill_store.put(key, low)
+            except OSError:
+                # a full/unwritable spill disk costs only the WARM
+                # adoption after a future replica change (that stream
+                # re-cold-starts typed); it must never fail the request
+                logger.warning("serve: spill of stream %s/%s failed; "
+                               "a replica change will cold-start it",
+                               key[0], key[1])
 
     def _serve_loop(self) -> None:
         B = self.engine.batch_size
@@ -346,7 +426,14 @@ class FlowServer:
                     logger.warning("serve: span flush failed at batch "
                                    "%d; continuing", self._batch_no)
 
-    def _process_batch(self, reqs, B: int) -> None:
+    def _admit_assemble(self, reqs, B: int):
+        """The admission prologue BOTH batcher modes share: assemble
+        the padded batch (typed deadline/poison rejections routed),
+        take the controller's iteration decision under the current
+        pressure (which includes the just-popped batch: with max_batch
+        close to capacity the post-pop depth alone could never reach
+        the high watermark even at saturation), and build the per-slot
+        warm inits.  Returns None when nothing survived admission."""
         workload = reqs[0].workload
         family = reqs[0].family
         engine = self.engines[workload]
@@ -360,24 +447,36 @@ class FlowServer:
                          if err.kind == "deadline-exceeded"
                          else "rejected_bad_request")
         if not any(r is not None for r in kept):
-            self.spans.step_boundary()
-            return
-
-        # pressure signal includes the just-popped batch: with
-        # max_batch close to capacity the post-pop depth alone
-        # could never reach the high watermark even at saturation
+            return None
         frac = min(1.0, (len(self.queue) + len(reqs))
                    / self.queue.capacity)
         iters = self.controller.observe(frac,
                                         self.latency.rolling_p95_ms())
-        flow_init = self._warm_inits(kept, hw, engine)
+        warm_init, warm_slots = self._warm_inits(kept, hw, engine)
+        return {"workload": workload, "family": family,
+                "engine": engine, "hw": hw, "img1": img1, "img2": img2,
+                "kept": kept, "iters": iters, "warm_init": warm_init,
+                "warm_slots": warm_slots}
+
+    def _process_batch(self, reqs, B: int) -> None:
+        adm = self._admit_assemble(reqs, B)
+        if adm is None:
+            self.spans.step_boundary()
+            return
+        workload, family = adm["workload"], adm["family"]
+        engine, hw = adm["engine"], adm["hw"]
+        img1, img2, kept = adm["img1"], adm["img2"], adm["kept"]
+        iters, flow_init = adm["iters"], adm["warm_init"]
+        warm_slots = adm["warm_slots"]
         if flow_init is not None and self.warm_iters is not None \
-                and all(r is None
-                        or ((r.workload, r.stream) in self._streams)
-                        for r in kept):
+                and all(r is None or i in warm_slots
+                        for i, r in enumerate(kept)):
             # fully-warm video batch: flow_init starts the GRU at
             # last frame's solution, so the flat region extends
-            # further down the ladder
+            # further down the ladder.  The FIFO batch runs ONE
+            # iteration count for every slot, so the clamp applies
+            # only when ALL slots are warm (continuous mode clamps
+            # per-slot — each slot carries its own budget there).
             iters = min(iters, self.warm_iters)
 
         token = None
@@ -431,12 +530,284 @@ class FlowServer:
                     {"flow": flow_up[i, :h, :w, :],
                      "flow_low": flow_low[i],
                      "iters": iters,
-                     "warm": (flow_init is not None
-                              and req.stream is not None)})
+                     # per-SLOT truth: a cold stream batched next to a
+                     # warm neighbor did NOT warm-start
+                     "warm": i in warm_slots})
         with self._lock:
             if fam_label in self._family_counts:
                 self._family_counts[fam_label]["batches"] += 1
         self.spans.step_boundary()
+
+    # -- continuous batching -------------------------------------------------
+    #
+    # The FIFO batcher above holds an ASSEMBLY BARRIER: a batch's slots
+    # are fixed at pop time and ride together for the full iteration
+    # depth, so a request arriving one instant after assembly waits out
+    # an entire 32-iteration dispatch even when the batch has empty
+    # slots.  The GRU refinement loop has natural yield points — the
+    # iteration boundaries — and the warm executable (flow_init) makes
+    # them schedulable: running `segment_iters` at a time and re-feeding
+    # flow_low as the next segment's flow_init is exactly the paper's
+    # video warm-start semantics applied WITHIN one request.  At every
+    # boundary, freed/empty slots admit new requests from the same
+    # (workload, family) lane.  Slot contents are independent within
+    # one executable (the PR 10 poison-isolation proof), so admitting a
+    # joiner leaves every other slot's outputs BIT-identical to the
+    # unjoined run — test-pinned in tests/test_fleet.py.
+
+    def _begin_inflight(self, reqs, B: int):
+        """Assemble the first segment's batch; returns the in-flight
+        state dict or None when nothing survived admission checks.
+        Slot iteration budgets round UP to whole segments (a level of
+        6 at segment_iters=4 runs 8) — the executed count is what the
+        result's ``iters`` reports."""
+        adm = self._admit_assemble(reqs, B)
+        if adm is None:
+            return None
+        engine, hw = adm["engine"], adm["hw"]
+        kept, iters = adm["kept"], adm["iters"]
+        warm_slots = adm["warm_slots"]
+        H, W = hw
+        wc = getattr(engine, "warm_channels", 2)
+        flow = adm["warm_init"]
+        if flow is None:
+            flow = np.zeros((B, H // 8, W // 8, wc), np.float32)
+        remaining = [0] * B
+        for i, r in enumerate(kept):
+            if r is not None:
+                t = iters
+                if self.warm_iters is not None and i in warm_slots:
+                    t = min(t, self.warm_iters)
+                remaining[i] = t
+        return {"lane": (adm["workload"], adm["family"]),
+                "engine": engine, "hw": hw,
+                "img1": adm["img1"], "img2": adm["img2"], "flow": flow,
+                "slots": kept, "remaining": remaining,
+                "warm": warm_slots, "segments": [0] * B}
+
+    def _admit_inflight(self, state, free) -> None:
+        """Fill free slots from the in-flight lane's queue at a segment
+        boundary — the continuous-batching admission.  A request popped
+        here MUST reach a terminal state (seated or typed reject): an
+        unseated pop is a silent drop, the exact conservation violation
+        this layer exists to kill."""
+        from raft_tpu.serve.batcher import slot_is_finite
+        from raft_tpu.serve.engine import pad_to_bucket
+
+        reqs = self.queue.pop_lane(state["lane"], len(free))
+        if not reqs:
+            return
+        # the admission boundary is the continuous-mode analogue of the
+        # FIFO path's batch assembly: under sustained traffic the
+        # in-flight batch never empties, so without this observe() the
+        # degradation controller would freeze at whatever level the
+        # FIRST assembly saw, no matter how far queue pressure or p95
+        # drift afterwards
+        frac = min(1.0, (len(self.queue) + len(reqs))
+                   / self.queue.capacity)
+        iters = self.controller.observe(frac,
+                                        self.latency.rolling_p95_ms())
+        hw = state["hw"]
+        engine = state["engine"]
+        wc = getattr(engine, "warm_channels", 2)
+        now = self._clock()
+        it = iter(free)
+        for req in reqs:
+            i = None
+            try:
+                if req.deadline is not None and now > req.deadline:
+                    self._reject(req, DeadlineExceededError(
+                        f"request {req.rid} expired before joining the "
+                        f"in-flight batch (deadline-aware shed at the "
+                        f"iteration boundary)"), "rejected_deadline")
+                    continue
+                if not slot_is_finite(req):
+                    self._reject(req, BadRequestError(
+                        f"request {req.rid} carries non-finite input "
+                        f"pixels; rejected at the iteration boundary — "
+                        f"its slot stays zero, neighbors unaffected"),
+                        "rejected_bad_request")
+                    continue
+                i = next(it)
+                state["img1"][i] = pad_to_bucket(
+                    req.image1.astype(np.float32), hw)
+                state["img2"][i] = pad_to_bucket(
+                    req.image2.astype(np.float32), hw)
+                # the joiner's warm start: its stream's spilled or
+                # remembered state when available, zeros (cold) otherwise
+                state["flow"][i] = 0.0
+                if req.stream is not None:
+                    warm = self._warm_state((req.workload, req.stream),
+                                            hw, wc)
+                    if warm is not None:
+                        state["flow"][i] = warm
+                        state["warm"].add(i)
+                t = iters
+                if self.warm_iters is not None and i in state["warm"]:
+                    t = min(t, self.warm_iters)
+                state["slots"][i] = req
+                state["remaining"][i] = t
+                state["segments"][i] = 0
+            except Exception as e:  # noqa: BLE001 — a failed seat
+                # rejects THAT request typed and restores its slot to
+                # the empty-pad contract (zero images, zero flow); the
+                # remaining popped requests still get their admission
+                logger.exception("serve: continuous admission of %s "
+                                 "failed", req.rid)
+                if i is not None:
+                    state["img1"][i] = 0.0
+                    state["img2"][i] = 0.0
+                    state["flow"][i] = 0.0
+                    state["warm"].discard(i)
+                    state["slots"][i] = None
+                self._reject(req, BadRequestError(
+                    f"request {req.rid} failed continuous admission "
+                    f"({type(e).__name__}: {e})"), "rejected_bad_request")
+
+    def _dispatch_segment(self, state) -> None:
+        """Run ONE `segment_iters` segment of the in-flight batch and
+        complete the slots whose iteration budget is spent."""
+        engine = state["engine"]
+        hw = state["hw"]
+        seg = self._segment
+        token = None
+        if self.watchdog is not None:
+            lazy = not engine.is_compiled(hw, seg, warm=True)
+            token = self.watchdog.begin(
+                f"continuous segment batch {self._batch_no} "
+                f"lane={state['lane']} seg={seg}"
+                + (" +compile" if lazy else ""), slow=lazy)
+        try:
+            flow_low, flow_up = engine.forward(
+                hw, seg, state["img1"], state["img2"],
+                flow_init=state["flow"])
+        except Exception as e:  # noqa: BLE001 — a dispatch failure
+            # rejects ITS slots typed, never kills the batcher
+            if token is not None:
+                self.watchdog.done(token)
+            err = BadRequestError(
+                f"continuous dispatch failed ({type(e).__name__}: {e})")
+            for i, req in enumerate(state["slots"]):
+                if req is not None:
+                    self._reject(req, err, "rejected_bad_request")
+                    state["slots"][i] = None
+            return
+        if token is not None:
+            self.watchdog.done(token)
+        state["flow"] = np.asarray(flow_low).copy()
+        now = self._clock()
+        for i, req in enumerate(state["slots"]):
+            if req is None:
+                continue
+            state["remaining"][i] -= seg
+            state["segments"][i] += 1
+            if state["remaining"][i] > 0:
+                continue
+            # slot complete: deliver, remember the stream, free it
+            h, w = req.hw
+            fam_label = f"{req.workload}/{state['lane'][1]}"
+            flow_low_i = state["flow"][i].copy()
+            if req.stream is not None:
+                self._remember_stream((req.workload, req.stream),
+                                      flow_low_i)
+            with self._lock:
+                self.counters["served"] += 1
+                self.counters["batches"] = self._batch_no
+                fc = self._family_counts.setdefault(
+                    fam_label, {"served": 0, "batches": 0})
+                fc["served"] += 1
+                fc["batches"] += 1
+            self.latency.add(now - req.t_submit)
+            self._family_latency.setdefault(
+                fam_label, LatencyTracker()).add(now - req.t_submit)
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(
+                    {"flow": np.asarray(flow_up)[i, :h, :w, :],
+                     "flow_low": flow_low_i,
+                     # the EXECUTED count: budgets round up to whole
+                     # segments, and reporting the smaller requested
+                     # number would misattribute the latency paid
+                     "iters": state["segments"][i] * seg,
+                     "segments": state["segments"][i],
+                     "warm": i in state["warm"]})
+            state["slots"][i] = None
+            state["warm"].discard(i)
+            # freed slot back to the empty-pad shape: zero images and
+            # zero flow state, exactly what an unjoined run carries
+            state["img1"][i] = 0.0
+            state["img2"][i] = 0.0
+            state["flow"][i] = 0.0
+
+    def _serve_loop_continuous(self) -> None:
+        B = self.engine.batch_size
+        state = None
+        while True:
+            if state is None:
+                if self._stop.is_set():
+                    return
+                with self.spans.span("queue"):
+                    reqs = self.queue.pop_batch(B, timeout=0.05)
+                if not reqs:
+                    continue
+                self._batch_no += 1
+                try:
+                    state = self._begin_inflight(reqs, B)
+                except Exception as e:  # noqa: BLE001 — survive any
+                    # per-batch failure (see _serve_loop)
+                    logger.exception("serve: continuous batch %d "
+                                     "assembly failed", self._batch_no)
+                    err = BadRequestError(
+                        f"batch {self._batch_no} assembly failed "
+                        f"({type(e).__name__}: {e})")
+                    for req in reqs:
+                        if not req.future.done():
+                            self._reject(req, err,
+                                         "rejected_bad_request")
+                    state = None
+                if state is None:
+                    continue
+            elif not self._stop.is_set():
+                free = [i for i, s in enumerate(state["slots"])
+                        if s is None]
+                # fairness: while ANOTHER (workload, family) lane has
+                # queued work, stop admitting same-lane joiners and let
+                # the in-flight batch DRAIN — admission-only-from-own-
+                # lane would otherwise starve every other lane forever
+                # under sustained traffic (the drained batch frees the
+                # executable within the slots' remaining segment
+                # budgets, then pop_batch serves the oldest lane head)
+                if free and not self.queue.other_lane_waiting(
+                        state["lane"]):
+                    try:
+                        self._admit_inflight(state, free)
+                    except Exception:  # noqa: BLE001 — a failed
+                        # admission must not kill the in-flight batch
+                        logger.exception("serve: continuous admission "
+                                         "failed; continuing in-flight")
+                self._batch_no += 1
+            try:
+                self._dispatch_segment(state)
+            except Exception as e:  # noqa: BLE001 — reject the batch
+                # typed and drop it; the loop itself must survive
+                logger.exception("serve: continuous segment %d failed",
+                                 self._batch_no)
+                err = BadRequestError(
+                    f"segment {self._batch_no} failed "
+                    f"({type(e).__name__}: {e})")
+                for i, req in enumerate(state["slots"]):
+                    if req is not None and not req.future.done():
+                        self._reject(req, err, "rejected_bad_request")
+                state = None
+                continue
+            if not any(s is not None for s in state["slots"]):
+                state = None
+                self.spans.step_boundary()
+            if self._batch_no % self._flush_every == 0:
+                try:
+                    self.spans.flush(self._batch_no)
+                except (ValueError, OSError):
+                    logger.warning("serve: span flush failed at batch "
+                                   "%d; continuing", self._batch_no)
 
     # -- shutdown ------------------------------------------------------------
 
@@ -454,6 +825,11 @@ class FlowServer:
             "unaccounted": counters["submitted"] - counters["served"]
                            - rejected,
             **self.latency.percentiles_ms(),
+            # bounded quantile sketch of the latency reservoir: the
+            # fleet merge path (obs report --merge) pools these across
+            # replicas to compute a genuine fleet-wide p95 — summed
+            # counters cannot recover a percentile
+            "latency_samples_ms": self.latency.sample_ms(),
             "slo_p95_ms": self.slo_ms,
             "degradation": self.controller.summary(),
         }
@@ -473,6 +849,24 @@ class FlowServer:
         if self.engine.aot is not None:
             summary["aot_cache"] = dict(self.engine.aot.stats)
         return summary
+
+    def kill(self, timeout: float = 60.0):
+        """Crash-style stop — the fleet's kill-a-replica path.
+
+        Unlike :meth:`close`, nothing waits for the queue to drain and
+        no summary/run_end is written (a real crash writes nothing):
+        the batcher stops after its in-flight work, the watchdog is
+        disarmed, and everything still QUEUED is returned to the caller
+        — the fleet front door re-routes those requests to surviving
+        replicas (the typed rescue), so a replica death is never a
+        silent drop.  The returned requests remain un-rejected here:
+        their terminal outcome is the FLEET's to decide.
+        """
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        return self.queue.drain()
 
     def close(self, timeout: float = 10.0) -> Dict:
         """Stop the batcher, reject everything still queued (typed),

@@ -1,0 +1,237 @@
+"""Tiled high-resolution (4K) inference through the bucketed batcher.
+
+A 2160x3840 frame does not fit any serving bucket family — and should
+not: a single 4K executable would monopolize device memory for a shape
+almost no request carries.  Instead, the frame is cut into overlapping
+tiles of ONE static tile family, each tile rides the existing
+queue -> batcher -> AOT executor path as an ordinary request (batched
+with other tiles and with unrelated traffic of the same family), and
+the per-tile flows are blended back with feathered seams:
+
+- **Tiling**: a fixed grid with ``overlap`` pixels of shared context
+  between neighbors; the last row/column is anchored to the frame edge
+  so every pixel is covered by at least one tile and tiles never pad
+  (:func:`plan_tiles`).  Optical flow is resolution-local, so a tile's
+  flow needs no rescaling — only vectors that leave the tile lose
+  their match, which is why the overlap must exceed the expected
+  displacement magnitude and the blend discounts tile borders.
+- **Blending**: per-tile weights ramp linearly from 0 at any edge that
+  has a neighboring tile to 1 inside the core (:func:`tile_weights` —
+  a separable feather), and the accumulated weight map normalizes the
+  sum, so seams are C0-continuous and every pixel's weights sum to
+  exactly 1 (:func:`blend_tiles` divides by the accumulated map).
+  Frame edges keep full weight — there is no second opinion there.
+- **Serving**: :func:`submit_tiled` fans the tiles into
+  ``server.submit`` (one future per tile) and returns a combined
+  future; the tiles are independent requests, so deadline sheds and
+  poison isolation apply per tile and a typed per-tile rejection
+  fails the whole frame typed (never a silently half-blended flow).
+
+``abstract_tiled_forward`` is the registered lowerable entry point
+(``tiled_serve_forward`` in ``raft_tpu/entrypoints.py``): the serving
+forward at the TILE family's static shape, so the tile executable is
+audited, budgeted and cache-warmed like every other graph.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# The default 4K tile family: /8-divisible, covers 2160x3840 in a 5x5
+# grid at 64 px overlap.  Small enough that the executable's footprint
+# stays in the same class as the video families, big enough that the
+# 64-px feather is context, not the whole tile.
+DEFAULT_TILE_HW = (544, 960)
+DEFAULT_OVERLAP = 64
+TILE_FAMILY = "tile4k"
+
+
+def tiled_buckets(tile_hw: Tuple[int, int] = DEFAULT_TILE_HW,
+                  base: Optional[Dict] = None) -> Dict[str,
+                                                       Tuple[int, int]]:
+    """The bucket table with the tile family added — what a
+    tiled-serving FlowServer is constructed with."""
+    from raft_tpu.serve.engine import default_buckets
+
+    out = dict(base if base is not None else default_buckets())
+    out[TILE_FAMILY] = tuple(tile_hw)
+    return out
+
+
+def plan_tiles(hw: Tuple[int, int], tile_hw: Tuple[int, int],
+               overlap: int) -> List[Tuple[int, int]]:
+    """Top-left (y, x) offsets of a covering tile grid.
+
+    Stride is ``tile - overlap``; the final row/column snaps to the
+    frame edge (so the last overlap may be larger, never smaller, and
+    no tile hangs off the frame).  A frame no larger than one tile is
+    a single tile at the origin."""
+    H, W = hw
+    th, tw = tile_hw
+    if overlap < 0 or overlap >= min(th, tw):
+        raise ValueError(f"overlap {overlap} must be in [0, "
+                         f"min{tile_hw}) — a tile must advance")
+    if th > H or tw > W:
+        raise ValueError(f"tile {tile_hw} exceeds the frame {hw}; "
+                         f"serve the frame as an ordinary request")
+
+    def starts(total: int, tile: int) -> List[int]:
+        if total <= tile:
+            return [0]
+        stride = tile - overlap
+        out = list(range(0, total - tile, stride))
+        out.append(total - tile)       # snap the last tile to the edge
+        return out
+
+    return [(y, x) for y in starts(H, th) for x in starts(W, tw)]
+
+
+def tile_weights(hw: Tuple[int, int], tile_hw: Tuple[int, int],
+                 origin: Tuple[int, int], overlap: int) -> np.ndarray:
+    """(th, tw) feather weights for the tile at ``origin``: a linear
+    ramp over the first/last ``overlap`` rows/cols on every side that
+    has a neighboring tile, full weight elsewhere (frame edges)."""
+    H, W = hw
+    th, tw = tile_hw
+    y, x = origin
+
+    def axis(n: int, lo_ramp: bool, hi_ramp: bool) -> np.ndarray:
+        # min-composed profiles, NOT in-place slice writes: when
+        # overlap > n/2 the lo and hi ramps share indices, and a slice
+        # write would let one overwrite the other mid-ramp — a weight
+        # discontinuity at index n-overlap that breaks the C0 seam
+        # contract.  min() of the two ramps is identical for
+        # overlap <= n/2 and stays continuous for any overlap < n.
+        w = np.ones(n, np.float32)
+        if overlap > 0:
+            idx = np.arange(n, dtype=np.float32)
+            if lo_ramp:
+                w = np.minimum(w, (idx + 1.0) / (overlap + 1))
+            if hi_ramp:
+                w = np.minimum(w, (n - idx) / (overlap + 1))
+        return w
+
+    wy = axis(th, lo_ramp=y > 0, hi_ramp=y + th < H)
+    wx = axis(tw, lo_ramp=x > 0, hi_ramp=x + tw < W)
+    return wy[:, None] * wx[None, :]
+
+
+def blend_tiles(hw: Tuple[int, int], tile_hw: Tuple[int, int],
+                plan: List[Tuple[int, int]], overlap: int,
+                tile_flows: List[np.ndarray]) -> np.ndarray:
+    """Feather-blend per-tile (th, tw, C) outputs into one (H, W, C)
+    field.  Weights are normalized by the accumulated map, so they sum
+    to exactly 1 everywhere regardless of how many tiles overlap."""
+    H, W = hw
+    th, tw = tile_hw
+    C = tile_flows[0].shape[-1]
+    acc = np.zeros((H, W, C), np.float32)
+    wsum = np.zeros((H, W, 1), np.float32)
+    for (y, x), flow in zip(plan, tile_flows):
+        w = tile_weights(hw, tile_hw, (y, x), overlap)[..., None]
+        acc[y:y + th, x:x + tw] += w * flow.astype(np.float32)
+        wsum[y:y + th, x:x + tw] += w
+    return acc / wsum
+
+
+def submit_tiled(server, image1: np.ndarray, image2: np.ndarray,
+                 tile_hw: Tuple[int, int] = DEFAULT_TILE_HW,
+                 overlap: int = DEFAULT_OVERLAP,
+                 deadline_ms: Optional[float] = None,
+                 workload: str = "flow") -> Future:
+    """Fan one high-res pair into tile requests and return a future
+    for the blended full-res flow.
+
+    Each tile is an ordinary admitted request (typed admission,
+    deadline, poison isolation all apply per tile); any tile's typed
+    rejection rejects the FRAME's future with that same error — a
+    partially-served frame is never silently blended.  The result dict
+    carries ``flow`` (H, W, 2 blended), ``tiles`` (the tile count) and
+    ``iters`` (of the first tile — all tiles ride the same ladder)."""
+    hw = image1.shape[:2]
+    plan = plan_tiles(hw, tile_hw, overlap)
+    th, tw = tile_hw
+    futures = []
+    out: Future = Future()
+    for (y, x) in plan:
+        t1 = np.ascontiguousarray(image1[y:y + th, x:x + tw])
+        t2 = np.ascontiguousarray(image2[y:y + th, x:x + tw])
+        try:
+            futures.append(server.submit(t1, t2, deadline_ms=deadline_ms,
+                                         workload=workload))
+        except Exception as e:  # typed admission rejection of a tile
+            # rejects the frame with the SAME typed error
+            for f in futures:
+                f.cancel()
+            out.set_exception(e)
+            return out
+    remaining = [len(futures)]
+    lock = threading.Lock()
+    results: List[Optional[Dict]] = [None] * len(futures)
+
+    def blend_and_resolve() -> None:
+        try:
+            flows = [r["flow"] for r in results]
+            blended = blend_tiles(hw, tile_hw, plan, overlap, flows)
+            out.set_result({"flow": blended, "tiles": len(plan),
+                            "iters": results[0]["iters"]})
+        except Exception as e:  # noqa: BLE001 — a blend failure
+            # rejects the frame; it must never pass silently
+            out.set_exception(e)
+
+    def finish(i: int, f) -> None:
+        exc = f.exception()
+        with lock:
+            if out.done():
+                return
+            if exc is not None:
+                out.set_exception(exc)
+                return
+            results[i] = f.result()
+            remaining[0] -= 1
+            if remaining[0]:
+                return
+        # the last tile's done-callback runs ON the server's batcher
+        # thread; a 4K feather blend there (tens of ms of numpy over
+        # ~66 MB of accumulators) would stall every co-tenant batch,
+        # inflating the exact p95 the SLO gate measures — hand it off
+        threading.Thread(target=blend_and_resolve, daemon=True,
+                         name="tiled-blend").start()
+
+    for i, f in enumerate(futures):
+        f.add_done_callback(lambda fut, i=i: finish(i, fut))
+    return out
+
+
+def infer_tiled(server, image1: np.ndarray, image2: np.ndarray,
+                tile_hw: Tuple[int, int] = DEFAULT_TILE_HW,
+                overlap: int = DEFAULT_OVERLAP,
+                deadline_ms: Optional[float] = None,
+                workload: str = "flow",
+                timeout: float = 600.0) -> Dict:
+    """Blocking form of :func:`submit_tiled`."""
+    return submit_tiled(server, image1, image2, tile_hw=tile_hw,
+                        overlap=overlap, deadline_ms=deadline_ms,
+                        workload=workload).result(timeout=timeout)
+
+
+def abstract_tiled_forward(iters: int = 2,
+                           tile_hw: Tuple[int, int] = (128, 224),
+                           batch: int = 2,
+                           overrides: Optional[Dict] = None):
+    """The tile family's lowerable serving graph — the serve forward at
+    the tile's static shape (tiles are ordinary requests of the tile
+    bucket family; there is no separate tiled model).  Registered as
+    ``tiled_serve_forward`` so the tile executable is audited,
+    budgeted and coverage-checked like every family the fleet compiles.
+    The audit shape is a reduced tile (/8-divisible, same aspect class
+    as :data:`DEFAULT_TILE_HW`) to keep engine compile cost bounded;
+    the structure is shape-independent."""
+    from raft_tpu.serve.engine import abstract_serve_forward
+
+    return abstract_serve_forward(iters=iters, hw=tuple(tile_hw),
+                                  batch=batch, overrides=overrides)

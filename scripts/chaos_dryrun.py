@@ -78,6 +78,18 @@ through ``obs report --fail-on-incident fatal``:
 - ``serve-stall``        the first dispatch wedges forever -> the
                          dispatch watchdog exits 14 with a typed
                          ``serve-stalled``; the fatal gate trips
+- ``serve-kill-one-replica`` a 3-replica FLEET session
+                         (--fleet, serve/fleet.py) loses its busiest
+                         replica mid-load -> queued work re-places
+                         typed on survivors, streams re-route via the
+                         ring and ADOPT their spilled warm state,
+                         fleet-wide conservation holds
+- ``serve-rolling-restart`` a 3-replica fleet rolls every replica
+                         (drain -> close -> warm AOT restore -> rejoin)
+                         WHILE the load runs -> zero shed beyond typed
+                         admission, every restart's warm restore < 50%
+                         of the cold startup, fleet p95 within 1.25x
+                         of steady state
 
 This is the scripted, runnable form of the resilience acceptance
 criteria; tests/test_resilience.py runs the cheap unit half in tier-1,
@@ -361,7 +373,10 @@ def run_serve(workdir, name, extra, env, phase="run", timeout=600):
             except json.JSONDecodeError:
                 continue
             startup = rec.get("serve_startup", startup)
-            summary = rec.get("serve_summary", summary)
+            # fleet sessions print fleet_summary instead; either way
+            # the caller gets THE summary dict of the session
+            summary = rec.get("serve_summary",
+                              rec.get("fleet_summary", summary))
     return proc.returncode, startup, summary, proc.stdout[-4000:]
 
 
@@ -374,7 +389,8 @@ def serve_main(args, env, workdir):
 
     all_names = ("serve-overload", "serve-deadline-storm", "serve-poison",
                  "serve-mixed-family", "serve-kill-restart-warm",
-                 "serve-stall")
+                 "serve-stall", "serve-kill-one-replica",
+                 "serve-rolling-restart")
     if args.only and args.only not in all_names:
         print(f"unknown serve scenario {args.only!r} "
               f"(known: {', '.join(all_names)})")
@@ -526,6 +542,75 @@ def serve_main(args, env, workdir):
                 fail = f"torn-cache restart did not serve cleanly ({summary})"
         finish(name, {"serve-cache-corrupt"}, False, fail,
                [ledger(name, p) for p in ("cold", "warm", "torn")])
+
+    # -- fleet: kill the busiest replica mid-load — queued work
+    # re-places typed on survivors, streams re-route and adopt spilled
+    # warm state, fleet-wide conservation holds (submitted == served +
+    # typed rejects + 0)
+    if want("serve-kill-one-replica"):
+        name, fail = "serve-kill-one-replica", None
+        rc, _, summary, tail = run_serve(
+            workdir, name,
+            ["--fleet", "3", "--requests", "24", "--batch_size", "2",
+             "--queue_capacity", "16", "--iter_levels", "4,2",
+             "--video_streams", "6", "--inject", "kill-replica@8"],
+            env)
+        if rc != 0:
+            fail = f"exit {rc} != 0\n{tail}"
+        elif summary is None or summary["unaccounted"] != 0:
+            fail = (f"fleet silent drops: "
+                    f"{summary and summary['unaccounted']}")
+        elif summary["served"] + summary["rejected_total"] != 24:
+            fail = (f"conservation books wrong: served "
+                    f"{summary['served']} + rejected "
+                    f"{summary['rejected_total']} != 24")
+        elif sum(1 for r in summary["replicas"].values()
+                 if r["status"] == "dead") != 1:
+            fail = f"expected exactly one dead replica ({summary['replicas']})"
+        elif not summary["stream_moves"]:
+            fail = "no stream re-routed off the dead replica"
+        finish(name, {"fleet-replica-lost", "fleet-reroute",
+                      "fleet-warm-adopt"}, False, fail,
+               [ledger(name, "run")]
+               + [ledger(name, "run") + f".p{i}" for i in range(3)])
+
+    # -- fleet: zero-downtime rolling restart under load — every
+    # restart restores WARM from the shared AOT cache (< 50% of cold,
+    # measured), nothing is shed beyond typed admission, and the
+    # client-measured p95 stays within 1.25x of steady state
+    if want("serve-rolling-restart"):
+        name, fail = "serve-rolling-restart", None
+        rc, _, summary, tail = run_serve(
+            workdir, name,
+            ["--fleet", "3", "--requests", "32", "--batch_size", "2",
+             "--queue_capacity", "16", "--iter_levels", "4,2",
+             "--continuous", "--video_streams", "4",
+             "--inject", "rolling-restart@8"],
+            env)
+        restarts = (summary or {}).get("restarts") or []
+        if rc != 0:
+            fail = f"exit {rc} != 0\n{tail}"
+        elif summary is None or summary["unaccounted"] != 0:
+            fail = (f"fleet silent drops: "
+                    f"{summary and summary['unaccounted']}")
+        elif summary["rejected_total"] != 0:
+            fail = (f"{summary['rejected_total']} request(s) shed "
+                    f"during an unloaded roll (zero-downtime violated)")
+        elif len(restarts) != 3:
+            fail = f"expected 3 restarts, got {len(restarts)}"
+        elif any(r["warm_frac"] is None or r["warm_frac"] >= 0.5
+                 for r in restarts):
+            fail = (f"a warm restore was not < 50% of cold: "
+                    f"{[(r['replica'], r['warm_frac']) for r in restarts]}")
+        elif summary.get("p95_ratio") is None \
+                or summary["p95_ratio"] > 1.25:
+            fail = (f"fleet p95 not flat through the roll: ratio "
+                    f"{summary.get('p95_ratio')} > 1.25 (steady "
+                    f"{summary.get('steady_p95_ms')}ms, roll "
+                    f"{summary.get('post_event_p95_ms')}ms)")
+        finish(name, {"fleet-drain", "fleet-restart"}, False, fail,
+               [ledger(name, "run")]
+               + [ledger(name, "run") + f".p{i}" for i in range(3)])
 
     # -- stall: wedged dispatch -> watchdog exit 14, typed, gated
     if want("serve-stall"):

@@ -413,10 +413,16 @@ def test_pyramid_window_lookup_stacked_matches_corr_lookup(radius):
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pyramid_window_lookup_stacked_vjp_and_model():
     """VJP of the one-launch lookup vs autodiff of the einsum path, and
     full-model gradient parity at lookup_impl='pallas_stacked' (both
-    deferred settings)."""
+    deferred settings).
+
+    Slow lane (PR 14 wall-clock satellite, ~25 s): the non-stacked
+    pyramid VJP + model-grad parity tests stay fast-lane and exercise
+    the same kernel machinery; engine 4's Pallas pass walks the stacked
+    entry every graftlint run."""
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models import RAFT
     from raft_tpu.ops.corr import (build_corr_pyramid_direct,

@@ -1,0 +1,989 @@
+"""Serving-fleet tests: consistent-hash routing, PodChannel-backed
+membership, the warm-state spill store, continuous batching,
+tiled high-res inference, the merged fleet obs report, and the
+fleet end-to-end gates.
+
+The PR 14 acceptance proofs live here in tier-1 form:
+
+- **Fleet e2e gate**: 3 replicas serve a mixed flow+stereo stream
+  load; one replica dies mid-load -> its streams re-route with typed
+  incidents and ADOPT their spilled warm state, and fleet-wide request
+  conservation holds (submitted == served + typed rejects + 0).
+- **Continuous-batching parity**: a request admitted into an in-flight
+  batch at an iteration boundary leaves every other slot BIT-identical
+  to an unjoined run (slot independence within one executable).
+- **Rolling restart**: drain -> close -> rebuild -> warm AOT restore
+  measured < 50% of the cold startup, conservation intact.
+
+scripts/chaos_dryrun.py --serve drives the same properties through the
+real CLI (serve-kill-one-replica, serve-rolling-restart rows), where
+the p95-flat-through-the-roll number is also gated.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+HW = (64, 64)
+B = 2
+
+
+# ---------------------------------------------------------------------------
+# shared tiny serving stack (compiles amortized through ONE AOT cache)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def aot_dir(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("fleet_aot"))
+
+
+@pytest.fixture(scope="module")
+def flow_model():
+    from raft_tpu.models import RAFT
+    from raft_tpu.serve.engine import serve_config
+
+    model = RAFT(serve_config(small=True))
+    img = np.zeros((1, HW[0], HW[1], 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=2,
+                           train=True)
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def stereo_model():
+    from raft_tpu.workloads.stereo import (STEREO_SERVE_OVERRIDES,
+                                           StereoRAFT, stereo_config)
+
+    model = StereoRAFT(stereo_config(small=True,
+                                     overrides=STEREO_SERVE_OVERRIDES))
+    img = np.zeros((1, HW[0], HW[1], 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(1), img, img, iters=2,
+                           train=True)
+    return model, variables
+
+
+def _flow_engine(flow_model, aot_dir):
+    from raft_tpu.serve.aot import AOTCache
+    from raft_tpu.serve.engine import ServeEngine
+
+    model, variables = flow_model
+    return ServeEngine(model, variables, batch_size=B,
+                       aot_cache=AOTCache(aot_dir))
+
+
+def _frame(rng):
+    return rng.uniform(0, 255, (HW[0], HW[1], 3)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# hash ring / local KV / membership / router (pure host-side)
+# ---------------------------------------------------------------------------
+
+def test_hash_ring_deterministic_and_minimal_motion():
+    from raft_tpu.serve.router import HashRing
+
+    ring = HashRing(["r0", "r1", "r2"])
+    keys = [f"stream-{i}" for i in range(300)]
+    a = {k: ring.assign(k) for k in keys}
+    # deterministic across instances (sha256, not hash())
+    ring2 = HashRing(["r2", "r0", "r1"])
+    assert a == {k: ring2.assign(k) for k in keys}
+    # every node owns a nontrivial share
+    by_node = {n: sum(1 for v in a.values() if v == n) for n in ring.nodes}
+    assert all(c > 30 for c in by_node.values()), by_node
+    # removing one node moves ONLY its keys (the consistent-hash
+    # contract that bounds a replica death to ~1/N of the streams)
+    smaller = ring.without("r1")
+    for k in keys:
+        if a[k] != "r1":
+            assert smaller.assign(k) == a[k]
+        else:
+            assert smaller.assign(k) in ("r0", "r2")
+    with pytest.raises(ValueError):
+        HashRing([]).assign("x")
+
+
+def test_local_kv_backs_the_pr7_podchannel_protocol():
+    """PodChannel (parallel/elastic.py) runs UNCHANGED over the
+    in-process KV store: post/gather agreement, mutable heartbeats,
+    prefix polls — the fleet's membership transport is the pod's."""
+    from raft_tpu.serve.router import LocalKVStore, fleet_channel
+
+    kv = LocalKVStore()
+    c0 = fleet_channel(kv, 0, 2)
+    c1 = fleet_channel(kv, 1, 2)
+    # one-shot post + blocking gather (the agreement primitive)
+    c1.post("boundary/3", "1")
+    votes = c0.gather("boundary/3", "0", timeout_s=2.0)
+    assert votes == {0: "0", 1: "1"}
+    assert c1.gather("boundary/3", "1", timeout_s=2.0) == votes
+    # duplicate posts are idempotent (ALREADY_EXISTS swallowed)
+    c0.post("boundary/3", "9")
+    assert c0.poll("boundary/3")[0] == "0"
+    # mutable put (heartbeats) overwrites
+    c0.put("hb", "1:100.0")
+    c0.put("hb", "1:200.0")
+    c1.put("hb", "0:150.0")
+    assert c0.poll("hb") == {0: "1:200.0", 1: "0:150.0"}
+
+
+def test_membership_staleness_and_marks():
+    from raft_tpu.serve.router import (FleetMembership, LocalKVStore,
+                                       ReplicaHeartbeat, fleet_channel)
+
+    now = [100.0]
+    kv = LocalKVStore()
+    rids = ("r0", "r1")
+    mem = FleetMembership(fleet_channel(kv, 0, 2), rids, interval=1.0,
+                          clock=lambda: now[0])
+    hbs = [ReplicaHeartbeat(fleet_channel(kv, i, 2), lambda: True,
+                            interval=1.0, clock=lambda: now[0])
+           for i in range(2)]
+    for hb in hbs:
+        hb.beat_once()
+    assert mem.live() == ["r0", "r1"]
+    # r1 stops beating; past the staleness bound it drops out
+    now[0] += 10.0
+    hbs[0].beat_once()
+    assert mem.live() == ["r0"]
+    # an unhealthy beat is as dead as a missing one
+    now[0] += 0.5
+    kv.key_value_delete("fleet/hb/p1")
+    kv.key_value_set("fleet/hb/p1", f"0:{now[0]}")
+    assert mem.live() == ["r0"]
+    # explicit marks win instantly (the fleet-initiated paths)
+    mem.mark_dead("r0")
+    assert mem.live() == []
+    mem.mark_live("r0")
+    mem.mark_draining("r0")
+    assert mem.live() == []
+
+
+def test_router_affinity_and_reported_moves():
+    from raft_tpu.serve.router import (FleetMembership, FleetRouter,
+                                       LocalKVStore, fleet_channel)
+
+    now = [0.0]
+    kv = LocalKVStore()
+    rids = ("r0", "r1", "r2")
+    mem = FleetMembership(fleet_channel(kv, 0, 3), rids, interval=1.0,
+                          clock=lambda: now[0])
+    router = FleetRouter(mem)
+    depths = {r: 0 for r in rids}
+    # affinity: same stream -> same replica, no move reported
+    t1, moved = router.route("s1", depths)
+    t2, moved2 = router.route("s1", depths)
+    assert t1 == t2 and moved is None and moved2 is None
+    # stateless requests go to the shallowest queue
+    depths = {"r0": 5, "r1": 0, "r2": 3}
+    assert router.route(None, depths)[0] == "r1"
+    # a death moves the stream exactly once, and the move is REPORTED
+    mem.mark_dead(t1)
+    t3, moved3 = router.route("s1", depths)
+    assert t3 != t1 and moved3 == t1
+    # ...and only once (the new assignment is remembered)
+    assert router.route("s1", depths)[1] is None
+
+
+# ---------------------------------------------------------------------------
+# spill store: manifest discipline, typed re-cold-start
+# ---------------------------------------------------------------------------
+
+def test_spill_store_roundtrip_torn_and_missing(tmp_path):
+    from raft_tpu.serve.fleet import SpillStore
+
+    fired = []
+    store = SpillStore(str(tmp_path / "spill"),
+                       on_incident=lambda k, d: fired.append((k, d)))
+    key = ("flow", "cam-17")
+    state = np.arange(8 * 8 * 2, dtype=np.float32).reshape(8, 8, 2)
+    store.put(key, state)
+    got = store.get(key)
+    assert got is not None and np.array_equal(got, state)
+    assert got.dtype == np.float32
+    # missing key: silent miss (every new stream is legitimately cold)
+    assert store.get(("flow", "nope")) is None
+    assert not fired
+    # torn blob at rest: typed fleet-cold-start, quarantined, None
+    with open(store.path(key), "r+b") as f:
+        f.truncate(16)
+    assert store.get(key) is None
+    assert fired and fired[0][0] == "fleet-cold-start"
+    assert os.path.exists(store.path(key) + ".corrupt")
+    # quarantine means the NEXT read is a clean miss, not a re-verify
+    fired.clear()
+    assert store.get(key) is None
+    assert not fired
+    # a fresh put re-establishes the stream
+    store.put(key, state * 2)
+    assert np.array_equal(store.get(key), state * 2)
+
+
+def test_spill_get_retries_transient_mismatch(tmp_path):
+    """put() writes blob-then-manifest as two separate atomic renames,
+    so a reader landing between them pairs the NEW blob with the OLD
+    manifest.  That transient mismatch must re-verify and succeed —
+    quarantining it would destroy the dying replica's last spill at
+    the exact moment a kill-replica adoption is reading for it.  A
+    PERSISTENT mismatch (kill between the renames) still quarantines
+    (previous test)."""
+    from raft_tpu.serve.fleet import SpillStore
+
+    fired = []
+    store = SpillStore(str(tmp_path / "spill"),
+                       on_incident=lambda k, d: fired.append((k, d)))
+    key = ("flow", "cam-42")
+    state = np.arange(8 * 8 * 2, dtype=np.float32).reshape(8, 8, 2)
+    store.put(key, state)
+    real = store._read_verified
+    calls = {"n": 0}
+
+    def mid_write_once(k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("sha256 mismatch — simulated mid-put read")
+        return real(k)
+
+    store._read_verified = mid_write_once
+    got = store.get(key)
+    assert calls["n"] == 2
+    assert got is not None and np.array_equal(got, state)
+    assert not fired
+    assert not os.path.exists(store.path(key) + ".corrupt")
+    assert store.stats["hits"] == 1 and store.stats["corrupt"] == 0
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: the bit-exact join proof + server semantics
+# ---------------------------------------------------------------------------
+
+def test_continuous_join_keeps_neighbors_bit_exact(flow_model, aot_dir):
+    """THE continuous-batching parity pin: admitting a joiner into a
+    free slot at an iteration boundary leaves the other slot's outputs
+    BIT-identical to the unjoined run — same executable, slot contents
+    independent (the PR 10 poison-isolation argument, applied to
+    admission instead of rejection)."""
+    eng = _flow_engine(flow_model, aot_dir)
+    rng = np.random.default_rng(3)
+    seg = 2
+    i1 = np.zeros((B, *HW, 3), np.float32)
+    i2 = np.zeros((B, *HW, 3), np.float32)
+    i1[0], i2[0] = _frame(rng), _frame(rng)
+    zero_flow = np.zeros((B, HW[0] // 8, HW[1] // 8, 2), np.float32)
+
+    # segment 1: only slot 0 is live (slot 1 empty-pad)
+    low1, _ = eng.forward(HW, seg, i1, i2, flow_init=zero_flow)
+
+    # segment 2a (unjoined): slot 1 stays empty
+    flow_a = np.zeros_like(zero_flow)
+    flow_a[0] = low1[0]
+    low2a, up2a = eng.forward(HW, seg, i1, i2, flow_init=flow_a)
+
+    # segment 2b (joined): a new request occupies slot 1 at the
+    # boundary, with its own images and cold flow state
+    j1, j2 = i1.copy(), i2.copy()
+    j1[1], j2[1] = _frame(rng), _frame(rng)
+    low2b, up2b = eng.forward(HW, seg, j1, j2, flow_init=flow_a)
+
+    assert np.array_equal(low2a[0], low2b[0])
+    assert np.array_equal(up2a[0], up2b[0])
+    # and the joiner actually computed something
+    assert not np.array_equal(low2b[1], low2a[1])
+
+
+def test_continuous_server_segments_conservation_and_warm(flow_model,
+                                                          aot_dir,
+                                                          tmp_path):
+    """The continuous FlowServer end-to-end: requests complete after
+    ceil(iters/segment) segments, video streams chain warm starts
+    across frames, and the conservation books balance at close."""
+    from raft_tpu.obs import RunLedger
+    from raft_tpu.serve.server import FlowServer
+
+    ledger = RunLedger(str(tmp_path / "events.jsonl"),
+                       meta={"entry": "serve"})
+    server = FlowServer(_flow_engine(flow_model, aot_dir),
+                        buckets={"tiny": HW}, queue_capacity=16,
+                        iter_levels=(4, 2), degrade=False,
+                        ledger=ledger, continuous=True, segment_iters=2)
+    try:
+        rng = np.random.default_rng(5)
+        frames = {s: (_frame(rng), _frame(rng), _frame(rng))
+                  for s in ("a", "b")}
+        # frame 1 of both streams
+        r1 = [server.submit(frames[s][0], frames[s][1], stream=s)
+              .result(timeout=120) for s in ("a", "b")]
+        assert all(r["iters"] == 4 and r["segments"] == 2 for r in r1)
+        assert all(not r["warm"] for r in r1)
+        # frame 2: the warm chain engages
+        r2 = [server.submit(frames[s][1], frames[s][2], stream=s)
+              .result(timeout=120) for s in ("a", "b")]
+        assert all(r["warm"] for r in r2)
+        # a stateless request rides the same in-flight machinery
+        server.submit(_frame(rng), _frame(rng)).result(timeout=120)
+    finally:
+        summary = server.close()
+    assert summary["submitted"] == 5
+    assert summary["served"] == 5
+    assert summary["unaccounted"] == 0
+
+
+def test_continuous_no_cross_lane_starvation(flow_model, aot_dir):
+    """Sustained traffic in one (workload, family) lane must not
+    starve another lane: continuous admission only joins the in-flight
+    batch's own lane, so at any boundary where ANOTHER lane has queued
+    work the batch must stop admitting and DRAIN (bounded by the
+    slots' remaining segment budgets) — without that rule, a request
+    in a second family would wait forever under steady first-family
+    arrivals (its deadline never even checked)."""
+    import threading
+    import time as _time
+
+    from raft_tpu.serve.batcher import RequestError
+    from raft_tpu.serve.server import FlowServer
+
+    big_hw = (72, 72)
+    server = FlowServer(_flow_engine(flow_model, aot_dir),
+                        buckets={"tiny": HW, "big": big_hw},
+                        queue_capacity=8, iter_levels=(4, 2),
+                        degrade=False, continuous=True, segment_iters=2)
+    stop = threading.Event()
+
+    def feed():
+        rng = np.random.default_rng(7)
+        while not stop.is_set():
+            try:
+                server.submit(_frame(rng), _frame(rng))
+            except RequestError:
+                pass       # queue full: the backlog is bounded
+            _time.sleep(0.005)
+
+    feeder = threading.Thread(target=feed, daemon=True)
+    try:
+        rng = np.random.default_rng(8)
+        # pay the tiny-lane compile before the clock starts
+        server.submit(_frame(rng), _frame(rng)).result(timeout=300)
+        feeder.start()
+        b1 = rng.uniform(0, 255, (*big_hw, 3)).astype(np.float32)
+        b2 = rng.uniform(0, 255, (*big_hw, 3)).astype(np.float32)
+        res = server.submit(b1, b2).result(timeout=300)
+        assert res["iters"] >= 2
+    finally:
+        stop.set()
+        feeder.join(timeout=10)
+        summary = server.close()
+    assert summary["unaccounted"] == 0
+
+
+def test_continuous_admission_failure_rejects_typed(flow_model, aot_dir):
+    """A joiner whose continuous admission fails (its warm-state
+    lookup raises) must reach a TYPED rejection — a request popped
+    from the queue and then dropped would hang its client and trip
+    the conservation gate as an unaccounted silent drop.  The rest of
+    the popped wave still seats, and the admission boundary drives
+    the degradation controller's observe() (without it the level
+    would freeze for as long as the in-flight batch persists)."""
+    import time as _time
+
+    from raft_tpu.serve.batcher import RequestError
+    from raft_tpu.serve.server import FlowServer
+
+    server = FlowServer(_flow_engine(flow_model, aot_dir),
+                        buckets={"tiny": HW}, queue_capacity=16,
+                        iter_levels=(16, 2), degrade=False,
+                        continuous=True, segment_iters=2)
+    real_warm = server._warm_state
+    observed = []
+
+    def poisoned_warm(key, hw, wc):
+        if key[1] == "boom":
+            raise RuntimeError("simulated warm-state lookup failure")
+        return real_warm(key, hw, wc)
+
+    server._warm_state = poisoned_warm
+    real_observe = server.controller.observe
+
+    def counting_observe(frac, p95_ms=None):
+        observed.append(frac)
+        return real_observe(frac, p95_ms)
+
+    server.controller.observe = counting_observe
+    try:
+        rng = np.random.default_rng(11)
+        fa = server.submit(_frame(rng), _frame(rng))   # 8 segments
+        deadline = _time.monotonic() + 300
+        while server._batch_no < 1 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        fb = server.submit(_frame(rng), _frame(rng), stream="boom")
+        fc = server.submit(_frame(rng), _frame(rng))
+        with pytest.raises(RequestError):
+            fb.result(timeout=300)
+        assert fa.result(timeout=300)["iters"] >= 2
+        assert fc.result(timeout=300)["iters"] >= 2
+    finally:
+        summary = server.close()
+    assert summary["submitted"] == 3
+    assert summary["served"] == 2
+    assert summary["rejected_bad_request"] == 1
+    assert summary["unaccounted"] == 0
+    assert observed, "admission boundaries must drive controller.observe"
+
+
+# ---------------------------------------------------------------------------
+# the fleet e2e gate: kill a replica under mixed flow+stereo load
+# ---------------------------------------------------------------------------
+
+def test_fleet_kill_replica_e2e_gate(flow_model, stereo_model, aot_dir,
+                                     tmp_path):
+    """PR 14 acceptance: 3 replicas, mixed flow+stereo stream load,
+    one replica killed mid-load -> typed incidents, verified warm-state
+    adoption on the survivors, fleet-wide conservation, and the merged
+    obs report aggregates per-replica attribution and passes the fatal
+    gate."""
+    from raft_tpu.obs import RunLedger
+    from raft_tpu.obs.__main__ import main as obs_main
+    from raft_tpu.obs.events import read_ledger
+    from raft_tpu.serve.aot import AOTCache
+    from raft_tpu.serve.engine import ServeEngine
+    from raft_tpu.serve.fleet import FleetServer
+    from raft_tpu.serve.server import FlowServer
+    from raft_tpu.workloads.stereo import compile_stereo_forward
+
+    f_model, f_vars = flow_model
+    s_model, s_vars = stereo_model
+    front = str(tmp_path / "events.jsonl")
+    ledger = RunLedger(front, meta={"entry": "serve-fleet"})
+
+    def factory(rid, spill):
+        engines = {
+            "flow": ServeEngine(f_model, f_vars, batch_size=B,
+                                aot_cache=AOTCache(aot_dir)),
+            "stereo": ServeEngine(s_model, s_vars, batch_size=B,
+                                  aot_cache=AOTCache(aot_dir),
+                                  compile_fn=compile_stereo_forward,
+                                  cache_tag="stereo_serve",
+                                  warm_channels=1),
+        }
+        rep_ledger = RunLedger(f"{front}.p{rid[1:]}",
+                               meta={"entry": "serve", "replica": rid})
+        return FlowServer(engines, buckets={"tiny": HW},
+                          queue_capacity=16, iter_levels=(2,),
+                          degrade=False, ledger=rep_ledger,
+                          spill_store=spill)
+
+    fleet = FleetServer(factory, n_replicas=3,
+                        spill_dir=str(tmp_path / "spill"), ledger=ledger,
+                        heartbeat_interval=0.1)
+    fleet.warmup()
+    rng = np.random.default_rng(7)
+    streams = [("flow", f"s{i}") for i in range(4)] + \
+              [("stereo", f"t{i}") for i in range(2)]
+
+    def one_round():
+        futs = [fleet.submit(_frame(rng), _frame(rng), stream=sid,
+                             workload=wl) for wl, sid in streams]
+        return [f.result(timeout=300) for f in futs]
+
+    round1 = one_round()
+    owner1 = {streams[i]: r["replica"] for i, r in enumerate(round1)}
+    # round 2 on the same replicas: the local warm chain engages
+    round2 = one_round()
+    assert all(r["warm"] for r in round2)
+
+    victims = {}
+    for (wl, sid), rid in owner1.items():
+        victims[rid] = victims.get(rid, 0) + 1
+    victim = max(victims, key=lambda r: victims[r])
+    assert fleet.kill_replica(victim) >= 0
+
+    round3 = one_round()
+    moved = [(streams[i], r) for i, r in enumerate(round3)
+             if owner1[streams[i]] == victim]
+    assert moved, "the victim owned no stream?!"
+    for (wl, sid), r in moved:
+        assert r["replica"] != victim
+        # verified warm-state adoption: the moved stream continues its
+        # warm chain on the new replica (spilled state, not cold)
+        assert r["warm"], f"stream {wl}/{sid} lost its warm chain"
+
+    summary = fleet.close()
+    assert summary["unaccounted"] == 0
+    assert summary["submitted"] == summary["served"] == 18
+    assert summary["stream_moves"] >= len(moved)
+    assert summary["replicas"][victim]["status"] == "dead"
+    assert summary["spill_store"]["hits"] >= len(moved)
+
+    # typed incidents landed where they belong
+    front_kinds = {r.get("incident") for r in read_ledger(front)
+                   if r.get("kind") == "incident"}
+    assert {"fleet-replica-lost", "fleet-reroute"} <= front_kinds
+    replica_kinds = set()
+    for i in range(3):
+        replica_kinds |= {r.get("incident")
+                         for r in read_ledger(f"{front}.p{i}")
+                         if r.get("kind") == "incident"}
+    assert "fleet-warm-adopt" in replica_kinds
+
+    # the merged fleet report aggregates and the fatal gate passes
+    assert obs_main(["report", "--merge", front + ".p0",
+                     "--fail-on-incident", "fatal"]) == 0
+    from raft_tpu.obs.report import build_pod_report
+    per = {i: read_ledger(f"{front}.p{i}") for i in range(3)}
+    merged = build_pod_report(per)
+    assert merged["serving"] is not None
+    # the killed replica wrote no run_end; the two closed replicas'
+    # books are in the merge and balance
+    assert merged["serving"]["unaccounted"] == 0
+    assert merged["serving"]["served"] == 18 - victims[victim] * 2
+
+
+def test_fleet_rolling_restart_warm_restore(flow_model, tmp_path):
+    """Rolling restart against a FRESH AOT cache: the initial warmup
+    pays the cold compiles, every restart verifies-and-loads warm at
+    < 50% of cold (measured), and the books balance with traffic
+    before, during and after the roll."""
+    from raft_tpu.serve.aot import AOTCache
+    from raft_tpu.serve.engine import ServeEngine
+    from raft_tpu.serve.fleet import FleetServer
+    from raft_tpu.serve.server import FlowServer
+
+    f_model, f_vars = flow_model
+    cache_dir = str(tmp_path / "aot")
+
+    def factory(rid, spill):
+        eng = ServeEngine(f_model, f_vars, batch_size=B,
+                          aot_cache=AOTCache(cache_dir))
+        return FlowServer(eng, buckets={"tiny": HW}, queue_capacity=16,
+                          iter_levels=(2,), degrade=False,
+                          spill_store=spill, warm_iters=None)
+
+    fleet = FleetServer(factory, n_replicas=2,
+                        spill_dir=str(tmp_path / "spill"),
+                        heartbeat_interval=0.1)
+    fleet.warmup()
+    assert fleet.cold_startup_s > 0
+    rng = np.random.default_rng(11)
+    futs = [fleet.submit(_frame(rng), _frame(rng), stream=f"s{i % 2}")
+            for i in range(4)]
+    assert all(f.result(timeout=300) for f in futs)
+
+    rows = fleet.rolling_restart()
+    assert len(rows) == 2
+    for row in rows:
+        assert row["drained"], row
+        assert row["warm_frac"] is not None and row["warm_frac"] < 0.5, \
+            f"warm restore not under half of cold: {row}"
+
+    # the restarted fleet still serves, and streams survived the roll
+    # through the spill store (the LRU died with the old replicas)
+    futs = [fleet.submit(_frame(rng), _frame(rng), stream=f"s{i % 2}")
+            for i in range(2)]
+    res = [f.result(timeout=300) for f in futs]
+    assert all(r["warm"] for r in res)
+    summary = fleet.close()
+    assert summary["unaccounted"] == 0
+    assert summary["served"] == 6
+    assert all(r["restarts"] == 1
+               for r in summary["replicas"].values())
+
+
+def test_place_retries_across_rolling_restart_swap():
+    """The _place stale-handle race: a submit thread that read the
+    replica handle just before a rolling restart swapped it must RETRY
+    on the fresh server — the old path saw mark == 'up', skipped the
+    dead-replica branch, and rejected a servable request typed (which
+    also flakes the zero-shed rolling-restart chaos gate)."""
+    from concurrent.futures import Future
+
+    from raft_tpu.serve.batcher import BadRequestError
+    from raft_tpu.serve.fleet import FleetServer
+
+    class FakeServer:
+        def __init__(self):
+            self.queue = []
+            self.submitted = []
+
+        def warmup(self):
+            pass
+
+        def health(self):
+            return {"ok": True}
+
+        def submit(self, image1, image2, deadline_ms=None, stream=None,
+                   workload="flow"):
+            self.submitted.append(stream)
+            fut = Future()
+            fut.set_result({"flow": np.zeros((2, 2, 2), np.float32),
+                            "warm": False})
+            return fut
+
+        def kill(self):
+            return []
+
+        def close(self):
+            return {}
+
+    servers = [FakeServer()]
+    fleet = FleetServer(lambda rid, spill: servers[-1], n_replicas=1)
+    stale = fleet._replicas["r0"]
+    servers.append(FakeServer())
+    fresh = fleet._build_replica("r0")
+
+    def racing_submit(*a, **k):
+        # the swap lands between _place's handle read and this call:
+        # emulate by swapping NOW, then failing like a closed server
+        fleet._replicas["r0"] = fresh
+        raise BadRequestError("server is shutting down")
+
+    stale.server.submit = racing_submit
+    img = np.zeros((*HW, 3), np.float32)
+    res = fleet.submit(img, img).result(timeout=10)
+    assert res["replica"] == "r0"
+    assert fresh.server.submitted, "retry never reached the fresh server"
+    assert fleet.counters["served"] == 1
+
+
+def test_request_terminal_is_claimed_exactly_once():
+    """close()'s leftover sweep racing a late completion: the
+    completion pops the pending entry and counts served, then the
+    sweep's stale reference must NOT also count rejected — a double
+    terminal drives 'unaccounted' negative and fires a false FATAL
+    fleet-conservation on a run with zero silent drops."""
+    from concurrent.futures import Future
+
+    from raft_tpu.serve.batcher import BadRequestError
+    from raft_tpu.serve.fleet import FleetServer
+
+    class HoldServer:
+        def __init__(self):
+            self.queue = []
+            self.held = []
+
+        def warmup(self):
+            pass
+
+        def submit(self, image1, image2, deadline_ms=None, stream=None,
+                   workload="flow"):
+            fut = Future()
+            self.held.append(fut)
+            return fut
+
+        def kill(self):
+            return []
+
+        def close(self):
+            return {}
+
+    fleet = FleetServer(lambda rid, spill: HoldServer(), n_replicas=1)
+    img = np.zeros((*HW, 3), np.float32)
+    client = fleet.submit(img, img)
+    pend = next(iter(fleet._pending.values()))
+    # the completion wins the race: served counted, entry popped
+    fleet._replicas["r0"].server.held[0].set_result(
+        {"flow": np.zeros((2, 2, 2), np.float32), "warm": False})
+    assert client.result(timeout=10)["replica"] == "r0"
+    # the sweep's STALE reference arrives second: must be a no-op
+    fleet._finish_rejected(pend, BadRequestError("stale leftover sweep"))
+    assert fleet.counters["served"] == 1
+    assert fleet.counters["rejected_bad_request"] == 0
+    summary = fleet.close()
+    assert summary["unaccounted"] == 0
+
+
+def test_rolling_restart_skips_dead_replica_close():
+    """A replica killed BEFORE a roll has crash semantics: rolling
+    through it must rebuild it WITHOUT calling close() on the dead
+    server — a post-mortem run_end would book its rescued orphans as
+    unaccounted and fire a false FATAL serve-conservation on the
+    replica's ledger."""
+    from concurrent.futures import Future
+
+    from raft_tpu.serve.fleet import FleetServer
+
+    class FakeServer:
+        def __init__(self):
+            self.queue = []
+            self.closed = False
+
+        def warmup(self):
+            pass
+
+        def health(self):
+            return {"ok": True}
+
+        def submit(self, image1, image2, deadline_ms=None, stream=None,
+                   workload="flow"):
+            fut = Future()
+            fut.set_result({"flow": np.zeros((2, 2, 2), np.float32),
+                            "warm": False})
+            return fut
+
+        def kill(self):
+            return []
+
+        def close(self):
+            self.closed = True
+            return {}
+
+    fleet = FleetServer(lambda rid, spill: FakeServer(), n_replicas=2)
+    dead = fleet._replicas["r0"].server
+    alive = fleet._replicas["r1"].server
+    fleet.kill_replica("r0")
+    rows = fleet.rolling_restart(drain_timeout=5.0)
+    assert dead.closed is False, "crash semantics: no post-mortem close"
+    assert alive.closed is True, "the live replica drains and closes"
+    assert [r["drained"] for r in rows] == [False, True]
+    assert all(fleet.membership.mark(r) == "up" for r in ("r0", "r1"))
+    assert all(fleet._replicas[r].restarts == 1 for r in ("r0", "r1"))
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# tiled high-res inference
+# ---------------------------------------------------------------------------
+
+def test_tiled_plan_and_blend_unit():
+    from raft_tpu.serve.tiled import (DEFAULT_OVERLAP, DEFAULT_TILE_HW,
+                                      blend_tiles, plan_tiles,
+                                      tile_weights)
+
+    # the 4K plan covers every pixel with positive total weight
+    hw = (2160, 3840)
+    plan = plan_tiles(hw, DEFAULT_TILE_HW, DEFAULT_OVERLAP)
+    assert len(plan) == 25
+    th, tw = DEFAULT_TILE_HW
+    cov = np.zeros(hw, np.float32)
+    for (y, x) in plan:
+        assert 0 <= y <= hw[0] - th and 0 <= x <= hw[1] - tw
+        cov[y:y + th, x:x + tw] += tile_weights(hw, DEFAULT_TILE_HW,
+                                                (y, x), DEFAULT_OVERLAP)
+    assert cov.min() > 0
+    # frame corners keep full weight (no neighbor, no feather)
+    assert cov[0, 0] == pytest.approx(1.0)
+
+    # blending constant tiles reproduces the constant exactly —
+    # normalized weights sum to 1 everywhere
+    plan96 = plan_tiles((96, 96), (64, 64), 32)
+    assert plan96 == [(0, 0), (0, 32), (32, 0), (32, 32)]
+    flows = [np.full((64, 64, 2), 3.25, np.float32) for _ in plan96]
+    out = blend_tiles((96, 96), (64, 64), plan96, 32, flows)
+    np.testing.assert_allclose(out, 3.25, rtol=0, atol=1e-5)
+
+    # degenerate configs are loud
+    with pytest.raises(ValueError):
+        plan_tiles((96, 96), (64, 64), 64)
+    with pytest.raises(ValueError):
+        plan_tiles((32, 32), (64, 64), 16)
+
+
+def test_tile_weights_continuous_at_large_overlap():
+    """overlap > tile/2 is legal (validation only demands overlap <
+    min(tile)) and must keep the feather C0-continuous: the old
+    slice-write form let the hi ramp overwrite the lo ramp mid-tile,
+    a weight JUMP inside every interior tile — exactly the seam
+    artifact the blend exists to kill.  The min-composed ramps bound
+    every adjacent-pixel weight step by one ramp increment."""
+    from raft_tpu.serve.tiled import blend_tiles, plan_tiles, tile_weights
+
+    hw, tile, ov = (100, 100), (40, 40), 28
+    plan = plan_tiles(hw, tile, ov)
+    step = 1.0 / (ov + 1) + 1e-6
+    for origin in plan:
+        w = tile_weights(hw, tile, origin, ov)
+        assert np.max(np.abs(np.diff(w, axis=0))) <= step
+        assert np.max(np.abs(np.diff(w, axis=1))) <= step
+    # and the normalized blend still reproduces a constant exactly
+    flows = [np.full((*tile, 2), -1.5, np.float32) for _ in plan]
+    out = blend_tiles(hw, tile, plan, ov, flows)
+    np.testing.assert_allclose(out, -1.5, rtol=0, atol=1e-5)
+
+
+def test_tiled_serve_through_the_batcher(flow_model, aot_dir):
+    """Tiles ride the ordinary bucketed batcher: a frame that IS one
+    tile reproduces the plain request (weights are identically 1), a
+    2x2 tiled frame blends finite seams, and a poisoned tile fails the
+    whole frame typed — never a silently half-blended flow."""
+    from raft_tpu.serve.batcher import RequestError
+    from raft_tpu.serve.server import FlowServer
+    from raft_tpu.serve.tiled import infer_tiled, submit_tiled
+
+    server = FlowServer(_flow_engine(flow_model, aot_dir),
+                        buckets={"tile": HW}, queue_capacity=32,
+                        iter_levels=(2,), degrade=False)
+    try:
+        rng = np.random.default_rng(13)
+        f1, f2 = _frame(rng), _frame(rng)
+        direct = server.submit(f1, f2).result(timeout=300)
+        tiled = infer_tiled(server, f1, f2, tile_hw=HW, overlap=16,
+                            timeout=300)
+        assert tiled["tiles"] == 1
+        # one tile covering the frame: the tiled path IS the plain
+        # request (same executable; slot-index lowering noise only)
+        np.testing.assert_allclose(tiled["flow"], direct["flow"],
+                                   atol=3e-3, rtol=1e-5)
+
+        big1 = rng.uniform(0, 255, (96, 96, 3)).astype(np.float32)
+        big2 = rng.uniform(0, 255, (96, 96, 3)).astype(np.float32)
+        out = infer_tiled(server, big1, big2, tile_hw=HW, overlap=32,
+                          timeout=300)
+        assert out["flow"].shape == (96, 96, 2)
+        assert out["tiles"] == 4
+        assert np.isfinite(out["flow"]).all()
+
+        # a poisoned tile -> the FRAME future rejects typed
+        poisoned = big1.copy()
+        poisoned[0, 0, 0] = np.nan
+        fut = submit_tiled(server, poisoned, big2, tile_hw=HW,
+                           overlap=32)
+        with pytest.raises(RequestError):
+            fut.result(timeout=300)
+    finally:
+        summary = server.close()
+    assert summary["unaccounted"] == 0
+
+
+def test_registry_has_tiled_entry():
+    """The tile family's executable is a registered entry point: all
+    five engines + the budget ledger cover it by construction."""
+    from raft_tpu.entrypoints import ENTRYPOINTS
+
+    e = ENTRYPOINTS["tiled_serve_forward"]
+    assert e.hlo and e.numerics and e.jaxpr == ("serve_forward",)
+    assert e.cache_tag == "serve_forward"
+    assert e.budget_sections == ("entries",)
+    assert e.anchor == ("raft_tpu.serve.tiled", "abstract_tiled_forward")
+
+
+# ---------------------------------------------------------------------------
+# merged fleet obs report + SLO gate
+# ---------------------------------------------------------------------------
+
+def test_obs_merge_fleet_serving_and_slo_gate(tmp_path):
+    from raft_tpu.obs.__main__ import main as obs_main
+    from raft_tpu.obs.events import RunLedger, read_ledger
+    from raft_tpu.obs.report import build_pod_report
+
+    def replica(pid, samples, slo):
+        path = str(tmp_path / f"events.jsonl.p{pid}")
+        led = RunLedger(path, meta={"entry": "serve"})
+        led.close(summary={"serving": {
+            "submitted": 10, "served": 9, "rejected_queue_full": 1,
+            "rejected_deadline": 0, "rejected_bad_request": 0,
+            "rejected_shutdown": 0, "rejected_total": 1,
+            "unaccounted": 0, "latency_p95_ms": max(samples),
+            "latency_samples_ms": samples, "slo_p95_ms": slo}})
+        return path
+
+    p0 = replica(0, [10.0, 11.0, 12.0, 13.0], 50.0)
+    replica(1, [14.0, 15.0, 16.0, 90.0], 50.0)
+
+    merged = build_pod_report({i: read_ledger(str(
+        tmp_path / f"events.jsonl.p{i}")) for i in range(2)})
+    s = merged["serving"]
+    assert s["submitted"] == 20 and s["served"] == 18
+    assert s["rejected_total"] == 2 and s["unaccounted"] == 0
+    assert s["pooled_samples"] == 8
+    # the fleet p95 comes from POOLED samples — not from averaging
+    # per-replica percentiles (p95 of the pool is the tail request)
+    assert s["latency_p95_ms"] > 50.0
+    assert s["slo_ok"] is False
+    assert set(s["replicas"]) == {"p0", "p1"}
+
+    # --merge --fail-on-slo gates the fleet-wide number
+    assert obs_main(["report", "--merge", p0, "--fail-on-slo"]) == 1
+    # a fleet inside its SLO passes
+    for f in os.listdir(tmp_path):
+        os.unlink(tmp_path / f)
+    p0 = replica(0, [10.0, 11.0], 50.0)
+    replica(1, [12.0, 13.0], 50.0)
+    assert obs_main(["report", "--merge", p0, "--fail-on-slo"]) == 0
+    # non-serve pod ledgers: a loud usage error, never a silent pass
+    for f in os.listdir(tmp_path):
+        os.unlink(tmp_path / f)
+    path = str(tmp_path / "events.jsonl.p0")
+    RunLedger(path, meta={"entry": "train"}).close(summary={"steps": 3})
+    assert obs_main(["report", "--merge", path, "--fail-on-slo"]) == 2
+
+
+def test_obs_merge_front_door_gate_and_multi_run_replicas(tmp_path):
+    """Two merge-path pins: (a) the fleet front door's OWN ledger (the
+    suffix-less stem next to the .p<i> replica ledgers) joins the
+    merge — it is where the FATAL fleet-conservation incident lands,
+    and a merge that skipped it could not gate on the exact
+    silent-drop violation the fleet layer exists to catch; (b) a
+    rolling-restarted replica appends a SECOND run to its .p<i>
+    ledger, and the merged conservation counters must sum across ALL
+    runs instead of silently dropping pre-restart traffic."""
+    from raft_tpu.obs.__main__ import main as obs_main
+    from raft_tpu.obs.events import RunLedger, read_ledger
+    from raft_tpu.obs.report import (build_pod_report,
+                                     find_process_ledgers)
+
+    front = str(tmp_path / "events.jsonl")
+    led = RunLedger(front, meta={"entry": "serve-fleet"})
+    led.incident("fleet-conservation", step=0,
+                 detail="1 request unaccounted at close")
+    led.close(summary={"serving": {"submitted": 16, "served": 15,
+                                   "unaccounted": 1}})
+
+    def run(pid, served):
+        RunLedger(f"{front}.p{pid}", meta={"entry": "serve"}).close(
+            summary={"serving": {
+                "submitted": served, "served": served,
+                "rejected_total": 0, "unaccounted": 0,
+                "latency_p95_ms": 10.0,
+                "latency_samples_ms": [8.0, 9.0, 10.0],
+                "slo_p95_ms": 50.0}})
+
+    run(0, 10)
+    run(0, 5)        # the post-restart run, appended to the SAME file
+    run(1, 12)
+
+    ledgers = find_process_ledgers(front + ".p0")
+    assert set(ledgers) == {-1, 0, 1}
+    merged = build_pod_report(
+        {pid: read_ledger(p) for pid, p in ledgers.items()})
+    s = merged["serving"]
+    # replica counters sum across BOTH of p0's runs; the front door's
+    # fleet-LEVEL view of the same requests is attribution (its
+    # process row), not a third replica to sum — that would double-
+    # count every request
+    assert s["submitted"] == 27 and s["served"] == 27
+    assert s["replicas"]["p0"]["served"] == 15
+    assert s["replicas"]["p0"]["runs"] == 2
+    assert s["pooled_samples"] == 9
+    # the front door's fatal incident gates the merged report
+    assert obs_main(["report", "--merge", front + ".p0",
+                     "--fail-on-incident", "fatal"]) == 1
+    # a front-door-less pod run (PR 7 training) is unchanged
+    assert -1 not in find_process_ledgers(
+        str(tmp_path / "missing" / "events.jsonl.p0"))
+
+
+def test_obs_merge_ignores_unrelated_stem_ledger(tmp_path):
+    """Only a ledger that declares itself the fleet front door
+    (run_start meta entry ``serve-fleet``) may join the merge as the
+    front process: a stale suffix-less ledger from an UNRELATED
+    earlier run sharing the stem (say, a training run's events.jsonl
+    next to a later pod's .p<i> files) must not be adopted, gated,
+    and attributed as part of the pod."""
+    from raft_tpu.obs.events import RunLedger
+    from raft_tpu.obs.report import find_process_ledgers
+
+    stem = str(tmp_path / "events.jsonl")
+    led = RunLedger(stem, meta={"entry": "train"})
+    led.incident("nonfinite-loss", step=3, detail="stale earlier run")
+    led.close(summary={})
+    for pid in (0, 1):
+        RunLedger(f"{stem}.p{pid}", meta={"entry": "serve"}).close(
+            summary={"serving": {"submitted": 1, "served": 1,
+                                 "rejected_total": 0,
+                                 "unaccounted": 0}})
+    assert set(find_process_ledgers(str(tmp_path))) == {0, 1}
+    # a torn/unreadable stem file is likewise not adopted
+    with open(stem, "w", encoding="utf-8") as f:
+        f.write('{"kind": "run_st')
+    assert set(find_process_ledgers(str(tmp_path))) == {0, 1}

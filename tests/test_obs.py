@@ -327,11 +327,17 @@ def test_iter_with_span_charges_next_to_phase():
 # health.py
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_nonfinite_sentinel_fires_through_the_real_train_step(tmp_path):
     """Injected NaN batch -> the in-graph sentinel (training/step.py)
     flags it as a device scalar -> the bus boundary converts -> the
     monitor records EXACTLY ONE nonfinite-loss incident naming the
-    offending step, latched against the poisoned-state aftermath."""
+    offending step, latched against the poisoned-state aftermath.
+
+    Slow lane (PR 14 wall-clock satellite, ~21 s): the sentinel state
+    machine is pinned fast by the obs selfcheck's tripwire run and the
+    monitor unit tests; this twin re-proves it through a real compiled
+    train step and rides --runslow."""
     import jax
 
     from raft_tpu.config import RAFTConfig

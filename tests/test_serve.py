@@ -637,8 +637,10 @@ def test_warm_init_family_change_drops_stream_instead_of_crashing(engine):
         img = np.zeros(HW + (3,), np.float32)
         req = _req(img, img, rid=1)
         req.stream = "cam0"
-        flow_init = server._warm_inits([req, None], HW, server.engine)
+        flow_init, warm_slots = server._warm_inits([req, None], HW,
+                                                   server.engine)
         assert flow_init is None, "mismatched stream state must cold-start"
+        assert not warm_slots, "no slot may claim a warm start"
         assert ("flow", "cam0") not in server._streams, \
             "stale state must be evicted"
     finally:

@@ -110,10 +110,16 @@ def test_fetch_dataset_applies_wire_format():
         fetch_dataset("synthetic", (64, 64), wire_format="fp8")
 
 
+@pytest.mark.slow
 def test_train_step_loss_matches_f32_wire():
     """The same samples through both wire formats give the same loss up
     to the 1/128-px target quantization — the packed wire changes bytes
-    on the link, not the training objective."""
+    on the link, not the training objective.
+
+    Slow lane (PR 14 wall-clock satellite, ~38 s): the per-op wire
+    round-trip/quantization pins above stay fast-lane and catch wire
+    regressions; this end-to-end train-step twin re-proves their
+    composition and rides --runslow."""
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models import RAFT
     from raft_tpu.training import create_train_state, make_optimizer
