@@ -400,6 +400,35 @@ def main():
     health.sample_memory(n_steps)
     spans.flush(n_steps)
 
+    # SDC digest-cadence overhead (resilience/sdc.py): the per-cadence
+    # cost of --sdc_vote_every is one capture (device_get of the
+    # pre-step state) + one param-tree digest + one replayed step +
+    # one host compare — measured here against the steady-state p50 at
+    # the acceptance cadence of 100, stamped into the JSON line.  The
+    # always-on in-graph grad digest is already inside `value` itself.
+    def _sdc_overhead():
+        nonlocal state
+        from raft_tpu.resilience.sdc import (float_bits_hex,
+                                             param_tree_digest)
+
+        t0 = time.perf_counter()
+        host_state = jax.device_get(state)
+        param_tree_digest(host_state.params)
+        state, m = step(state, batch)     # the replayed-step cost
+        float_bits_hex(float(m["grad_digest"]))
+        per_cadence_s = time.perf_counter() - t0
+        cadence = 100
+        pct = 100.0 * per_cadence_s / max(cadence * step_pct["p50"], 1e-9)
+        return {"sdc_vote_every": cadence,
+                "sdc_vote_overhead_pct": round(pct, 3)}
+
+    sdc_metrics = {}
+    try:
+        sdc_metrics = _sdc_overhead()
+    except Exception as e:  # the overhead lane must never sink the bench
+        print(f"sdc overhead bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # Fed variants: identical step, batches produced by the real host
     # pipeline.  Two lanes, so the device-aug win is measured rather
     # than asserted: ``device`` ships raw frames + aug params and runs
@@ -774,6 +803,7 @@ def main():
                             round(fed_pairs_per_s_host, 3),
                         "fed_lane": fed_lane}
                      | serve_metrics | fleet_metrics | stereo_metrics
+                     | sdc_metrics
                      | {"confidence_overhead_pct":
                             confidence_overhead_pct,
                         "fused_update_block": fused}
@@ -804,6 +834,9 @@ def main():
         **stereo_metrics,
         # the uncertainty head's eval-forward cost (percent step delta)
         "confidence_overhead_pct": confidence_overhead_pct,
+        # the silent-corruption defense's per-cadence cost at
+        # --sdc_vote_every 100, as a percent of 100 steps' p50 wall
+        **sdc_metrics,
         # which registered entry point each lane exercises
         "lane_entrypoints": lane_entries,
         "host_cores": os.cpu_count(),

@@ -191,6 +191,22 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "the pre-resilience behavior: non-finite updates "
                         "are applied and only the fatal nonfinite-loss "
                         "incident says so")
+    p.add_argument("--sdc_vote_every", type=int, default=0,
+                   help="silent-corruption detection cadence in steps "
+                        "(resilience/sdc.py): the in-graph gradient "
+                        "digest is checked at metrics-window "
+                        "boundaries, once per boundary on the newest "
+                        "cadence step (effective cadence "
+                        "max(N, --sum_freq)) — cross-replica "
+                        "vote + replay arbitration under a pod, "
+                        "replay-verify sentinel single-process.  A "
+                        "mismatch is a typed sdc-detected / "
+                        "sdc-replay-mismatch incident, quarantines the "
+                        "culprit host and exits rc 13 for a supervised "
+                        "elastic rollback-relaunch "
+                        "(scripts/supervise.py).  0 (default) disables "
+                        "detection; the digest itself always rides the "
+                        "metrics bundle")
     p.add_argument("--keep_ckpts", type=int, default=0,
                    help="keep-last-k retention over step-numbered "
                         "checkpoints (manifests pruned alongside; the "
@@ -314,7 +330,8 @@ def train(args) -> str:
     from raft_tpu.data.loader import prefetch_to_device
     from raft_tpu.models import RAFT
     from raft_tpu.parallel import make_mesh, shard_batch
-    from raft_tpu.parallel.elastic import (AgreementTimeout,
+    from raft_tpu.parallel.elastic import (WATCHDOG_EXIT_CODE,
+                                           AgreementTimeout,
                                            CollectiveWatchdog, PodChannel)
     from raft_tpu.parallel.step import (make_parallel_train_step,
                                         replicate_state)
@@ -641,6 +658,29 @@ def train(args) -> str:
         shard=shard)
     install_preemption_handler()
 
+    # Silent-corruption defense (resilience/sdc.py): harvest the
+    # in-graph grad digest at the window boundary, vote it across the
+    # pod (or replay-verify it single-process) every --sdc_vote_every
+    # steps.  Detection terminates rc 13 with the culprit quarantined,
+    # so the supervisor's elastic relaunch IS the coordinated rollback.
+    sdc = None
+    if args.sdc_vote_every > 0:
+        from raft_tpu.resilience.sdc import SDCPolicy, quarantine_file_path
+
+        sdc = SDCPolicy(
+            args.sdc_vote_every, channel=pod,
+            quarantine_file=quarantine_file_path(train_cfg.checkpoint_dir),
+            place_fn=((lambda hs: replicate_state(hs, mesh))
+                      if mesh is not None else None),
+            timeout_s=args.collective_timeout or 60.0,
+            record=lambda kind, detail: record_incident(kind, detail),
+            window=args.sum_freq)
+        logger.bus.add_window_hook(sdc.on_window)
+        print(f"sdc defense armed: vote/replay every "
+              f"{args.sdc_vote_every} steps"
+              + (f" across {jax.process_count()} processes"
+                 if pod is not None else " (replay-verify sentinel)"))
+
     def save_state_now(path) -> str:
         """Synchronous (rescue/final) save, sharded when the run is."""
         host_state = jax.device_get(state)
@@ -656,9 +696,12 @@ def train(args) -> str:
             s["faults"] = plan.summary()
         if recovery is not None:
             s["recovery"] = recovery.summary()
+        if sdc is not None:
+            s["sdc"] = sdc.summary()
         return s | (extra or {})
 
-    def fatal(kind: str, detail: str) -> SystemExit:
+    def fatal(kind: str, detail: str, exit_code: int = 1,
+              announce: bool = True, step=None) -> SystemExit:
         """Typed-incident termination: ledger says why, exit is nonzero
         — the chaos contract's 'cleanly terminated' leg.  Under a pod
         the fatal is ANNOUNCED first (the divergent-decision fence):
@@ -666,12 +709,19 @@ def train(args) -> str:
         fatal can never leave survivors hanging in a collective or
         silently diverging.  Process 0 owns the coordination service;
         it lingers briefly so peers observe the fence and exit typed
-        BEFORE the service teardown can SIGABRT them."""
-        if pod is not None:
+        BEFORE the service teardown can SIGABRT them.
+
+        ``exit_code``/``announce``/``step`` parameterize the SDC
+        verdicts (resilience/sdc.py): they exit 13 (the supervisor's
+        elastic-resume code) and skip the fence — every process reached
+        the same verdict from the same gathered votes and is already
+        exiting, so an announce would only race duplicate peer-fatal
+        incidents into the teardown."""
+        if pod is not None and announce:
             pod.announce_fatal(kind, detail)
         if watchdog is not None:
             watchdog.stop()
-        record_incident(kind, detail, severity="fatal")
+        record_incident(kind, detail, step=step, severity="fatal")
         logger.close()
         if ledger is not None:
             ledger.close(summary=run_summary({"fatal": kind}))
@@ -686,7 +736,12 @@ def train(args) -> str:
 
                 _time.sleep((watchdog.interval if watchdog is not None
                              else 5.0) * 2)
-            os._exit(1)
+            os._exit(exit_code)
+        if exit_code != 1:
+            # non-default code single-process: SystemExit(str) exits 1,
+            # so the typed detail prints here and the code rides _exit
+            print(f"fatal [{kind}]: {detail}", file=sys.stderr)
+            os._exit(exit_code)
         return SystemExit(f"fatal [{kind}]: {detail}")
 
     # Collective watchdog: converts a wedged/lost host into a typed
@@ -792,7 +847,16 @@ def train(args) -> str:
         # correlate within one ledger.
         health.observe_batch(total_steps + 1, batch)
         batch = plan.poison_batch(total_steps + 1, batch)
+        if sdc is not None and sdc.wants_capture(total_steps + 1):
+            # hold the replay pair BEFORE the step runs (the step
+            # donates its input state): a host copy of the state plus
+            # the batch reference — the boundary's vote arbitration /
+            # replay sentinel re-dispatches exactly this step
+            sdc.capture(total_steps + 1, state, batch)
         state, metrics = step(state, batch)
+        # scripted grad-skew (chaos): scales the published digest scalar
+        # lazily — finite, silent, state untouched
+        metrics = plan.skew_metrics(total_steps + 1, metrics)
         # Device scalars go in as-is; Logger converts at the sum_freq
         # window boundary, so there is no per-step host sync to stall
         # the dispatch pipeline.
@@ -818,6 +882,22 @@ def train(args) -> str:
                     "ckpt-save-failed",
                     f"async checkpoint save failed at step "
                     f"{total_steps}: {type(err).__name__}: {err}")
+            if sdc is not None:
+                # SDC check (window-boundary only): pod vote + replay
+                # arbitration, or the single-process replay sentinel.
+                # A verdict quarantines the culprits and terminates
+                # EVERY process rc 13 — the supervisor's elastic
+                # --resume relaunch from the newest verified checkpoint
+                # is the coordinated rollback (an in-place restore
+                # would keep training on the marginal chip).
+                try:
+                    verdict = sdc.at_boundary(total_steps, step)
+                except AgreementTimeout as e:
+                    raise fatal("host-lost", str(e))
+                if verdict is not None:
+                    raise fatal(verdict["kind"], verdict["detail"],
+                                exit_code=WATCHDOG_EXIT_CODE,
+                                announce=False, step=verdict["step"])
             try:
                 do_rollback = (recovery is not None
                                and recovery.agree_rollback(

@@ -142,6 +142,41 @@ incident                severity  meaning
                                   violated at close (submitted !=
                                   served + typed rejects): a silent
                                   drop crossed the fleet front door
+``sdc-detected``        fatal     the cross-replica gradient-digest
+                                  vote disagreed: a host computed
+                                  finite-but-WRONG values (silent data
+                                  corruption); replay arbitration
+                                  names the culprit, it is quarantined
+                                  and every process exits rc 13.
+                                  Fatal-unless-recovered: the
+                                  supervisor's elastic relaunch from
+                                  the newest verified checkpoint IS
+                                  the recovery, and the relaunched
+                                  run's ledger is its record — this
+                                  run's state is suspect by definition
+``sdc-replay-mismatch`` fatal     the replay-verify sentinel re-ran a
+                                  step from its saved (state, batch)
+                                  pair and the gradient digests
+                                  differ; XLA determinism makes that a
+                                  hardware/runtime fault on this host.
+                                  Same fatal-unless-recovered
+                                  semantics as ``sdc-detected`` (exit
+                                  rc 13, supervised relaunch recovers)
+``sdc-serve-canary``    fatal     a serving golden-input canary digest
+                                  mismatched: a chip is shipping wrong
+                                  flow.  The server recompiles the
+                                  executor and re-checks; a passing
+                                  recheck demotes the record to
+                                  "recovered" (transient/executable
+                                  corruption healed), a failing one
+                                  stays fatal and flips the readiness
+                                  probe so the replica drains
+``crash-loop``          fatal     the run supervisor restarted the run
+                                  K times inside W seconds (or spent
+                                  its restart budget) and terminated
+                                  instead of spinning — the run dies
+                                  faster than it recovers; operator
+                                  attention required
 ======================  ========  =====================================
 
 Append-only by construction: the file is opened in append mode and
@@ -204,6 +239,10 @@ DEFAULT_INCIDENT_SEVERITY = {
     "fleet-drain": "warn",
     "fleet-restart": "recovered",
     "fleet-conservation": "fatal",
+    "sdc-detected": "fatal",
+    "sdc-replay-mismatch": "fatal",
+    "sdc-serve-canary": "fatal",
+    "crash-loop": "fatal",
 }
 
 

@@ -181,6 +181,13 @@ def build_report(records: List[Dict]) -> Dict:
         # run back before it recovered (skips per burst)
         resilience["mean_recovery_latency_steps"] = round(
             recovery_counters.get("skipped_steps", 0) / bursts, 2)
+    # SDC subsection: the silent-corruption defense's counters
+    # (resilience/sdc.py SDCPolicy.summary() via the run_end record) —
+    # votes held, digests compared, replays run, mismatches by kind,
+    # quarantined hosts
+    sdc = (summary or {}).get("sdc")
+    if isinstance(sdc, dict):
+        resilience["sdc"] = sdc
 
     # Serving section: the FlowServer's run_end summary (request
     # conservation counters, latency percentiles, degradation history)
@@ -320,6 +327,7 @@ def merge_serving_sections(per_process_serving: Dict[int, object]) -> Dict:
     pooled: List[float] = []
     pooled_w: List[float] = []
     slo = None
+    canary: Dict[str, int] = {}
     replicas: Dict[str, Dict] = {}
     for pid, runs in sorted(per_process_serving.items()):
         if isinstance(runs, dict):
@@ -352,6 +360,17 @@ def merge_serving_sections(per_process_serving: Dict[int, object]) -> Dict:
             if slo is None and isinstance(s.get("slo_p95_ms"),
                                           (int, float)):
                 slo = s["slo_p95_ms"]
+            for k, v in (s.get("canary") or {}).items():
+                if not isinstance(v, (int, float)):
+                    continue
+                if k == "families":
+                    # a COUNT of distinct golden pairs per replica, not
+                    # a monotonic counter: summing across replicas (or
+                    # a restarted replica's multiple runs) would
+                    # overstate the coverage
+                    canary[k] = max(canary.get(k, 0), int(v))
+                else:
+                    canary[k] = canary.get(k, 0) + int(v)
             p95 = s.get("latency_p95_ms")
             if isinstance(p95, (int, float)) and p95 == p95:
                 row_last_p95 = p95
@@ -362,6 +381,8 @@ def merge_serving_sections(per_process_serving: Dict[int, object]) -> Dict:
         replicas[f"p{pid}"] = row
     merged["replicas"] = replicas
     merged["slo_p95_ms"] = slo
+    if canary:
+        merged["canary"] = canary
     if pooled:
         # graftlint: disable=f64-literal -- host-side latency math
         arr = np.asarray(pooled, dtype=np.float64)
@@ -404,6 +425,8 @@ def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
     by_severity: Dict[str, int] = {}
     faults: Dict[str, int] = {}
     recovery: Dict[str, int] = {}
+    sdc: Dict = {}
+    quarantined: List[str] = []
     for pid, rep in processes.items():
         for row in rep["incidents"]:
             incidents.append(dict(row, process=pid))
@@ -414,6 +437,21 @@ def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
             faults[k] = faults.get(k, 0) + v
         for k, v in (res.get("recovery") or {}).items():
             recovery[k] = recovery.get(k, 0) + v
+        s = res.get("sdc")
+        if s:
+            # pod SDC view: counters sum, mismatch kinds merge, the
+            # quarantine list is the union (every process records the
+            # same verdict; dedup keeps the report readable)
+            for k in ("votes", "digests_compared", "replays"):
+                sdc[k] = sdc.get(k, 0) + s.get(k, 0)
+            if s.get("vote_every"):
+                sdc["vote_every"] = s["vote_every"]
+            for k, v in (s.get("mismatches") or {}).items():
+                m = sdc.setdefault("mismatches", {})
+                m[k] = m.get(k, 0) + v
+            quarantined.extend(s.get("quarantined") or [])
+    if quarantined:
+        sdc["quarantined"] = sorted(set(quarantined))
     incidents.sort(key=lambda r: (r.get("step") or 0, r["process"]))
     # serving summaries come from the RAW records, every run of each
     # ledger (a rolling-restarted replica appends a second run to the
@@ -443,6 +481,7 @@ def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
             "incidents_by_severity": by_severity,
             "unrecovered": by_severity.get("fatal", 0),
             "recovery": recovery,
+            **({"sdc": sdc} if sdc else {}),
         },
     }
 
@@ -520,6 +559,12 @@ def render_pod_report(report: Dict) -> str:
                 f"{row.get('submitted', 0)} submitted  "
                 f"{row.get('rejected_total', 0)} rejected  "
                 f"p95 {_ms(row.get('latency_p95_ms'))}")
+        can = serving.get("canary")
+        if can:
+            lines.append(
+                f"  sdc canary (summed): {can.get('probes', 0)} "
+                f"probe(s)  {can.get('mismatches', 0)} mismatch(es)  "
+                f"{can.get('recompiles', 0)} recompile(s)")
     res = report["resilience"]
     lines.append("")
     lines.append("pod resilience:")
@@ -535,10 +580,29 @@ def render_pod_report(report: Dict) -> str:
             f"  recovery: {rec.get('skipped_steps', 0)} skipped step(s) "
             f"in {rec.get('skip_bursts', 0)} burst(s), "
             f"{rec.get('rollbacks', 0)} rollback(s)")
+    if res.get("sdc"):
+        lines.append(_sdc_line(res["sdc"]))
     if res["unrecovered"]:
         lines.append(f"  UNRECOVERED fatal incidents: "
                      f"{res['unrecovered']}")
     return "\n".join(lines)
+
+
+def _sdc_line(sdc: Dict) -> str:
+    """One report line for the silent-corruption defense counters."""
+    line = (f"  sdc: {sdc.get('votes', 0)} vote(s), "
+            f"{sdc.get('digests_compared', 0)} digest(s) compared, "
+            f"{sdc.get('replays', 0)} replay(s)"
+            + (f" (cadence {sdc['vote_every']} steps)"
+               if sdc.get("vote_every") else ""))
+    mism = sdc.get("mismatches") or {}
+    if mism:
+        line += "   MISMATCHES: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(mism.items()))
+    quar = sdc.get("quarantined") or []
+    if quar:
+        line += f"   quarantined: {', '.join(sorted(set(quar)))}"
+    return line
 
 
 def _fmt_bytes(n: int) -> str:
@@ -618,6 +682,7 @@ def render_report(report: Dict) -> str:
 
     res = report.get("resilience", {})
     if res.get("faults_injected") \
+            or res.get("sdc") \
             or any(res.get("recovery", {}).values()) \
             or any(res.get("incidents_by_severity", {}).values()):
         lines.append("")
@@ -639,6 +704,9 @@ def render_report(report: Dict) -> str:
                 f"{rec.get('rollbacks', 0)} rollback(s)"
                 + (f", mean latency {lat} steps" if lat is not None
                    else ""))
+        sdc = res.get("sdc")
+        if sdc:
+            lines.append(_sdc_line(sdc))
         if res.get("unrecovered", 0):
             lines.append(f"  UNRECOVERED fatal incidents: "
                          f"{res['unrecovered']}")
@@ -704,6 +772,13 @@ def render_report(report: Dict) -> str:
                 f"{aot.get('misses', 0)} cold compile(s) "
                 f"({aot.get('compile_s', 0):.2f} s)  "
                 f"{aot.get('corrupt', 0)} corrupt")
+        canary = serving.get("canary")
+        if canary:
+            lines.append(
+                f"  sdc canary: {canary.get('probes', 0)} probe(s) over "
+                f"{canary.get('families', 0)} golden pair(s)  "
+                f"{canary.get('mismatches', 0)} mismatch(es)  "
+                f"{canary.get('recompiles', 0)} recompile-and-recheck(s)")
 
     means = report["last_window_means"]
     if means:

@@ -13,6 +13,13 @@ nothing corrupts silently:
   passthrough), consecutive skips are counted at the metrics-window
   boundary, and after ``max_skip_steps`` the run rolls back to the
   newest *verified* checkpoint;
+- :mod:`raft_tpu.resilience.sdc` — the silent-corruption defense:
+  cross-replica gradient-digest voting with replay arbitration, the
+  single-process replay-verify sentinel, parameter checksum fences
+  (manifest ``param_digest``), and quarantine bookkeeping;
+- :mod:`raft_tpu.resilience.supervisor` — the crash-loop-aware run
+  supervisor (``scripts/supervise.py``): exit-code-typed restarts,
+  bounded backoff, elastic relaunch excluding quarantined hosts;
 - checkpoint hardening lives with the checkpoints themselves
   (training/state.py: per-save manifest, verify-on-restore,
   fallback restore, keep-last-k retention).
@@ -22,6 +29,8 @@ from raft_tpu.resilience.faults import (Fault, FaultInjectingDataset,
                                         FaultPlan, InjectedFatal,
                                         parse_fault_spec)
 from raft_tpu.resilience.recovery import RecoveryPolicy
+from raft_tpu.resilience.sdc import SDCPolicy, param_tree_digest
+from raft_tpu.resilience.supervisor import (RestartPolicy, RunSupervisor)
 
 __all__ = [
     "Fault",
@@ -29,5 +38,9 @@ __all__ = [
     "FaultPlan",
     "InjectedFatal",
     "RecoveryPolicy",
+    "RestartPolicy",
+    "RunSupervisor",
+    "SDCPolicy",
+    "param_tree_digest",
     "parse_fault_spec",
 ]

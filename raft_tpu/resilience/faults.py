@@ -40,6 +40,27 @@ spec item                       effect
                                 its typed-fatal path); under
                                 multi-process the fatal FENCE must
                                 terminate every peer too
+``grad-skew@S[:P]``             scale process P's published gradient
+                                digest by ``1 + GRAD_SKEW_EPS`` at step
+                                S (P defaults to 0) — finite, silent,
+                                invisible to the nonfinite sentinel;
+                                only the SDC detectors
+                                (resilience/sdc.py: cross-replica vote
+                                under a pod, replay-verify sentinel
+                                single-process) can see it.  Training
+                                state is untouched, so the
+                                post-detection rollback-relaunch
+                                replays the exact unkilled trajectory
+``param-flip@K``                re-serialize the K-th completed
+                                checkpoint save with ONE bit flipped in
+                                one param leaf and a manifest whose
+                                size/sha256 match the corrupted bytes —
+                                byte-level integrity verifies clean, so
+                                only the manifest's ``param_digest``
+                                fence (training/state.py) catches it at
+                                restore.  Models a marginal chip/host
+                                corrupting values BEFORE the checksum
+                                was computed
 ==============================  ==========================================
 
 Everything is deterministic: the plan is pure state derived from the
@@ -58,7 +79,12 @@ import time
 from typing import Callable, Dict, List, Optional
 
 FAULT_KINDS = ("sigterm", "ckpt-torn", "sample-ioerror", "nonfinite-burst",
-               "stall", "host-fatal")
+               "stall", "host-fatal", "grad-skew", "param-flip")
+
+# The grad-skew multiplier: small enough to be "plausibly wrong"
+# (a marginal chip, not a NaN), large enough that an f32 abs-sum
+# digest provably changes bits when scaled by it.
+GRAD_SKEW_EPS = 1e-3
 
 
 class InjectedFatal(RuntimeError):
@@ -108,11 +134,15 @@ def parse_fault_spec(spec: Optional[str]) -> List[Fault]:
         arg_s, _, count_s = args.partition(":")
         try:
             arg = int(arg_s)
-            count = int(count_s) if count_s else 1
+            # grad-skew's second field is a PROCESS INDEX (0-based,
+            # default 0), not a count
+            count = (int(count_s) if count_s
+                     else (0 if kind == "grad-skew" else 1))
         except ValueError:
             raise ValueError(
                 f"fault spec item {item!r}: arg/count must be integers")
-        if arg < (0 if kind == "sample-ioerror" else 1) or count < 1:
+        min_count = 0 if kind == "grad-skew" else 1
+        if arg < (0 if kind == "sample-ioerror" else 1) or count < min_count:
             raise ValueError(
                 f"fault spec item {item!r}: arg/count out of range")
         faults.append(Fault(kind, arg, count))
@@ -182,6 +212,10 @@ class FaultPlan:
         self._saves_seen = 0
         self._torn_ordinals = {f.arg for f in faults
                                if f.kind == "ckpt-torn"}
+        self._flip_ordinals = {f.arg for f in faults
+                               if f.kind == "param-flip"}
+        self._skew_steps = {f.arg: f.count for f in faults
+                            if f.kind == "grad-skew"}
         self._sigterm_steps = {f.arg for f in faults if f.kind == "sigterm"}
         self._stall_steps = {f.arg for f in faults if f.kind == "stall"}
         self._fatal_steps = {f.arg for f in faults
@@ -262,12 +296,41 @@ class FaultPlan:
         batch["flow"] = batch["flow"] * jnp.float32(jnp.nan)
         return batch
 
+    def skew_metrics(self, step: int, metrics):
+        """``grad-skew``: scale this step's published gradient digest by
+        ``1 + GRAD_SKEW_EPS`` on the targeted process — finite, silent,
+        and invisible to the nonfinite sentinel; only the SDC detectors
+        can see it.  The skew multiplies the lazily-held device scalar
+        (no host sync) and never touches training state, so a
+        post-detection rollback replays the exact unkilled trajectory."""
+        proc = self._skew_steps.get(step)
+        if proc is None or "grad_digest" not in metrics:
+            return metrics
+        import jax
+
+        if jax.process_index() != proc:
+            return metrics
+        self.injected["grad-skew"] += 1
+        self._note(f"grad-skew: scaling the published gradient digest "
+                   f"by 1+{GRAD_SKEW_EPS} at step {step} on process "
+                   f"{proc} (finite, silent — only the SDC vote/replay "
+                   f"detectors can see this)")
+        metrics = dict(metrics)
+        metrics["grad_digest"] = metrics["grad_digest"] * (1.0
+                                                          + GRAD_SKEW_EPS)
+        return metrics
+
     def after_checkpoint_save(self, path: str) -> None:
         """``ckpt-torn``: after the K-th completed save's atomic rename,
         truncate the file to half its bytes — simulating at-rest
         corruption that the rename protocol cannot prevent and only
-        verify-on-restore can catch."""
+        verify-on-restore can catch.  ``param-flip``: re-serialize the
+        K-th save with one bit flipped in one param leaf and a manifest
+        re-hashed to match — internally-consistent bytes only the
+        param-digest fence can reject."""
         self._saves_seen += 1
+        if self._saves_seen in self._flip_ordinals:
+            self._flip_param(path)
         if self._saves_seen not in self._torn_ordinals:
             return
         self.injected["ckpt-torn"] += 1
@@ -276,6 +339,74 @@ class FaultPlan:
             f.truncate(max(size // 2, 1))
         self._note(f"ckpt-torn: truncated save #{self._saves_seen} "
                    f"({path}) from {size} to {max(size // 2, 1)} bytes")
+
+    def _flip_param(self, path: str) -> None:
+        """The ``param-flip`` body: silent value corruption on the save
+        path.  The manifest's size/sha256 are REWRITTEN to match the
+        corrupted bytes (the corruption happened before hashing, as a
+        bad host/chip would), while the value-level ``param_digest``
+        the save computed from the true state is PRESERVED — so byte
+        verification passes and only the checksum fence
+        (training/state.py restore path) catches the lie."""
+        import hashlib
+        import json
+
+        import flax
+        import numpy as np
+
+        with open(path, "rb") as f:
+            payload = flax.serialization.msgpack_restore(f.read())
+
+        def flip_first(container, keys):
+            """Flip one mantissa LSB in the first float array leaf along
+            ``keys`` order — deterministic across runs."""
+            for k in keys:
+                v = container[k]
+                if isinstance(v, dict):
+                    if flip_first(v, sorted(v)):
+                        return True
+                    continue
+                arr = np.asarray(v) if v is not None else None
+                if arr is None or not arr.size \
+                        or not np.issubdtype(arr.dtype, np.floating):
+                    continue
+                flipped = np.array(arr)   # writable copy
+                raw = flipped.view(np.uint8).reshape(-1)
+                raw[0] ^= 1               # one mantissa LSB
+                container[k] = flipped
+                return True
+            return False
+
+        # Flip inside the PARAMS subtree: that is what the manifest's
+        # param_digest fences (an opt-state flip is invisible to it —
+        # coverage there is the pod vote's online digest).  Root keys
+        # starting with "params" sort first so both the nested
+        # single-file payload ({"params": {...}}) and the flat sharded
+        # one ({"params/...": arr}) flip a genuine parameter.
+        root_keys = sorted(payload, key=lambda k:
+                           (not str(k).startswith("params"), str(k)))
+        if not flip_first(payload, root_keys):
+            self._note(f"param-flip: no float param leaf found in "
+                       f"{path}; injection skipped")
+            return
+        data = flax.serialization.msgpack_serialize(payload)
+        with open(path, "wb") as f:
+            f.write(data)
+        mpath = path + ".manifest.json"
+        if os.path.isfile(mpath):
+            with open(mpath, encoding="utf-8") as f:
+                manifest = json.load(f)
+            manifest["size"] = len(data)
+            manifest["sha256"] = hashlib.sha256(data).hexdigest()
+            # param_digest deliberately NOT recomputed: it pins the
+            # values the save actually held
+            with open(mpath, "w", encoding="utf-8") as f:
+                json.dump(manifest, f, sort_keys=True)
+        self.injected["param-flip"] += 1
+        self._note(f"param-flip: flipped one param bit in save "
+                   f"#{self._saves_seen} ({path}) and re-hashed its "
+                   f"manifest — byte integrity verifies clean; only the "
+                   f"param-digest fence can reject this checkpoint")
 
     # -- reporting -----------------------------------------------------------
 

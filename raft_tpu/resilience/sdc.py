@@ -1,0 +1,396 @@
+"""Silent-corruption defense: digests, cross-replica voting, the
+replay-verify sentinel, and quarantine bookkeeping.
+
+PRs 6/7 made every *loud* fault recover-or-terminate-typed; this module
+covers the fault class the nonfinite sentinel can never see — a marginal
+chip returning finite-but-WRONG values (Hochschild et al., "Cores that
+don't count", HotOS'21).  Four layers, each with a deterministic
+injectable trigger in resilience/faults.py:
+
+1. **Cross-replica gradient voting** (pod runs).  The train step folds a
+   cheap in-graph digest of the gradient tree into its metrics bundle
+   (training/step.py ``grad_digest``: f32 abs-sum, reduces only — no new
+   collectives on any entry by construction).  Under data parallelism
+   the post-allreduce gradients are replicated, so every process's
+   digest is bit-identical by construction; at ``--sdc_vote_every N``
+   cadence steps (compared at the next metrics-window boundary, honoring
+   the one-host-sync-per-window discipline) each process publishes its
+   digest bits through the PR 7 :class:`PodChannel` and any disagreement
+   is a silent-corruption verdict.
+
+   Coverage boundary, stated plainly: the vote sees divergence in what
+   each host computes AFTER the gradient allreduce (the digest/optimizer
+   math, replicated-state drift — the param digest rides the same vote).
+   Corruption injected into one replica's local gradient shard BEFORE
+   the allreduce is mixed into every replica identically by the psum and
+   is invisible to the vote; its durable form (wrong values reaching
+   params) is what the parameter checksum fence (layer 3) and the online
+   param-digest vote exist to catch at the next cadence/checkpoint
+   cycle, and a transiently-flaky host is what the replay sentinel
+   catches on single-host shifts.  No digest compare can distinguish
+   "every replica agreed on a wrong psum" from a right one — that class
+   needs redundant computation (run the step twice), which is exactly
+   what the replay sentinel does at cadence where it is affordable.
+
+2. **Replay arbitration / replay-verify sentinel.**  Every cadence step
+   is captured pre-step (host copy of the state + the batch reference).
+   Single-process runs replay the captured step at the boundary and
+   compare digests bit-exact — XLA determinism makes any divergence a
+   hardware/runtime fault (``sdc-replay-mismatch``).  Under a pod the
+   same replay runs only AFTER a vote disagreed, as the localizer: every
+   process replays in lockstep (they reached the same gathered verdict),
+   and the process whose replay disagrees with its own recorded digest
+   is the faulty one — which is what lets a 2-process pod localize a
+   minority that a bare majority vote cannot (``sdc-detected`` names the
+   culprits).
+
+3. **Parameter checksum fence** (training/state.py).  Checkpoint
+   manifests already pin sha256 of the serialized bytes; they now also
+   carry :func:`param_tree_digest` of the parameter VALUES, computed
+   before serialization — corruption on the serialize path leaves
+   internally-consistent bytes (size + sha256 verify clean) that only
+   the value digest can catch.  ``restore_latest_verified`` re-verifies
+   it, and the pod vote compares it online (each process's vote message
+   carries its param digest), so corruption landing *between*
+   checkpoints cannot survive a rollback cycle undetected.
+
+4. **Serving canary** (serve/server.py): a periodic golden-input probe
+   per bucket family, checked off the hot path, firing a typed
+   ``sdc-serve-canary`` + executor recompile-and-recheck before a flaky
+   chip ships wrong flow.
+
+On detection the choreography is the PR 7 agreement pattern: quarantine
+the culprit host (:func:`write_quarantine` — the run supervisor excludes
+it from the next elastic relaunch), record the typed incident, and
+terminate every process with exit code 13 (the host-lost family), so the
+supervisor (resilience/supervisor.py) rolls the pod back to the newest
+verified checkpoint via an elastic ``--resume`` relaunch.  Rollback is a
+RESTART on purpose: an in-place restore would keep training on the
+marginal chip that just corrupted a gradient.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import struct
+from typing import Callable, Dict, List, Optional
+
+QUARANTINE_FILE = "quarantine.json"
+QUARANTINE_VERSION = 1
+
+
+def float_bits_hex(v: float) -> str:
+    """Bit-exact wire form of an f32 digest scalar.  Votes and replay
+    comparisons must be BIT comparisons — a stringified float rounds,
+    and a 1-ulp corruption is still corruption."""
+    return struct.pack("<f", float(v)).hex()
+
+
+def param_tree_digest(tree) -> int:
+    """Order-sensitive uint32 digest of every array leaf's exact bytes.
+
+    Per leaf: byte-sum (mod 2**32) of the raw buffer — any single
+    flipped bit changes exactly one byte by a nonzero delta, so a
+    single-bit corruption is always detected; the running total is
+    FNV-style mixed between leaves so swapped or resized leaves change
+    the digest too.  Pure host math over ``device_get`` values: the
+    digest pins the VALUES about to be serialized (or just restored),
+    which is exactly the span sha256-of-bytes cannot cover — bytes
+    corrupted before hashing hash "clean".
+    """
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.size == 0:
+            continue
+        buf = np.ascontiguousarray(arr).view(np.uint8)
+        total = (total * 16777619 + arr.size) & 0xFFFFFFFF
+        total = (total + int(buf.sum(dtype=np.uint64))) & 0xFFFFFFFF
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Quarantine bookkeeping (shared by the train CLI and the supervisor)
+# ---------------------------------------------------------------------------
+
+def quarantine_file_path(checkpoint_dir: str) -> str:
+    """The run's quarantine ledger: next to the checkpoints, because the
+    supervisor that reads it already knows the checkpoint dir."""
+    return os.path.join(checkpoint_dir, QUARANTINE_FILE)
+
+
+def read_quarantine(path: Optional[str]) -> List[Dict]:
+    """Quarantined-host entries (``{"process": int, "detail": str}``),
+    or [] when the file is absent/unreadable — a missing quarantine
+    ledger means nothing is quarantined, never an error."""
+    if not path or not os.path.isfile(path):
+        return []
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return []
+    entries = doc.get("quarantined", []) if isinstance(doc, dict) else []
+    return [e for e in entries
+            if isinstance(e, dict) and isinstance(e.get("process"), int)]
+
+
+def write_quarantine(path: str, processes, detail: str) -> List[Dict]:
+    """Merge ``processes`` into the quarantine file (atomic replace).
+
+    Idempotent and union-only: every pod process writes the same verdict
+    at the same boundary, so concurrent writers converge on identical
+    content; un-quarantining is an operator action (delete the file),
+    not something a run decides for itself.
+    """
+    entries = read_quarantine(path)
+    known = {e["process"] for e in entries}
+    for p in processes:
+        if int(p) not in known:
+            entries.append({"process": int(p), "detail": detail})
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"v": QUARANTINE_VERSION, "quarantined": entries}, f,
+                  sort_keys=True)
+    os.replace(tmp, path)
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# The loop-side policy
+# ---------------------------------------------------------------------------
+
+class SDCPolicy:
+    """The train loop's silent-corruption detector.
+
+    Wire-up (cli/train.py):
+
+    - ``on_window`` goes on the metrics bus (it harvests the in-graph
+      ``grad_digest`` host values the boundary conversion already paid
+      for);
+    - ``wants_capture``/``capture`` bracket the step call at cadence
+      steps (capture is a ``device_get`` of the pre-step state plus the
+      batch reference — the replay pair);
+    - ``at_boundary`` runs at metrics-window boundaries and returns
+      ``None`` (healthy) or a verdict dict ``{kind, step, detail,
+      culprits}`` — the caller records the typed incident and terminates
+      with exit code 13 so the supervisor performs the elastic
+      rollback-relaunch.
+
+    ``channel`` (a PR 7 ``PodChannel``) selects the mode: voting +
+    replay arbitration under a pod, replay-verify sentinel alone
+    single-process.  ``place_fn`` re-places a host state copy for the
+    replay dispatch (``replicate_state`` under a mesh; identity
+    otherwise).  Gathers raise the channel's ``AgreementTimeout`` —
+    callers escalate to host-lost exactly like every other agreement.
+    """
+
+    def __init__(self, vote_every: int, channel=None,
+                 quarantine_file: Optional[str] = None,
+                 place_fn: Optional[Callable] = None,
+                 timeout_s: float = 60.0,
+                 record: Optional[Callable[[str, str], None]] = None,
+                 window: int = 1):
+        if vote_every < 1:
+            raise ValueError(f"vote_every must be >= 1, got {vote_every} "
+                             f"(0 disables SDC detection at the CLI)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.vote_every = int(vote_every)
+        # the metrics-window size (--sum_freq): checks happen at window
+        # boundaries only, so the EFFECTIVE cadence is max(vote_every,
+        # window) — one vote per boundary, on the newest cadence step.
+        # wants_capture() therefore captures ONLY that step: a capture
+        # is a full-state device_get (the policy's dominant cost), and
+        # paying it for cadence steps whose digest will never be
+        # checked would silently multiply the overhead at
+        # vote_every < sum_freq.
+        self.window = int(window)
+        self.channel = channel
+        self.quarantine_file = quarantine_file
+        self.place_fn = place_fn
+        self.timeout_s = float(timeout_s)
+        self._record = record
+        self.process_index = (channel.process_index
+                              if channel is not None else 0)
+        # counters for the run_end summary's "sdc" section
+        self.votes = 0
+        self.digests_compared = 0
+        self.replays = 0
+        self.mismatches: Dict[str, int] = {}
+        self.quarantined: List[str] = []
+        self._digests: Dict[int, float] = {}
+        self._captured = None        # (step, host_state, batch)
+
+    # -- loop hooks ----------------------------------------------------------
+
+    def on_window(self, first_step: int,
+                  per_step: List[Dict[str, float]]) -> None:
+        """MetricsBus window hook: keep the cadence steps' just-converted
+        ``grad_digest`` host values (zero extra host syncs)."""
+        for i, m in enumerate(per_step):
+            s = first_step + i
+            if s % self.vote_every == 0 and "grad_digest" in m:
+                self._digests[s] = m["grad_digest"]
+
+    def wants_capture(self, step: int) -> bool:
+        """True for the cadence step a boundary will actually check:
+        the LAST multiple of ``vote_every`` inside ``step``'s metrics
+        window — earlier cadence steps in the same window would pay the
+        device_get capture for a digest ``at_boundary`` never votes."""
+        if step % self.vote_every:
+            return False
+        window_end = ((step + self.window - 1) // self.window) * self.window
+        return step + self.vote_every > window_end
+
+    def capture(self, step: int, state, batch) -> None:
+        """Hold the replay pair for cadence step ``step``: a host copy
+        of the PRE-step state (the step may donate its input buffers)
+        plus the batch reference (batches are never donated).  Cost: one
+        ``device_get`` per cadence step — the dominant term in the
+        digest-cadence overhead, which bench.py stamps."""
+        import jax
+
+        self._captured = (int(step), jax.device_get(state), batch)
+
+    # -- the boundary decision ----------------------------------------------
+
+    def at_boundary(self, step: int, step_fn) -> Optional[Dict]:
+        """Run the due vote/replay for the newest pending cadence step.
+        Returns None when healthy, else the verdict dict.  ``step_fn``
+        is the live train step (replays dispatch through the exact
+        executable the original step used)."""
+        if not self._digests:
+            return None
+        s = max(self._digests)
+        digest = self._digests[s]
+        self._digests.clear()
+        if self.channel is None:
+            return self._replay_verdict(s, digest, step_fn)
+        return self._vote_verdict(s, digest, step_fn)
+
+    def _replay(self, step_fn) -> float:
+        """Re-dispatch the captured step; returns the replayed digest.
+        The placed copy is independent of live training state, so the
+        executable's donation semantics destroy only the copy."""
+        _, host_state, batch = self._captured
+        state = (self.place_fn(host_state) if self.place_fn is not None
+                 else host_state)
+        _, metrics = step_fn(state, batch)
+        return float(metrics["grad_digest"])
+
+    def _replay_verdict(self, s: int, recorded: float,
+                        step_fn) -> Optional[Dict]:
+        if self._captured is None or self._captured[0] != s:
+            return None              # nothing held for this step
+        self.replays += 1
+        replayed = self._replay(step_fn)
+        self._captured = None
+        rec_hex, rep_hex = float_bits_hex(recorded), float_bits_hex(replayed)
+        if rec_hex == rep_hex:
+            return None
+        self.mismatches["sdc-replay-mismatch"] = \
+            self.mismatches.get("sdc-replay-mismatch", 0) + 1
+        return {
+            "kind": "sdc-replay-mismatch", "step": s,
+            "culprits": [self.process_index],
+            "detail": (
+                f"replay-verify sentinel: step {s} recomputed from its "
+                f"saved (state, batch) pair produced gradient digest "
+                f"0x{rep_hex} != recorded 0x{rec_hex}; XLA determinism "
+                f"makes this a hardware/runtime fault on this host — "
+                f"terminating rc 13 for a supervised rollback-relaunch "
+                f"from the newest verified checkpoint"),
+        }
+
+    def _vote_verdict(self, s: int, digest: float,
+                      step_fn) -> Optional[Dict]:
+        """The pod vote: digest bits + param digest gathered under a
+        one-shot per-step key; disagreement triggers the lockstep replay
+        arbitration that localizes the culprit."""
+        pd = (param_tree_digest(self._captured[1].params)
+              if self._captured is not None and self._captured[0] == s
+              else 0)
+        value = f"{float_bits_hex(digest)}/{pd:08x}"
+        votes = self.channel.gather(f"sdc@{s}", value, self.timeout_s)
+        self.votes += 1
+        self.digests_compared += len(votes)
+        if len(set(votes.values())) == 1:
+            self._captured = None
+            return None
+        # Disagreement.  Every process reached this same verdict from
+        # the same gathered votes, so all replay in lockstep (the
+        # replayed step's collectives line up) and exchange self-blame:
+        # the process whose replay disagrees with its own recorded
+        # digest is the one whose hardware computed something else.
+        self_bad = False
+        if self._captured is not None and self._captured[0] == s:
+            self.replays += 1
+            replayed = self._replay(step_fn)
+            self_bad = float_bits_hex(replayed) != float_bits_hex(digest)
+        self._captured = None
+        blame = self.channel.gather(f"sdcblame@{s}",
+                                    "1" if self_bad else "0",
+                                    self.timeout_s)
+        culprits = sorted(pid for pid, v in blame.items() if v == "1")
+        how = "replay arbitration names"
+        if not culprits:
+            # replay exonerated everyone (e.g. the param digests split,
+            # not the grad digests): fall back to digest minority;
+            # an unbreakable tie quarantines every disagreeing voter —
+            # over-quarantine is recoverable (operator deletes the
+            # file), training on a corrupting host is not
+            counts = collections.Counter(votes.values())
+            top = max(counts.values())
+            culprits = sorted(pid for pid, v in votes.items()
+                              if counts[v] < top)
+            how = "digest minority names"
+            if not culprits:
+                culprits = sorted(votes)
+                how = "tie — cannot localize; quarantining all voters:"
+        self.mismatches["sdc-detected"] = \
+            self.mismatches.get("sdc-detected", 0) + 1
+        names = [f"p{i}" for i in culprits]
+        short = {f"p{pid}": v[:8] for pid, v in sorted(votes.items())}
+        detail = (
+            f"cross-replica gradient vote at step {s} disagreed "
+            f"(digest bits by process: {short}); {how} {', '.join(names)} "
+            f"— quarantined for the next elastic relaunch; terminating "
+            f"rc 13 so the supervisor rolls the pod back to the newest "
+            f"verified checkpoint without the marginal host")
+        self.quarantined.extend(names)
+        if self.quarantine_file:
+            try:
+                write_quarantine(self.quarantine_file, culprits, detail)
+            except OSError as e:
+                # an unwritable quarantine file must not mask the
+                # detection itself — the incident and rc 13 still fire
+                if self._record is not None:
+                    self._record("sdc-detected",
+                                 f"quarantine file {self.quarantine_file} "
+                                 f"unwritable ({e}); verdict stands")
+        return {"kind": "sdc-detected", "step": s, "culprits": culprits,
+                "detail": detail}
+
+    # -- reporting -----------------------------------------------------------
+
+    def summary(self) -> Dict:
+        """Counters for the ledger's run_end record (the obs report's
+        SDC subsection)."""
+        out = {
+            "vote_every": self.vote_every,
+            "votes": self.votes,
+            "digests_compared": self.digests_compared,
+            "replays": self.replays,
+        }
+        if self.mismatches:
+            out["mismatches"] = dict(self.mismatches)
+        if self.quarantined:
+            out["quarantined"] = list(self.quarantined)
+        return out

@@ -1,0 +1,191 @@
+"""Crash-loop-aware run supervisor: recover-or-terminate becomes
+recover-or-RESTART.
+
+PRs 6/7 taught every fault path to exit typed — rc 0 after a rescue
+save, rc 13 (:data:`ELASTIC_RESUME_EXIT_CODE`) from the collective
+watchdog and the SDC detectors, rc 1 from the typed-fatal path — but
+relaunching was something only the test harness knew how to do.  This
+module is the product form: a supervisor that wraps the train CLI with
+an exit-code-typed restart policy, bounded exponential backoff, and a
+crash-loop fence.
+
+Exit-code policy (the contract the train CLI already speaks):
+
+==============  ===========================================================
+child exit      supervisor action
+==============  ===========================================================
+0               done — the schedule completed (or a rescue save landed
+                and a previous attempt's resume finished it)
+13              elastic resume: re-read the quarantine file
+                (resilience/sdc.py), relaunch with ``--resume`` minus the
+                quarantined hosts — host-lost, peer-fatal and the SDC
+                detectors all exit 13 precisely so one policy covers them
+< 0 (signal)    external kill (preemption that bypassed the handler, OOM
+                killer): relaunch with ``--resume``
+anything else   stop, pass the code through — typed fatals (1) and usage
+                errors (2) are config/data problems a restart cannot fix,
+                and retrying them forever is the crash loop this module
+                exists to fence
+==============  ===========================================================
+
+The crash-loop fence: when the policy would perform restart number K
+within a sliding W-second window (or the total restart budget is
+spent), the supervisor records a typed ``crash-loop`` incident and
+terminates with :data:`CRASH_LOOP_EXIT_CODE` — bounded, loud, and
+gateable by ``obs report --fail-on-incident fatal``, never an infinite
+relaunch-and-die spin.
+
+``launch`` is injected (an ``Attempt -> int`` callable), so the policy
+is unit-testable without subprocesses; ``scripts/supervise.py`` provides
+the real launcher (single command or an N-rank gloo pod).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+from raft_tpu.resilience.sdc import read_quarantine
+
+logger = logging.getLogger(__name__)
+
+# rc 13: "this host count / this hardware set is wrong, the state is
+# protected — relaunch me elastically".  One code shared by the
+# collective watchdog (host lost), the SDC vote (chip quarantined) and
+# the replay sentinel, because the supervisor's remedy is identical.
+# Numerically pinned to parallel/elastic.py WATCHDOG_EXIT_CODE without
+# importing it: the supervisor is a driver-side module and importing
+# raft_tpu.parallel drags jax into every scripts/supervise.py startup
+# (test-pinned equal in tests/test_sdc.py).
+ELASTIC_RESUME_EXIT_CODE = 13
+
+# Distinct from the child's codes (0/1/2/13/14) so a wrapper script can
+# tell "the child was fatal" from "the SUPERVISOR gave up".
+CRASH_LOOP_EXIT_CODE = 15
+
+
+@dataclasses.dataclass(frozen=True)
+class Attempt:
+    """One launch of the supervised command."""
+
+    index: int                   # 0 = first launch, >0 = restart number
+    resume: bool                 # restarts resume; the first launch may
+    excluded: List[int]          # quarantined process indices to drop
+
+
+@dataclasses.dataclass
+class RestartPolicy:
+    """Bounded exponential backoff + the crash-loop fence parameters."""
+
+    max_restarts: int = 8
+    backoff_base_s: float = 1.0
+    backoff_cap_s: float = 60.0
+    crash_loop_restarts: int = 3
+    crash_loop_window_s: float = 300.0
+
+    def backoff_s(self, restart_index: int) -> float:
+        """Sleep before restart ``restart_index`` (1-based): base *
+        2**(i-1), capped."""
+        return min(self.backoff_cap_s,
+                   self.backoff_base_s * (2.0 ** max(restart_index - 1, 0)))
+
+
+class RunSupervisor:
+    """Drives ``launch`` under the restart policy until done/stop/fence.
+
+    ``record(kind, detail)`` receives the typed ``crash-loop`` incident
+    (scripts/supervise.py wires it to an obs RunLedger so
+    ``--fail-on-incident fatal`` gates it); ``clock``/``sleep`` are
+    injectable for tests.
+    """
+
+    def __init__(self, launch: Callable[[Attempt], int],
+                 policy: Optional[RestartPolicy] = None,
+                 quarantine_file: Optional[str] = None,
+                 record: Optional[Callable[[str, str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._launch = launch
+        self.policy = policy or RestartPolicy()
+        self.quarantine_file = quarantine_file
+        self._record = record
+        self._clock = clock
+        self._sleep = sleep
+        self.attempts = 0
+        self.restarts = 0
+        self.history: List[Dict] = []    # per-attempt {rc, verdict}
+
+    @staticmethod
+    def classify(rc: int) -> str:
+        """'done' | 'restart' | 'stop' per the policy table above."""
+        if rc == 0:
+            return "done"
+        if rc == ELASTIC_RESUME_EXIT_CODE or rc < 0:
+            return "restart"
+        return "stop"
+
+    def excluded(self) -> List[int]:
+        """Quarantined process indices, re-read before every launch —
+        a vote that fired DURING the last attempt must shape the next."""
+        return sorted({e["process"]
+                       for e in read_quarantine(self.quarantine_file)})
+
+    def _crash_loop(self, detail: str) -> int:
+        logger.error("supervisor crash-loop fence: %s", detail)
+        if self._record is not None:
+            self._record("crash-loop", detail)
+        return CRASH_LOOP_EXIT_CODE
+
+    def run(self) -> int:
+        """Supervise until done (0), stop (child's rc), or the fence
+        trips (:data:`CRASH_LOOP_EXIT_CODE`)."""
+        restart_times: List[float] = []
+        while True:
+            attempt = Attempt(index=self.attempts,
+                              resume=self.attempts > 0,
+                              excluded=self.excluded())
+            self.attempts += 1
+            rc = self._launch(attempt)
+            verdict = self.classify(rc)
+            self.history.append({"rc": rc, "verdict": verdict})
+            if verdict == "done":
+                return 0
+            if verdict == "stop":
+                logger.error("supervisor: child exited %d (typed fatal/"
+                             "config); a restart cannot fix this — "
+                             "stopping", rc)
+                return rc
+            # restart path: fence first, then bounded backoff
+            now = self._clock()
+            window = self.policy.crash_loop_window_s
+            restart_times = [t for t in restart_times if now - t <= window]
+            if len(restart_times) + 1 > self.policy.crash_loop_restarts:
+                return self._crash_loop(
+                    f"{len(restart_times) + 1} restarts inside "
+                    f"{window:.0f}s (policy allows "
+                    f"{self.policy.crash_loop_restarts}): the run dies "
+                    f"faster than it recovers — terminating instead of "
+                    f"spinning (last child rc {rc})")
+            if self.restarts + 1 > self.policy.max_restarts:
+                return self._crash_loop(
+                    f"restart budget exhausted ({self.policy.max_restarts} "
+                    f"total): terminating (last child rc {rc})")
+            self.restarts += 1
+            restart_times.append(now)
+            delay = self.policy.backoff_s(self.restarts)
+            logger.warning("supervisor: child exited %d -> restart #%d "
+                           "with --resume in %.1fs (excluded: %s)",
+                           rc, self.restarts, delay,
+                           self.excluded() or "none")
+            if delay > 0:
+                self._sleep(delay)
+
+    def summary(self) -> Dict:
+        return {
+            "attempts": self.attempts,
+            "restarts": self.restarts,
+            "history": list(self.history),
+            "excluded": self.excluded(),
+        }

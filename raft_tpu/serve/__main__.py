@@ -37,6 +37,12 @@ the dispatch watchdog declares a wedge; 2 usage.
 - ``stall``          wedge the first dispatch forever: the watchdog
                      must convert the hang into ``serve-stalled`` +
                      exit 14 (pair with --watchdog_timeout)
+- ``canary-flip``    after warmup, the flow engine starts scaling its
+                     outputs by 1+1e-3 (finite, silent — a flaky chip)
+                     until an executor recompile heals it: the SDC
+                     canary (--canary_every) must catch the digest
+                     mismatch, recompile-and-recheck, and record a
+                     recovered ``sdc-serve-canary``
 
 ``--stereo_every N`` makes the session heterogeneous: every Nth
 request routes to a stereo disparity engine (workloads/stereo.py)
@@ -83,7 +89,7 @@ def parse_inject(spec):
         return None, 0
     kind, _, arg = spec.partition("@")
     kinds = ("overload", "deadline-storm", "poison", "sigkill", "stall",
-             "kill-replica", "rolling-restart")
+             "kill-replica", "rolling-restart", "canary-flip")
     if kind not in kinds:
         raise ValueError(f"unknown serve inject {kind!r} "
                          f"(known: {', '.join(kinds)})")
@@ -132,6 +138,72 @@ def _stereo_engine_builder(init_img, seed: int, batch_size: int, aot):
     return make
 
 
+def run_load(args, inject, inject_arg, hw, submit, on_served,
+             after_chunk=None):
+    """The synthetic load loop every session shape shares (single
+    server and fleet — the duplicated ~70-line driver PR 14 recorded as
+    known debt, folded here).  Builds each request deterministically
+    from ``--seed`` (frames, poison placement, stream assignment,
+    stereo routing, deadline storm), submits through ``submit(img1,
+    img2, deadline_ms, stream, workload)`` — typed admission rejections
+    are already counted by the server and simply skipped here — and
+    reaps completed futures chunk-wise in paced mode (calling
+    ``on_served(latency_s)`` per success) or all at the end under
+    ``--inject overload``.  ``after_chunk`` runs after each paced reap
+    (the fleet's chaos-event hook)."""
+    import numpy as np
+
+    from raft_tpu.serve import RequestError
+
+    H, W = hw
+    rng = np.random.default_rng(args.seed)
+    futures = []
+    reaped = 0
+
+    def frame():
+        return rng.integers(0, 255, (H, W, 3)).astype(np.float32)
+
+    def reap(upto):
+        nonlocal reaped
+        for f, t_sub in futures[reaped:upto]:
+            if f is None:
+                continue
+            try:
+                f.result(timeout=600)
+            except RequestError:
+                continue
+            on_served(time.perf_counter() - t_sub)
+        reaped = max(reaped, upto)
+
+    for i in range(args.requests):
+        img1, img2 = frame(), frame()
+        if inject == "poison" and i == inject_arg:
+            img1 = img1.copy()
+            img1[0, 0, 0] = np.nan
+        stream = (f"s{i % args.video_streams}"
+                  if args.video_streams else None)
+        workload = ("stereo" if args.stereo_every
+                    and (i % args.stereo_every) == args.stereo_every - 1
+                    else "flow")
+        deadline = args.deadline_ms
+        if inject == "deadline-storm":
+            deadline = -1.0            # already expired at submit: the
+            # assembly/boundary deadline check MUST shed it pre-dispatch
+            # regardless of how fast the batcher wakes
+        try:
+            futures.append((submit(img1, img2, deadline, stream,
+                                   workload), time.perf_counter()))
+        except RequestError:           # typed shed, already counted
+            futures.append((None, 0.0))
+        if inject != "overload" and (i + 1) % args.batch_size == 0:
+            # paced mode: wait out the chunk so the queue never backs
+            # up; overload mode slams the whole burst in at once
+            reap(len(futures))
+            if after_chunk is not None:
+                after_chunk()
+    reap(len(futures))
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(
         "python -m raft_tpu.serve",
@@ -172,6 +244,12 @@ def parse_args(argv=None):
                         "(default: the ladder's smallest level)")
     p.add_argument("--warm_iters", type=int, default=None,
                    help="iteration floor for fully-warm video batches")
+    p.add_argument("--canary_every", type=int, default=0,
+                   help="SDC serving canary cadence in batches: probe a "
+                        "cached golden input per (workload, family) "
+                        "between dispatches and compare digests "
+                        "bit-exact against the warmup baseline "
+                        "(resilience/sdc.py layer 4); 0 disables")
     p.add_argument("--no_degrade", action="store_true")
     p.add_argument("--aot_cache", default=None,
                    help="AOT executable cache directory (warm restarts)")
@@ -212,8 +290,8 @@ def fleet_main(args, inject, inject_arg) -> int:
 
     from raft_tpu.models import RAFT
     from raft_tpu.obs import RunLedger
-    from raft_tpu.serve import (AOTCache, FleetServer, RequestError,
-                                ServeEngine, serve_config)
+    from raft_tpu.serve import (AOTCache, FleetServer, ServeEngine,
+                                serve_config)
     from raft_tpu.serve.engine import _round8
     from raft_tpu.serve.server import FlowServer
 
@@ -221,7 +299,6 @@ def fleet_main(args, inject, inject_arg) -> int:
     levels = tuple(int(x) for x in args.iter_levels.split(","))
     cfg = serve_config(small=True)
     model = RAFT(cfg)
-    rng = np.random.default_rng(args.seed)
 
     workdir = tempfile.mkdtemp(prefix="fleet_session_")
     cache_dir = args.aot_cache or os.path.join(workdir, "aot")
@@ -271,7 +348,8 @@ def fleet_main(args, inject, inject_arg) -> int:
             warm_iters=args.warm_iters, ledger=rep_ledger,
             watchdog_timeout_s=args.watchdog_timeout,
             spill_store=spill, continuous=args.continuous,
-            segment_iters=args.segment_iters)
+            segment_iters=args.segment_iters,
+            canary_every=args.canary_every)
 
     fleet = FleetServer(factory, n_replicas=args.fleet,
                         spill_dir=os.path.join(workdir, "spill"),
@@ -289,30 +367,16 @@ def fleet_main(args, inject, inject_arg) -> int:
         "replicas": args.fleet,
     }}), flush=True)
 
-    def frame():
-        return rng.integers(0, 255, (H, W, 3)).astype(np.float32)
-
     event_fired = [False]
     roll_thread = None
     lat_steady: list = []
     lat_after: list = []
     served = 0
-    futures = []
-    reaped_upto = 0
 
-    def reap(upto):
-        nonlocal served, reaped_upto
-        for f, t_sub in futures[reaped_upto:upto]:
-            if f is None:
-                continue
-            try:
-                f.result(timeout=600)
-            except RequestError:
-                continue
-            (lat_after if event_fired[0] else lat_steady).append(
-                time.perf_counter() - t_sub)
-            served += 1
-        reaped_upto = max(reaped_upto, upto)
+    def on_served(latency_s):
+        nonlocal served
+        (lat_after if event_fired[0] else lat_steady).append(latency_s)
+        served += 1
 
     def maybe_fire_event():
         nonlocal roll_thread
@@ -341,31 +405,11 @@ def fleet_main(args, inject, inject_arg) -> int:
                 target=fleet.rolling_restart, daemon=True)
             roll_thread.start()
 
-    for i in range(args.requests):
-        img1, img2 = frame(), frame()
-        if inject == "poison" and i == inject_arg:
-            img1 = img1.copy()
-            img1[0, 0, 0] = np.nan
-        stream = (f"s{i % args.video_streams}"
-                  if args.video_streams else None)
-        workload = ("stereo" if args.stereo_every
-                    and (i % args.stereo_every) == args.stereo_every - 1
-                    else "flow")
-        deadline = args.deadline_ms
-        if inject == "deadline-storm":
-            deadline = -1.0
-        try:
-            futures.append((fleet.submit(img1, img2,
-                                         deadline_ms=deadline,
-                                         stream=stream,
-                                         workload=workload),
-                            time.perf_counter()))
-        except RequestError:
-            futures.append((None, 0.0))
-        if inject != "overload" and (i + 1) % args.batch_size == 0:
-            reap(len(futures))
-        maybe_fire_event()
-    reap(len(futures))
+    run_load(args, inject, inject_arg, (H, W),
+             lambda img1, img2, deadline, stream, workload:
+             fleet.submit(img1, img2, deadline_ms=deadline,
+                          stream=stream, workload=workload),
+             on_served, after_chunk=maybe_fire_event)
     if roll_thread is not None:
         roll_thread.join(timeout=600)
 
@@ -427,8 +471,8 @@ def main(argv=None) -> int:
 
     from raft_tpu.models import RAFT
     from raft_tpu.obs import RunLedger
-    from raft_tpu.serve import (AOTCache, FlowServer, RequestError,
-                                ServeEngine, serve_config)
+    from raft_tpu.serve import (AOTCache, FlowServer, ServeEngine,
+                                serve_config)
     from raft_tpu.serve.engine import _round8
 
     H, W = (_round8(x) for x in args.image_size)
@@ -438,7 +482,6 @@ def main(argv=None) -> int:
     # CLI's job); no flag pretends otherwise
     cfg = serve_config(small=True)
     model = RAFT(cfg)
-    rng = np.random.default_rng(args.seed)
 
     ledger = None
     if args.ledger:
@@ -475,6 +518,33 @@ def main(argv=None) -> int:
 
         engine.forward = wedged_forward
 
+    flaky = {"on": False}              # the canary-flip chaos shim
+    if inject == "canary-flip":
+        if not args.canary_every:
+            print("serve: inject canary-flip needs --canary_every N",
+                  file=sys.stderr)
+            return 2
+        # A flaky chip: finite-but-wrong outputs (x 1+1e-3) starting
+        # AFTER warmup records the golden baseline, healed by an
+        # executor recompile — exactly the corruption shape the canary's
+        # recompile-and-recheck choreography must catch and recover.
+        real_fwd = engine.forward
+        real_invalidate = engine.invalidate
+
+        def flaky_forward(hw, iters, img1, img2, flow_init=None):
+            low, up = real_fwd(hw, iters, img1, img2,
+                               flow_init=flow_init)
+            if flaky["on"]:
+                up = up * np.float32(1.0 + 1e-3)
+            return low, up
+
+        def healed_invalidate(*a, **kw):
+            flaky["on"] = False        # the recompile replaces the
+            return real_invalidate(*a, **kw)   # "corrupted" executable
+
+        engine.forward = flaky_forward
+        engine.invalidate = healed_invalidate
+
     engines = {"flow": engine}
     if args.stereo_every:
         # heterogeneous session: a stereo disparity engine rides the
@@ -489,11 +559,13 @@ def main(argv=None) -> int:
         iter_levels=levels, slo_ms=args.slo_ms,
         degrade=not args.no_degrade, warm_iters=args.warm_iters,
         ledger=ledger, watchdog_timeout_s=args.watchdog_timeout,
-        continuous=args.continuous, segment_iters=args.segment_iters)
+        continuous=args.continuous, segment_iters=args.segment_iters,
+        canary_every=args.canary_every)
 
     t0 = time.perf_counter()
     server.warmup(warm_too=args.video_streams > 0)
     startup_s = time.perf_counter() - t0
+    flaky["on"] = True                 # no-op unless inject canary-flip
     stats = dict(aot.stats) if aot else {}
     print(json.dumps({"serve_startup": {
         "startup_s": round(startup_s, 3),
@@ -502,53 +574,18 @@ def main(argv=None) -> int:
         "cache_corrupt": int(stats.get("corrupt", 0)),
     }}), flush=True)
 
-    def frame():
-        return rng.integers(0, 255, (H, W, 3)).astype(np.float32)
+    served = [0]
 
-    futures = []
-    served = 0
-    for i in range(args.requests):
-        img1, img2 = frame(), frame()
-        if inject == "poison" and i == inject_arg:
-            img1 = img1.copy()
-            img1[0, 0, 0] = np.nan
-        stream = (f"s{i % args.video_streams}"
-                  if args.video_streams else None)
-        workload = ("stereo" if args.stereo_every
-                    and (i % args.stereo_every) == args.stereo_every - 1
-                    else "flow")
-        deadline = args.deadline_ms
-        if inject == "deadline-storm":
-            deadline = -1.0            # already expired at submit: the
-            # assembly-time check MUST shed it pre-dispatch regardless
-            # of how fast the batcher wakes
-        try:
-            futures.append(server.submit(img1, img2,
-                                         deadline_ms=deadline,
-                                         stream=stream,
-                                         workload=workload))
-        except RequestError:           # typed shed (queue-full / bad
-            futures.append(None)       # request), already counted
-        if inject != "overload" and (i + 1) % args.batch_size == 0:
-            # paced mode: wait for the chunk so the queue never backs
-            # up; overload mode slams the whole burst in at once
-            for f in futures[-args.batch_size:]:
-                if f is None:
-                    continue
-                try:
-                    f.result(timeout=600)
-                    served += 1
-                    if inject == "sigkill" and served >= inject_arg:
-                        os.kill(os.getpid(), signal.SIGKILL)
-                except RequestError:
-                    continue
-    for f in futures:
-        if f is None or f.done():
-            continue
-        try:
-            f.result(timeout=600)
-        except RequestError:
-            continue
+    def on_served(latency_s):
+        served[0] += 1
+        if inject == "sigkill" and served[0] >= inject_arg:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    run_load(args, inject, inject_arg, (H, W),
+             lambda img1, img2, deadline, stream, workload:
+             server.submit(img1, img2, deadline_ms=deadline,
+                           stream=stream, workload=workload),
+             on_served)
 
     summary = server.close()
     # same strict-JSON discipline as the ledger: a zero-served run has

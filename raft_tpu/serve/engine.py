@@ -223,6 +223,18 @@ class ServeEngine:
         return self.compile_fn(self.model, self.variables, img_sds,
                                img_sds, iters, flow_sds=flow_sds)
 
+    def invalidate(self, hw: Tuple[int, int], iters: int,
+                   warm: bool = False) -> bool:
+        """Drop the in-process memo for one executable so the next call
+        re-verifies-and-loads from the AOT cache (or recompiles) — the
+        serve canary's recompile-and-recheck hook (server.py): a
+        golden-digest mismatch evicts the suspect executable and the
+        recheck decides whether the corruption lived in it (healed) or
+        in the chip (fatal).  Returns whether an entry was dropped."""
+        with self._compile_lock:
+            key = (tuple(hw), int(iters), bool(warm))
+            return self._fns.pop(key, None) is not None
+
     def is_compiled(self, hw: Tuple[int, int], iters: int,
                     warm: bool = False) -> bool:
         """Is this executable already in the in-process memo? (The

@@ -67,7 +67,8 @@ class FlowServer:
                  exit_fn=None,
                  spill_store=None,
                  continuous: bool = False,
-                 segment_iters: Optional[int] = None):
+                 segment_iters: Optional[int] = None,
+                 canary_every: int = 0):
         from raft_tpu.obs.spans import NULL, SpanRecorder
         from raft_tpu.serve.engine import default_buckets
 
@@ -149,6 +150,24 @@ class FlowServer:
         # the degradation path already proves exists and warms
         self._segment = int(segment_iters if segment_iters is not None
                             else self.controller.levels[-1])
+        # Serving SDC canary (resilience/sdc.py layer 4): one cached
+        # (golden input, digest) pair per (workload, family), probed
+        # every `canary_every` batches BETWEEN dispatches — a flaky
+        # chip computing finite-but-wrong flow is caught by a bit-exact
+        # digest compare against the warmup-time baseline, typed
+        # `sdc-serve-canary`, and answered with executor
+        # recompile-and-recheck before more wrong flow ships.  0
+        # disables probing.
+        if canary_every < 0:
+            raise ValueError(f"canary_every must be >= 0, "
+                             f"got {canary_every}")
+        self.canary_every = int(canary_every)
+        self._canary: Dict = {}            # (workload, family) -> record
+        self._canary_counts = {"probes": 0, "mismatches": 0,
+                               "recompiles": 0}
+        self._canary_last = 0
+        self._canary_rr = 0
+        self._canary_failed = False
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._warm = False
@@ -170,7 +189,8 @@ class FlowServer:
 
     # -- telemetry -----------------------------------------------------------
 
-    def _incident(self, kind: str, detail: str, sample: bool = True) -> None:
+    def _incident(self, kind: str, detail: str, sample: bool = True,
+                  severity: Optional[str] = None) -> None:
         n = self._incident_counts.get(kind, 0) + 1
         self._incident_counts[kind] = n
         if self.ledger is None:
@@ -181,7 +201,8 @@ class FlowServer:
             detail = f"[{n} total so far, 1-in-{INCIDENT_SAMPLE} " \
                      f"sampled] {detail}"
         try:
-            self.ledger.incident(kind, step=self._batch_no, detail=detail)
+            self.ledger.incident(kind, step=self._batch_no, detail=detail,
+                                 severity=severity)
         except (ValueError, OSError):
             # closed ledger (a submit racing shutdown) or failed disk
             # (ENOSPC): the typed rejection/counters are the contract —
@@ -230,6 +251,12 @@ class FlowServer:
                 else:
                     secs += eng.warmup(fams, self.controller.levels,
                                        warm_too=warm_too)
+            if self.canary_every:
+                # INSIDE the watchdog bracket: the baseline dispatches
+                # real forwards, and a wedged first dispatch must trip
+                # serve-stalled like any other startup wedge instead of
+                # hanging warmup forever
+                self._canary_baseline(fams)
         finally:
             if token is not None:
                 self.watchdog.done(token)
@@ -237,6 +264,123 @@ class FlowServer:
         logger.info("serve: warmup took %.2fs (%s)", secs,
                     self.engine.aot.stats if self.engine.aot else "no AOT")
         return secs
+
+    # -- SDC canary (resilience/sdc.py layer 4) ------------------------------
+
+    def _canary_baseline(self, fams: Dict) -> None:
+        """Record one golden (input, digest) pair per (workload,
+        family) right after warmup — the executables are
+        just-compiled/verified here, so the digest pins a healthy
+        chip's bit-exact answer.  Continuous mode probes the (segment,
+        warm) executable it actually serves with; FIFO mode probes the
+        ladder's cheapest cold level."""
+        import zlib
+
+        from raft_tpu.resilience.sdc import param_tree_digest
+
+        for workload, eng in self.engines.items():
+            B = eng.batch_size
+            wc = getattr(eng, "warm_channels", 2)
+            for family, hw in fams.items():
+                H, W = hw
+                rng = np.random.default_rng(zlib.crc32(
+                    f"sdc-canary/{workload}/{family}".encode()))
+                img1 = rng.integers(0, 255,
+                                    (B, H, W, 3)).astype(np.float32)
+                img2 = rng.integers(0, 255,
+                                    (B, H, W, 3)).astype(np.float32)
+                if self.continuous:
+                    iters = self._segment
+                    flow_init = np.zeros((B, H // 8, W // 8, wc),
+                                         np.float32)
+                else:
+                    iters, flow_init = self.controller.levels[-1], None
+                low, up = eng.forward(hw, iters, img1, img2,
+                                      flow_init=flow_init)
+                self._canary[(workload, family)] = {
+                    "engine": eng, "hw": hw, "iters": iters,
+                    "img1": img1, "img2": img2, "flow_init": flow_init,
+                    "warm": flow_init is not None,
+                    "digest": param_tree_digest([low, up]),
+                }
+
+    def _maybe_canary(self) -> None:
+        """Probe one (workload, family) pair when due — called from the
+        batcher thread BETWEEN dispatches (idle, or right after a batch
+        completed), never while client work is in flight, so the hot
+        path only ever pays one small extra dispatch per
+        ``canary_every`` batches.  A digest mismatch is answered
+        in-place: evict the executable, recompile/reload, re-probe —
+        the recheck decides whether the corruption lived in the
+        executable (healed, ``recovered``) or the chip is flaky
+        (``fatal``; the readiness probe flips so this replica drains)."""
+        if not self.canary_every or not self._canary:
+            return
+        if self._batch_no - self._canary_last < self.canary_every:
+            return
+        self._canary_last = self._batch_no
+        from raft_tpu.resilience.sdc import param_tree_digest
+
+        keys = sorted(self._canary)
+        key = keys[self._canary_rr % len(keys)]
+        self._canary_rr += 1
+        rec = self._canary[key]
+        eng, hw, iters = rec["engine"], rec["hw"], rec["iters"]
+
+        def probe() -> int:
+            low, up = eng.forward(hw, iters, rec["img1"], rec["img2"],
+                                  flow_init=rec["flow_init"])
+            return param_tree_digest([low, up])
+
+        token = None
+        if self.watchdog is not None:
+            # slow=True: a mismatch pays a recompile inside this bracket
+            token = self.watchdog.begin(
+                f"sdc canary probe {key[0]}/{key[1]} batch "
+                f"{self._batch_no}", slow=True)
+        try:
+            self._canary_counts["probes"] += 1
+            d = probe()
+            if d == rec["digest"]:
+                return
+            self._canary_counts["mismatches"] += 1
+            if eng.invalidate(hw, iters, warm=rec["warm"]):
+                # count only a genuine eviction: the report's
+                # "recompile-and-recheck" claim must match what ran
+                self._canary_counts["recompiles"] += 1
+            d2 = probe()
+            label = f"{key[0]}/{key[1]}"
+            if d2 == rec["digest"]:
+                self._incident(
+                    "sdc-serve-canary",
+                    f"golden-input canary for {label} mismatched its "
+                    f"baseline digest ({d:#010x} != {rec['digest']:#010x})"
+                    f" at batch {self._batch_no}; executor "
+                    f"recompile-and-recheck RESTORED the baseline — the "
+                    f"corruption lived in the executable, now evicted; "
+                    f"output served between the last clean probe and "
+                    f"this one is suspect",
+                    sample=False, severity="recovered")
+            else:
+                self._canary_failed = True
+                self._incident(
+                    "sdc-serve-canary",
+                    f"golden-input canary for {label} mismatched its "
+                    f"baseline digest ({d:#010x} != "
+                    f"{rec['digest']:#010x}) and a recompiled executor "
+                    f"STILL disagrees ({d2:#010x}) — this chip computes "
+                    f"wrong flow; readiness flipped false so the "
+                    f"replica drains instead of shipping it",
+                    sample=False, severity="fatal")
+        except Exception as e:  # noqa: BLE001 — a probe crash must not
+            # kill the batcher thread (the silent-drop failure mode);
+            # it is still loud in the log
+            logger.warning("serve: sdc canary probe %s failed "
+                           "(%s: %s); will retry next cadence",
+                           key, type(e).__name__, e)
+        finally:
+            if token is not None:
+                self.watchdog.done(token)
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                deadline_ms: Optional[float] = None,
@@ -282,8 +426,10 @@ class FlowServer:
     # -- probes --------------------------------------------------------------
 
     def ready(self) -> bool:
-        """Readiness: executables warm, batcher alive, watchdog clean."""
+        """Readiness: executables warm, batcher alive, watchdog clean,
+        and the SDC canary has not condemned this chip."""
         return (self._warm and self._thread.is_alive()
+                and not self._canary_failed
                 and (self.watchdog is None or self.watchdog.tripped is None))
 
     def health(self) -> Dict:
@@ -293,6 +439,7 @@ class FlowServer:
                   and (self.watchdog is None
                        or self.watchdog.tripped is None),
             "ready": self.ready(),
+            "canary_failed": self._canary_failed,
             "queue_depth": len(self.queue),
             "queue_capacity": self.queue.capacity,
             "degradation_level": self.controller.level,
@@ -400,6 +547,7 @@ class FlowServer:
             with self.spans.span("queue"):
                 reqs = self.queue.pop_batch(B, timeout=0.05)
             if not reqs:
+                self._maybe_canary()
                 continue
             self._batch_no += 1
             try:
@@ -417,6 +565,10 @@ class FlowServer:
                 for req in reqs:
                     if not req.future.done():
                         self._reject(req, err, "rejected_bad_request")
+            # canary cadence check between dispatches: the just-served
+            # batch's futures are already resolved, so a due probe
+            # never adds latency to work a client is waiting on
+            self._maybe_canary()
             if self._batch_no % self._flush_every == 0:
                 try:
                     self.spans.flush(self._batch_no)
@@ -748,6 +900,10 @@ class FlowServer:
                 with self.spans.span("queue"):
                     reqs = self.queue.pop_batch(B, timeout=0.05)
                 if not reqs:
+                    # between in-flight batches: the one place the
+                    # continuous loop is provably not holding client
+                    # slots, so the canary probes here
+                    self._maybe_canary()
                     continue
                 self._batch_no += 1
                 try:
@@ -802,6 +958,7 @@ class FlowServer:
             if not any(s is not None for s in state["slots"]):
                 state = None
                 self.spans.step_boundary()
+                self._maybe_canary()
             if self._batch_no % self._flush_every == 0:
                 try:
                     self.spans.flush(self._batch_no)
@@ -846,6 +1003,9 @@ class FlowServer:
             families[label] = row
         if families:
             summary["families"] = families
+        if self.canary_every:
+            summary["canary"] = dict(self._canary_counts) | {
+                "families": len(self._canary)}
         if self.engine.aot is not None:
             summary["aot_cache"] = dict(self.engine.aot.stats)
         return summary

@@ -27,11 +27,11 @@ from typing import Dict, Optional
 from raft_tpu.obs.meters import MetricsBus
 from raft_tpu.obs.spans import NULL
 
-# Metrics that exist for the health monitor / recovery policy, not for
-# humans: they stay in the ledger and the history, but are filtered from
-# the reference-parity console line and TensorBoard scalars
-# (train.py:105-110).
-_SENTINEL_KEYS = frozenset({"nonfinite", "skipped"})
+# Metrics that exist for the health monitor / recovery / SDC policies,
+# not for humans: they stay in the ledger and the history, but are
+# filtered from the reference-parity console line and TensorBoard
+# scalars (train.py:105-110).
+_SENTINEL_KEYS = frozenset({"nonfinite", "skipped", "grad_digest"})
 
 
 class Logger:

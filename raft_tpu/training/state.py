@@ -133,10 +133,13 @@ def save_checkpoint(path: str, state: TrainState,
     no manifest (degrades to legacy parse-verification), never a
     manifest describing bytes that don't exist.
     """
+    from raft_tpu.resilience.sdc import param_tree_digest
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     # optax states are NamedTuples; _state_payload converts to plain
     # dicts for msgpack
-    data = flax.serialization.msgpack_serialize(_state_payload(state))
+    payload = _state_payload(state)
+    data = flax.serialization.msgpack_serialize(payload)
     _atomic_write_bytes(path, data)
     manifest = {
         "v": MANIFEST_VERSION,
@@ -144,6 +147,12 @@ def save_checkpoint(path: str, state: TrainState,
         "fingerprint": fingerprint,
         "size": len(data),
         "sha256": hashlib.sha256(data).hexdigest(),
+        # the silent-corruption fence: a digest of the parameter VALUES
+        # (computed before serialization), re-verified after restore —
+        # corruption on the serialize path produces internally-
+        # consistent bytes whose size/sha256 verify clean, and only
+        # this value-level digest can reject them (resilience/sdc.py)
+        "param_digest": param_tree_digest(payload.get("params", {})),
     }
     _atomic_write_bytes(manifest_path(path),
                         json.dumps(manifest, sort_keys=True).encode("utf-8"))
@@ -313,10 +322,13 @@ def save_checkpoint_sharded(base_path: str, state: TrainState,
     os.makedirs(os.path.dirname(base_path) or ".", exist_ok=True)
     from flax import traverse_util
 
+    from raft_tpu.resilience.sdc import param_tree_digest
+
     # keep_empty_nodes: optax EmptyState / empty batch_stats are real
     # STRUCTURE (from_state_dict restores positionally); the sentinel
     # rides the wire as an empty dict, which no array leaf can be
-    flat = traverse_util.flatten_dict(_state_payload(state),
+    payload = _state_payload(state)
+    flat = traverse_util.flatten_dict(payload,
                                       keep_empty_nodes=True, sep="/")
     keys = _shard_keys(flat.keys(), shard_index, shard_count)
     data = flax.serialization.msgpack_serialize(
@@ -332,6 +344,10 @@ def save_checkpoint_sharded(base_path: str, state: TrainState,
         "sha256": hashlib.sha256(data).hexdigest(),
         "shard": shard_index,
         "shards": shard_count,
+        # full-tree param digest (the state is replicated, so every
+        # writer computes the same value): part of the shard set's
+        # agreement fields AND the restore-time fence
+        "param_digest": param_tree_digest(payload.get("params", {})),
     }
     _atomic_write_bytes(manifest_path(path),
                         json.dumps(manifest, sort_keys=True).encode("utf-8"))
@@ -409,7 +425,7 @@ def verify_shard_set(base_path: str) -> Tuple[bool, str, Dict]:
                            f"{manifest.get('shard')} of "
                            f"{manifest.get('shards')} — misplaced file"), {}
         fields = {k: manifest.get(k) for k in ("step", "fingerprint",
-                                               "shards")}
+                                               "shards", "param_digest")}
         if not agreed:
             agreed = fields
         elif fields != agreed:
@@ -573,11 +589,14 @@ def restore_latest_verified(
     Returns ``(restored_state, path)``, or ``(None, None)`` when no
     candidate survives (the caller decides whether that is fatal).
     """
+    from raft_tpu.resilience.sdc import param_tree_digest
+
     for path, sharded in _all_candidates(ckpt_dir, prefix):
         if sharded:
-            ok, reason, _ = verify_shard_set(path)
+            ok, reason, meta = verify_shard_set(path)
         else:
             ok, reason = verify_checkpoint(path)
+            meta = _manifest_fields(path)
         if not ok:
             if on_incident is not None:
                 on_incident("ckpt-corrupt",
@@ -586,15 +605,52 @@ def restore_latest_verified(
             continue
         try:
             if sharded:
-                return restore_checkpoint_sharded(path, state), path
-            return restore_checkpoint(path, state), path
+                restored = restore_checkpoint_sharded(path, state)
+            else:
+                restored = restore_checkpoint(path, state)
         except Exception as e:  # torn msgpack raises library-private types
             if on_incident is not None:
                 on_incident("ckpt-corrupt",
                             f"{path}: verified but restore failed "
                             f"({type(e).__name__}: {e}); falling back to "
                             f"the next newest checkpoint")
+            continue
+        # Parameter checksum fence (resilience/sdc.py): the bytes
+        # verified, but do the restored VALUES match what the save
+        # digested before serialization?  A corrupted serialize path
+        # writes internally-consistent bytes (size + sha256 clean) that
+        # only this value-level check can reject.  Legacy manifests
+        # carry no digest and skip the fence.
+        expected = (meta or {}).get("param_digest")
+        if isinstance(expected, int):
+            actual = param_tree_digest(restored.params)
+            if actual != expected:
+                if on_incident is not None:
+                    on_incident(
+                        "ckpt-corrupt",
+                        f"{path}: param-tree digest mismatch (manifest "
+                        f"{expected:#010x}, restored {actual:#010x}) — "
+                        f"bytes verified clean but the parameter VALUES "
+                        f"differ from what was saved: silent corruption "
+                        f"on the save path; falling back to the next "
+                        f"newest checkpoint")
+                continue
+        return restored, path
     return None, None
+
+
+def _manifest_fields(path: str) -> Dict:
+    """The sidecar manifest's fields for a single-file checkpoint, or {}
+    (legacy saves, or a kill between the two save renames)."""
+    mpath = manifest_path(path)
+    if not os.path.isfile(mpath):
+        return {}
+    try:
+        with open(mpath, encoding="utf-8") as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else {}
+    except (OSError, json.JSONDecodeError):
+        return {}
 
 
 def prune_checkpoints(ckpt_dir: str, prefix: str, keep: int,

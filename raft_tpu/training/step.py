@@ -168,6 +168,15 @@ def make_train_step(model, iters: int, gamma: float, max_flow: float,
             batch_stats=new_model_state.get("batch_stats",
                                             state.batch_stats))
         metrics["grad_norm"] = optax_global_norm(grads)
+        # In-graph SDC digest (resilience/sdc.py): under data
+        # parallelism the post-allreduce gradients are replicated, so
+        # this scalar is bit-identical on every process by construction
+        # — the cross-replica vote compares its bits at the
+        # --sdc_vote_every cadence, and the single-process replay
+        # sentinel re-derives it from a captured (state, batch) pair.
+        # Reduces only: no new collectives on any entry (engine-3
+        # budgets re-baselined for the extra reduce + output scalar).
+        metrics["grad_digest"] = grad_tree_digest(grads)
         # In-graph health sentinel (obs/health.py): two isfinite on
         # scalars the step already computed — the metrics bus inspects it
         # at the window boundary, so a NaN run is caught without any
@@ -262,6 +271,19 @@ def abstract_train_step(iters: int = 2, donate: bool = False,
                            max_flow=max_flow, donate=donate,
                            add_noise=add_noise)
     return step, (state_sds, batch_sds)
+
+
+def grad_tree_digest(tree) -> jax.Array:
+    """The in-graph silent-corruption digest: f32 abs-sum over every
+    gradient leaf.  Strictly positive for any nonzero gradient tree, so
+    a multiplicative skew (the ``grad-skew`` chaos fault, a marginal
+    chip's "finite but wrong" failure mode) always changes its bits;
+    deterministic for fixed inputs, so a bit-exact compare across
+    replicas (resilience/sdc.py vote) or against a replayed step
+    (replay-verify sentinel) is a corruption test, not a tolerance
+    check.  Reduces only — no new collectives on any audited entry."""
+    leaves = jax.tree.leaves(tree)
+    return sum(jnp.sum(jnp.abs(x.astype(jnp.float32))) for x in leaves)
 
 
 def optax_global_norm(tree) -> jax.Array:

@@ -27,6 +27,18 @@ Scenarios (the fault taxonomy, obs/events.py):
 - ``nonfinite-fatal``   NaN with recovery DISABLED -> fatal incident;
                         the severity gate must trip (the
                         no-silent-corruption leg)
+- ``sdc-param-flip``    the newest checkpoint's params silently
+                        corrupted on the save path (one bit flipped,
+                        manifest re-hashed to match — sha256 verifies
+                        CLEAN) -> --resume's param-digest fence rejects
+                        it typed and falls back to the newest verified
+                        save (resilience/sdc.py layer 3)
+- ``supervisor-crash-loop`` the replay-verify sentinel trips every
+                        attempt (grad-skew re-injected at the same
+                        step) -> scripts/supervise.py restarts with
+                        bounded backoff until the crash-loop fence
+                        terminates typed (``crash-loop`` incident,
+                        exit 15)
 
 ``--dist`` switches to the POD matrix: every scenario is a real
 2-process gloo run of the training CLI (multi-host data plane, sharded
@@ -51,6 +63,14 @@ checkpoints, agreement channel), gated through
                             the pod-wide fence terminates the peer too
                             (typed peer-fatal), with NO watchdog
                             timeout configured
+- ``sdc-grad-skew``         one process's gradient digest silently
+                            skewed (finite, wrong) -> the cross-replica
+                            vote disagrees at the next cadence
+                            boundary, replay arbitration localizes p1,
+                            quarantines it, both exit rc 13 -> the
+                            elastic --resume relaunch (1 process,
+                            re-shard 2->1) rolls back to the newest
+                            verified checkpoint and completes
 
 ``--serve`` switches to the SERVING matrix: every scenario drives the
 real FlowServer through ``python -m raft_tpu.serve`` (bounded queue,
@@ -90,6 +110,12 @@ through ``obs report --fail-on-incident fatal``:
                          admission, every restart's warm restore < 50%
                          of the cold startup, fleet p95 within 1.25x
                          of steady state
+- ``serve-sdc-canary``   a flaky chip scales outputs by 1+1e-3 after
+                         warmup (finite, silent) -> the golden-input
+                         canary catches the digest mismatch at its
+                         cadence, executor recompile-and-recheck heals
+                         it, typed recovered ``sdc-serve-canary``, the
+                         load still fully served
 
 This is the scripted, runnable form of the resilience acceptance
 criteria; tests/test_resilience.py runs the cheap unit half in tier-1,
@@ -247,6 +273,22 @@ def pod_incident_kinds(workdir, name):
     return kinds
 
 
+def _check_quarantine(workdir, name, want_procs):
+    """The SDC vote must have quarantined exactly ``want_procs`` — a
+    localization that names the wrong host would evict healthy
+    hardware and keep the marginal chip."""
+    qf = os.path.join(workdir, name, "ckpts", "quarantine.json")
+    try:
+        with open(qf, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return f"quarantine file unreadable at {qf}: {e}"
+    procs = sorted(e.get("process") for e in doc.get("quarantined", []))
+    if procs != sorted(want_procs):
+        return f"expected processes {want_procs} quarantined, got {procs}"
+    return None
+
+
 def dist_main(args, env, workdir):
     """The pod fault matrix.  Each row: recover or terminate loudly —
     now with 'loudly' meaning EVERY process, typed, nonzero."""
@@ -281,6 +323,21 @@ def dist_main(args, env, workdir):
          [("pod", [[], ["--inject", "host-fatal@2"]],
            [WATCHDOG_EXIT_CODE, 1])],
          {"injected-fatal", "peer-fatal"}, True),
+        ("sdc-grad-skew",
+         # both processes carry the same deterministic plan; the skew
+         # applies only on p1.  Vote at the step-2 boundary agrees
+         # (healthy path), the step-4 vote disagrees -> replay
+         # arbitration names p1 -> quarantine + coordinated rc 13 ->
+         # elastic single-process --resume (re-shard 2->1) restores the
+         # newest verified set and completes.
+         [("pod", [["--sdc_vote_every", "2", "--val_freq", "2",
+                    "--keep_ckpts", "4", "--inject", "grad-skew@4:1"],
+                   ["--sdc_vote_every", "2", "--val_freq", "2",
+                    "--keep_ckpts", "4", "--inject", "grad-skew@4:1"]],
+           [WATCHDOG_EXIT_CODE, WATCHDOG_EXIT_CODE]),
+          ("single", ["--resume"], 0)],
+         {"sdc-detected", "ckpt-reshard"}, True,
+         lambda workdir, name: _check_quarantine(workdir, name, [1])),
     ]
     if args.only:
         scenarios = [s for s in scenarios if s[0] == args.only]
@@ -290,7 +347,8 @@ def dist_main(args, env, workdir):
 
     rows = []
     failures = 0
-    for name, phases, want_kinds, expect_fatal in scenarios:
+    for name, phases, want_kinds, expect_fatal, *extra in scenarios:
+        check = extra[0] if extra else None
         fail = None
         for i, phase in enumerate(phases):
             if phase[0] == "pod":
@@ -324,6 +382,8 @@ def dist_main(args, env, workdir):
                 fail = "pod fatal gate did NOT trip"
             elif not expect_fatal and gate_rc != 0:
                 fail = "pod fatal gate tripped on a recovered scenario"
+            elif check is not None:
+                fail = check(workdir, name)
         verdict = "FAIL" if fail else (
             "terminated+gated" if expect_fatal else "recovered")
         rows.append((name, sorted(seen), verdict, fail))
@@ -390,7 +450,7 @@ def serve_main(args, env, workdir):
     all_names = ("serve-overload", "serve-deadline-storm", "serve-poison",
                  "serve-mixed-family", "serve-kill-restart-warm",
                  "serve-stall", "serve-kill-one-replica",
-                 "serve-rolling-restart")
+                 "serve-rolling-restart", "serve-sdc-canary")
     if args.only and args.only not in all_names:
         print(f"unknown serve scenario {args.only!r} "
               f"(known: {', '.join(all_names)})")
@@ -612,6 +672,31 @@ def serve_main(args, env, workdir):
                [ledger(name, "run")]
                + [ledger(name, "run") + f".p{i}" for i in range(3)])
 
+    # -- sdc canary: flaky-chip outputs after warmup -> golden-input
+    # probe mismatches at its cadence -> recompile-and-recheck heals ->
+    # recovered typed incident, full load served, fatal gate green
+    if want("serve-sdc-canary"):
+        name, fail = "serve-sdc-canary", None
+        rc, _, summary, tail = run_serve(
+            workdir, name, base + ["--canary_every", "2",
+                                   "--inject", "canary-flip"], env)
+        canary = (summary or {}).get("canary") or {}
+        if rc != 0:
+            fail = f"exit {rc} != 0\n{tail}"
+        elif summary is None or summary["unaccounted"] != 0:
+            fail = f"silent drops: {summary and summary['unaccounted']}"
+        elif summary["served"] != 8:
+            fail = f"expected 8/8 served, got {summary['served']}"
+        elif not canary.get("probes"):
+            fail = f"canary never probed ({canary})"
+        elif not canary.get("mismatches"):
+            fail = (f"flaky outputs never mismatched a probe "
+                    f"({canary})")
+        elif not canary.get("recompiles"):
+            fail = f"no recompile-and-recheck ran ({canary})"
+        finish(name, {"sdc-serve-canary"}, False, fail,
+               [ledger(name, "run")])
+
     # -- stall: wedged dispatch -> watchdog exit 14, typed, gated
     if want("serve-stall"):
         name, fail = "serve-stall", None
@@ -716,8 +801,24 @@ def main(argv=None):
          # the matrix can't greenwash an unrecovered fault
          [(["--inject", "nonfinite-burst@2:1"], S, 0)],
          {"nonfinite-loss"}, True),
+        ("sdc-param-flip",
+         # phase 1: periodic saves every 2 steps + final; param-flip the
+         # FINAL (= newest) save — its bytes verify CLEAN (the fault
+         # re-hashes the manifest), so only the param-digest fence can
+         # reject it.  phase 2: --resume must reject it typed
+         # (ckpt-corrupt naming the digest mismatch) and fall back to
+         # the newest VERIFIED periodic save, then finish the longer
+         # schedule.
+         [(["--inject", f"param-flip@{S // 2 + 1}", "--val_freq", "2",
+            "--keep_ckpts", "4"], S, 0),
+          (["--resume", "--val_freq", "1000000"], S + 2, 0)],
+         {"ckpt-corrupt"}, False),
     ]
-    if args.only:
+    want_supervisor = (not args.only
+                       or args.only == "supervisor-crash-loop")
+    if args.only == "supervisor-crash-loop":
+        scenarios = []
+    elif args.only:
         scenarios = [s for s in scenarios if s[0] == args.only]
         if not scenarios:
             print(f"unknown scenario {args.only!r}")
@@ -750,6 +851,61 @@ def main(argv=None):
                         f"(severities: {sevs})")
         verdict = "FAIL" if fail else (
             "terminated+gated" if expect_fatal else "recovered")
+        rows.append((name, sorted(seen), verdict, fail))
+        failures += bool(fail)
+
+    if want_supervisor:
+        # supervisor-crash-loop: the replay-verify sentinel trips every
+        # attempt (the skew fault re-fires deterministically at step 2;
+        # no checkpoint exists yet, so each --resume relaunch replays
+        # the same poisoned prefix) -> scripts/supervise.py restarts
+        # with bounded backoff until the crash-loop fence terminates
+        # typed with a nonzero rc.
+        name, fail = "supervisor-crash-loop", None
+        sup_ledger = os.path.join(workdir, name, "supervise.jsonl")
+        child_ledger = ledger(name, "child")
+        os.makedirs(os.path.dirname(sup_ledger), exist_ok=True)
+        cmd = [sys.executable,
+               os.path.join(ROOT, "scripts", "supervise.py"),
+               "--max-restarts", "6", "--backoff-base", "0.1",
+               "--backoff-cap", "0.5", "--crash-loop-restarts", "2",
+               "--crash-loop-window", "600",
+               "--ledger", sup_ledger, "--",
+               sys.executable, "-m", "raft_tpu.cli.train",
+               "--stage", "synthetic", "--small", "--iters", "2",
+               "--batch_size", "1", "--image_size", "64", "64",
+               "--num_steps", str(S), "--sum_freq", "1",
+               "--no_tensorboard", "--seed", "7",
+               "--checkpoint_dir", os.path.join(workdir, name, "ckpts"),
+               "--log_dir", os.path.join(workdir, name, "runs"),
+               "--name", "chaos",
+               "--sdc_vote_every", "2",
+               "--inject", "grad-skew@2:0",
+               "--obs_ledger", child_ledger]
+        try:
+            proc = subprocess.run(cmd, cwd=ROOT, env=env,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True,
+                                  timeout=1200)
+            rc, tail = proc.returncode, proc.stdout[-4000:]
+        except subprocess.TimeoutExpired:
+            rc, tail = None, "TIMEOUT — supervisor hung"
+        seen = set()
+        for lp in (sup_ledger, child_ledger):
+            if os.path.isfile(lp):
+                try:
+                    ks, _ = read_incident_kinds(lp)
+                    seen.update(ks)
+                except (OSError, ValueError):
+                    pass
+        if rc != 15:       # CRASH_LOOP_EXIT_CODE (supervisor.py)
+            fail = f"supervisor exit {rc} != 15 (crash-loop)\n{tail}"
+        elif "crash-loop" not in seen or "sdc-replay-mismatch" not in seen:
+            fail = (f"missing typed incident(s): expected crash-loop + "
+                    f"sdc-replay-mismatch, saw {sorted(seen)}")
+        elif gate(sup_ledger, env) == 0:
+            fail = "fatal gate did NOT trip on the crash-loop ledger"
+        verdict = "FAIL" if fail else "terminated+gated"
         rows.append((name, sorted(seen), verdict, fail))
         failures += bool(fail)
 

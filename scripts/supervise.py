@@ -1,0 +1,259 @@
+"""Run supervisor CLI: wrap the train CLI with the exit-code-typed
+restart policy (resilience/supervisor.py).
+
+    python scripts/supervise.py [policy flags] -- \\
+        python -m raft_tpu.cli.train --stage synthetic ...
+
+Single mode supervises one child command; ``--pod N`` launches N gloo
+ranks of the child (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID —
+the same env contract scripts/chaos_dryrun.py --dist uses) and applies
+the policy to the pod's aggregate exit code.  Restarts append
+``--resume`` (unless already present), re-read the quarantine file
+(written by the SDC vote, resilience/sdc.py) and relaunch WITHOUT the
+quarantined ranks — the elastic resume: PR 7's re-shard restore means a
+(N-1)-rank pod restores an N-shard checkpoint set by construction.
+
+Exit-code policy (see resilience/supervisor.py for the table): child 0
+-> done; 13 / signal-killed -> backoff + elastic restart; anything else
+-> stop, code passed through.  K restarts inside W seconds (or a spent
+restart budget) trip the crash-loop fence: a typed ``crash-loop``
+incident in the supervisor's own obs ledger (``--ledger``) and exit
+code 15 — bounded and gateable, never an infinite relaunch spin.
+
+Flags the launcher understands:
+
+- ``--pod N``          launch N ranks (default: single command)
+- ``--cpu-devices D``  total virtual CPU devices across the pod: each
+                       rank gets ``XLA_FLAGS=--xla_force_host_platform_
+                       device_count=D/ranks`` so an elastic shrink keeps
+                       the GLOBAL device count (and the --data_parallel
+                       mesh) constant — the CPU-testing analogue of a
+                       pod whose chips outlive a lost host
+- ``--quarantine F``   the quarantine file to re-read before every
+                       launch (default: none — no exclusions)
+- ``--ledger F``       supervisor obs ledger (crash-loop incidents land
+                       here; render with ``obs report``)
+
+A 1-rank relaunch of a pod command drops ``--multihost`` and the
+coordinator env — jax.distributed has no one-process mode on this
+jaxlib.  Prints a final ``{"supervise_summary": ...}`` JSON line.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from raft_tpu.resilience.supervisor import (  # noqa: E402
+    CRASH_LOOP_EXIT_CODE, ELASTIC_RESUME_EXIT_CODE, Attempt,
+    RestartPolicy, RunSupervisor)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        "scripts/supervise.py",
+        description="crash-loop-aware run supervisor: exit-code-typed "
+                    "restarts with bounded backoff and elastic "
+                    "quarantine-aware relaunch")
+    p.add_argument("--pod", type=int, default=0, metavar="N",
+                   help="launch N gloo ranks of the child command "
+                        "(0 = single command)")
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="total virtual CPU devices across the pod "
+                        "(kept constant through elastic shrinks); 0 "
+                        "leaves XLA_FLAGS untouched")
+    p.add_argument("--quarantine", default=None,
+                   help="quarantine file (resilience/sdc.py) re-read "
+                        "before every launch")
+    p.add_argument("--ledger", default=None,
+                   help="supervisor obs ledger path (crash-loop "
+                        "incidents)")
+    p.add_argument("--max-restarts", type=int, default=8)
+    p.add_argument("--backoff-base", type=float, default=1.0)
+    p.add_argument("--backoff-cap", type=float, default=60.0)
+    p.add_argument("--crash-loop-restarts", type=int, default=3,
+                   help="K: restarts inside the window that trip the "
+                        "crash-loop fence")
+    p.add_argument("--crash-loop-window", type=float, default=300.0,
+                   help="W seconds: the fence's sliding window")
+    p.add_argument("--launch-timeout", type=float, default=1800.0,
+                   help="per-attempt wall-clock bound; a hung child is "
+                        "killed and treated as signal-killed "
+                        "(restartable)")
+    p.add_argument("child", nargs=argparse.REMAINDER,
+                   help="-- CMD ... (the supervised command)")
+    args = p.parse_args(argv)
+    child = list(args.child)
+    if child and child[0] == "--":
+        child = child[1:]
+    if not child:
+        p.error("no child command given (append: -- python -m "
+                "raft_tpu.cli.train ...)")
+    args.child = child
+    return args
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _with_resume(cmd):
+    return cmd if "--resume" in cmd else cmd + ["--resume"]
+
+
+def _wait(procs, timeout):
+    """Collect return codes; a hang past ``timeout`` kills the whole
+    attempt and reports the killed rc (negative -> restartable) — a
+    wedged child must not wedge the SUPERVISOR, whose whole job is
+    bounded recovery."""
+    deadline = time.monotonic() + timeout
+    rcs = []
+    for p in procs:
+        left = max(deadline - time.monotonic(), 0.0)
+        try:
+            p.wait(timeout=left or 0.001)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            p.wait()
+        rcs.append(p.returncode)
+    return rcs
+
+
+def aggregate_rc(rcs):
+    """One policy-relevant exit code for a pod attempt: 13 beats a
+    signal kill beats any other nonzero — the supervisor restarts on
+    the first two and must not let a peer's secondary rc mask them
+    (under the pod fence a lost host exits 13 while its peers may exit
+    1 through the typed peer-fatal path)."""
+    if any(rc == ELASTIC_RESUME_EXIT_CODE for rc in rcs):
+        return ELASTIC_RESUME_EXIT_CODE
+    neg = [rc for rc in rcs if rc is not None and rc < 0]
+    if neg:
+        return neg[0]
+    nonzero = [rc for rc in rcs if rc]
+    return nonzero[0] if nonzero else 0
+
+
+def make_launcher(args):
+    """The Attempt -> rc callable scripts/supervise.py feeds the
+    policy: single subprocess or an N-rank gloo pod, quarantined ranks
+    excluded, ``--resume`` appended on restarts."""
+
+    def launch(attempt: Attempt) -> int:
+        cmd = list(args.child)
+        if attempt.resume:
+            cmd = _with_resume(cmd)
+        if not args.pod:
+            print(f"supervise: attempt {attempt.index}: "
+                  f"{' '.join(cmd)}", file=sys.stderr)
+            proc = subprocess.Popen(cmd)
+            return _wait([proc], args.launch_timeout)[0]
+        ranks = args.pod - len(attempt.excluded)
+        if ranks < 1:
+            print(f"supervise: all {args.pod} ranks quarantined "
+                  f"({attempt.excluded}); nothing left to launch",
+                  file=sys.stderr)
+            return 1
+        env_base = dict(os.environ)
+        per_rank_devices = None
+        if args.cpu_devices:
+            if args.cpu_devices % ranks:
+                print(f"supervise: --cpu-devices {args.cpu_devices} "
+                      f"does not divide {ranks} rank(s); keeping "
+                      f"XLA_FLAGS untouched", file=sys.stderr)
+            else:
+                per_rank_devices = args.cpu_devices // ranks
+        if ranks == 1:
+            # single-process elastic resume: no coordinator, no
+            # --multihost (jax.distributed has no 1-process mode here);
+            # the sharded restore re-shards N->1 by construction
+            cmd = [c for c in cmd if c != "--multihost"]
+            env = dict(env_base)
+            for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES",
+                      "PROCESS_ID"):
+                env.pop(k, None)
+            if per_rank_devices:
+                env["XLA_FLAGS"] = ("--xla_force_host_platform_device_"
+                                    f"count={per_rank_devices}")
+            print(f"supervise: attempt {attempt.index}: 1 rank "
+                  f"(excluded: {attempt.excluded or 'none'}): "
+                  f"{' '.join(cmd)}", file=sys.stderr)
+            proc = subprocess.Popen(cmd, env=env)
+            return _wait([proc], args.launch_timeout)[0]
+        port = _free_port()
+        procs = []
+        for rank in range(ranks):
+            env = dict(env_base,
+                       COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                       NUM_PROCESSES=str(ranks), PROCESS_ID=str(rank))
+            if per_rank_devices:
+                env["XLA_FLAGS"] = ("--xla_force_host_platform_device_"
+                                    f"count={per_rank_devices}")
+            procs.append(subprocess.Popen(cmd, env=env))
+        print(f"supervise: attempt {attempt.index}: {ranks} rank(s) "
+              f"(excluded: {attempt.excluded or 'none'})",
+              file=sys.stderr)
+        rcs = _wait(procs, args.launch_timeout)
+        print(f"supervise: attempt {attempt.index} rank rcs: {rcs}",
+              file=sys.stderr)
+        return aggregate_rc(rcs)
+
+    return launch
+
+
+def main(argv=None) -> int:
+    import logging
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    args = parse_args(argv)
+    ledger = None
+    if args.ledger:
+        # events.py only — importing the obs package (or anything that
+        # drags jax) would tax every supervised restart
+        from raft_tpu.obs.events import RunLedger
+
+        ledger = RunLedger(args.ledger, meta={
+            "entry": "supervise",
+            "pod": args.pod, "child": args.child,
+            "quarantine": args.quarantine,
+        })
+
+    def record(kind, detail):
+        if ledger is not None:
+            ledger.incident(kind, step=0, detail=detail)
+
+    sup = RunSupervisor(
+        make_launcher(args),
+        policy=RestartPolicy(
+            max_restarts=args.max_restarts,
+            backoff_base_s=args.backoff_base,
+            backoff_cap_s=args.backoff_cap,
+            crash_loop_restarts=args.crash_loop_restarts,
+            crash_loop_window_s=args.crash_loop_window),
+        quarantine_file=args.quarantine,
+        record=record)
+    rc = sup.run()
+    summary = sup.summary() | {"final_rc": rc}
+    if ledger is not None:
+        ledger.close(summary=summary)
+    print(json.dumps({"supervise_summary": summary}), flush=True)
+    if rc == CRASH_LOOP_EXIT_CODE:
+        print(f"supervise: CRASH LOOP — terminating after "
+              f"{sup.restarts} restart(s); exit {rc}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

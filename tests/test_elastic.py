@@ -81,6 +81,9 @@ def test_shard_manifest_roundtrip_on_mesh(tmp_path):
         assert len(manifest["sha256"]) == 64
     ok, reason, meta = verify_shard_set(base)
     assert ok, reason
+    # param_digest joined the agreement quorum (resilience/sdc.py
+    # checksum fence): every writer digests the same replicated values
+    assert isinstance(meta.pop("param_digest"), int)
     assert meta == {"step": 7, "fingerprint": "beef", "shards": 2}
     restored = restore_checkpoint_sharded(base, _mini_state())
     assert int(restored.step) == 7

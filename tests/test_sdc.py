@@ -1,0 +1,833 @@
+"""Silent-corruption defense (resilience/sdc.py), the run supervisor
+(resilience/supervisor.py + scripts/supervise.py), the param-digest
+checkpoint fence (training/state.py) and the serving canary
+(serve/server.py).
+
+Fast lane: pure-unit coverage over fakes (digests, vote/replay
+verdicts, quarantine bookkeeping, restart policy, fence
+reject-and-fallback, canary choreography, taxonomy/report pins).  The
+slow lane holds THE flagship gate: a 2-process pod with ``grad-skew``
+injected on p1 -> typed ``sdc-detected`` localizing p1 within one vote
+window -> quarantine -> supervisor-driven elastic relaunch -> merged
+loss trajectory matches the unkilled twin within the PR 6 pinned
+tolerance.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fault grammar: grad-skew / param-flip
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_grad_skew_and_param_flip_parse():
+    from raft_tpu.resilience import parse_fault_spec
+
+    faults = parse_fault_spec("grad-skew@4:1,param-flip@2")
+    assert [(f.kind, f.arg, f.count) for f in faults] == \
+        [("grad-skew", 4, 1), ("param-flip", 2, 1)]
+    # grad-skew's second field is a PROCESS INDEX defaulting to 0
+    (f,) = parse_fault_spec("grad-skew@4")
+    assert (f.arg, f.count) == (4, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        parse_fault_spec("param-flip@0")
+
+
+def test_grad_skew_scales_digest_only_on_target_process():
+    from raft_tpu.resilience import FaultPlan
+    from raft_tpu.resilience.faults import GRAD_SKEW_EPS
+
+    plan = FaultPlan.from_spec("grad-skew@3")      # process 0 = this one
+    m = plan.skew_metrics(3, {"grad_digest": jnp.float32(2.0),
+                              "loss": jnp.float32(1.0)})
+    assert float(m["grad_digest"]) == pytest.approx(2.0 * (1 + GRAD_SKEW_EPS))
+    assert float(m["loss"]) == 1.0                 # only the digest
+    assert plan.summary() == {"grad-skew": 1}
+    # wrong step or wrong process: untouched, not consumed
+    plan2 = FaultPlan.from_spec("grad-skew@3:1")   # targets p1, we are p0
+    m2 = plan2.skew_metrics(3, {"grad_digest": jnp.float32(2.0)})
+    assert float(m2["grad_digest"]) == 2.0
+    assert plan2.summary() == {}
+
+
+# ---------------------------------------------------------------------------
+# digests
+# ---------------------------------------------------------------------------
+
+def test_param_tree_digest_detects_single_bit_flip_and_leaf_swap():
+    from raft_tpu.resilience.sdc import param_tree_digest
+
+    tree = {"a": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": {"c": np.ones(5, dtype=np.float32)}}
+    d = param_tree_digest(tree)
+    assert isinstance(d, int) and 0 <= d < 2 ** 32
+    assert param_tree_digest(tree) == d            # deterministic
+    flipped = {"a": tree["a"].copy(), "b": {"c": tree["b"]["c"].copy()}}
+    flipped["a"].view(np.uint8).reshape(-1)[0] ^= 1
+    assert param_tree_digest(flipped) != d         # one mantissa LSB
+    swapped = {"a": tree["b"]["c"], "b": {"c": tree["a"]}}
+    assert param_tree_digest(swapped) != d         # order-sensitive
+    assert param_tree_digest({}) == 0
+
+
+def test_grad_tree_digest_positive_and_skew_visible():
+    from raft_tpu.resilience.sdc import float_bits_hex
+    from raft_tpu.training.step import grad_tree_digest
+
+    g = {"a": jnp.asarray([1.0, -2.0], jnp.float32),
+         "b": jnp.ones((2, 2), jnp.bfloat16)}
+    d = float(grad_tree_digest(g))
+    assert d == 7.0                                # abs-sum, f32 accum
+    assert float_bits_hex(d * 1.001) != float_bits_hex(d)
+    assert float_bits_hex(d) == float_bits_hex(7.0)
+
+
+# ---------------------------------------------------------------------------
+# quarantine bookkeeping
+# ---------------------------------------------------------------------------
+
+def test_quarantine_merge_idempotent_and_tolerant(tmp_path):
+    from raft_tpu.resilience.sdc import read_quarantine, write_quarantine
+
+    q = str(tmp_path / "quarantine.json")
+    assert read_quarantine(q) == []                # absent = empty
+    write_quarantine(q, [1], "vote at step 4")
+    write_quarantine(q, [1, 2], "vote at step 8")  # merge, no dupes
+    entries = read_quarantine(q)
+    assert sorted(e["process"] for e in entries) == [1, 2]
+    with open(q, "w") as f:
+        f.write("{garbage")
+    assert read_quarantine(q) == []                # unreadable = empty
+
+
+# ---------------------------------------------------------------------------
+# SDCPolicy: replay-verify sentinel (single-process mode)
+# ---------------------------------------------------------------------------
+
+def _policy(vote_every=2, channel=None, qfile=None):
+    from raft_tpu.resilience.sdc import SDCPolicy
+
+    return SDCPolicy(vote_every, channel=channel, quarantine_file=qfile)
+
+
+def _fake_state(values):
+    return types.SimpleNamespace(
+        params={"w": np.asarray(values, np.float32)})
+
+
+def test_replay_sentinel_clean_and_mismatch():
+    pol = _policy(vote_every=2)
+    assert pol.wants_capture(2) and not pol.wants_capture(3)
+    pol.capture(2, _fake_state([1.0]), {"x": 1})
+    pol.on_window(1, [{"grad_digest": 5.0}, {"grad_digest": 7.0}])
+    # replay agrees bit-exact -> healthy
+    ok = pol.at_boundary(2, lambda s, b: (s, {"grad_digest": 7.0}))
+    assert ok is None and pol.replays == 1
+    # next cadence: recorded value skewed vs replay -> verdict
+    pol.capture(4, _fake_state([1.0]), {"x": 2})
+    pol.on_window(3, [{"grad_digest": 5.0}, {"grad_digest": 7.007}])
+    verdict = pol.at_boundary(4, lambda s, b: (s, {"grad_digest": 7.0}))
+    assert verdict is not None
+    assert verdict["kind"] == "sdc-replay-mismatch"
+    assert verdict["step"] == 4 and verdict["culprits"] == [0]
+    assert "replay-verify sentinel" in verdict["detail"]
+    assert pol.summary()["mismatches"] == {"sdc-replay-mismatch": 1}
+
+
+def test_wants_capture_only_the_step_a_boundary_checks():
+    from raft_tpu.resilience.sdc import SDCPolicy
+
+    # window 1 (sum_freq=1): every cadence step is its window's last
+    pol = SDCPolicy(2, window=1)
+    assert [s for s in range(1, 9) if pol.wants_capture(s)] == [2, 4, 6, 8]
+    # vote_every 10 under sum_freq 100: only step 100 is ever voted —
+    # capturing 10..90 would pay 9 device_get syncs for nothing
+    pol = SDCPolicy(10, window=100)
+    assert [s for s in range(1, 201) if pol.wants_capture(s)] == [100, 200]
+    # cadence coarser than the window: every cadence step is checked
+    pol = SDCPolicy(100, window=10)
+    assert [s for s in range(1, 301) if pol.wants_capture(s)] == [100, 200, 300]
+
+
+def test_replay_sentinel_noop_without_digest_or_capture():
+    pol = _policy(vote_every=2)
+    # no digests harvested: nothing to do
+    assert pol.at_boundary(2, None) is None
+    # digest without a matching capture: skipped, not a false positive
+    pol.on_window(1, [{"grad_digest": 1.0}, {"grad_digest": 2.0}])
+    assert pol.at_boundary(2, None) is None
+    assert pol.replays == 0
+
+
+# ---------------------------------------------------------------------------
+# SDCPolicy: pod vote + replay arbitration
+# ---------------------------------------------------------------------------
+
+class _VoteChannel:
+    """Scripted pod channel: gather() returns this process's value plus
+    scripted peer values keyed by topic prefix ('sdc' / 'sdcblame')."""
+
+    def __init__(self, process_index=0, process_count=2):
+        self.process_index = process_index
+        self.process_count = process_count
+        self.script = {}                     # prefix -> {pid: value}
+        self.topics = []
+
+    def gather(self, topic, value, timeout_s=60.0):
+        self.topics.append((topic, str(value)))
+        out = {self.process_index: str(value)}
+        out.update(self.script.get(topic.split("@")[0], {}))
+        return out
+
+
+def test_vote_agreement_is_healthy_and_costs_no_replay():
+    from raft_tpu.resilience.sdc import float_bits_hex
+
+    ch = _VoteChannel(process_index=0)
+    pol = _policy(vote_every=2, channel=ch)
+    pol.capture(2, _fake_state([1.0, 2.0]), None)
+    pol.on_window(1, [{"grad_digest": 3.0}, {"grad_digest": 7.0}])
+    # peer posts the identical digest+param value p0 will post
+    from raft_tpu.resilience.sdc import param_tree_digest
+    pd = param_tree_digest({"w": np.asarray([1.0, 2.0], np.float32)})
+    ch.script["sdc"] = {1: f"{float_bits_hex(7.0)}/{pd:08x}"}
+    assert pol.at_boundary(2, None) is None
+    assert pol.votes == 1 and pol.digests_compared == 2
+    assert pol.replays == 0                  # healthy path never replays
+
+
+def test_vote_mismatch_localizes_via_replay_arbitration(tmp_path):
+    from raft_tpu.resilience.sdc import float_bits_hex, read_quarantine
+
+    q = str(tmp_path / "quarantine.json")
+    ch = _VoteChannel(process_index=0)
+    pol = _policy(vote_every=2, channel=ch, qfile=q)
+    pol.capture(2, _fake_state([1.0]), {"b": 0})
+    pol.on_window(1, [{"grad_digest": 1.0}, {"grad_digest": 7.0}])
+    # the peer's digest differs (it was skewed); our replay agrees with
+    # our recorded value, the peer self-blames through the blame gather
+    ch.script["sdc"] = {1: f"{float_bits_hex(7.007)}/deadbeef"}
+    ch.script["sdcblame"] = {1: "1"}
+    verdict = pol.at_boundary(2,
+                              lambda s, b: (s, {"grad_digest": 7.0}))
+    assert verdict is not None and verdict["kind"] == "sdc-detected"
+    assert verdict["culprits"] == [1]
+    assert "p1" in verdict["detail"]
+    assert [e["process"] for e in read_quarantine(q)] == [1]
+    # our own blame vote said clean
+    blame = [v for t, v in ch.topics if t.startswith("sdcblame")]
+    assert blame == ["0"]
+
+
+def test_vote_mismatch_minority_fallback_without_self_blame(tmp_path):
+    from raft_tpu.resilience.sdc import float_bits_hex
+
+    from raft_tpu.resilience.sdc import param_tree_digest
+
+    # 3 voters, no replay self-blame anywhere (e.g. the param digests
+    # split, grads agreed): the digest minority is quarantined
+    ch = _VoteChannel(process_index=0, process_count=3)
+    pol = _policy(vote_every=2, channel=ch,
+                  qfile=str(tmp_path / "q.json"))
+    pol.capture(2, _fake_state([1.0]), None)
+    pol.on_window(1, [{"grad_digest": 7.0}, {"grad_digest": 7.0}])
+    pd = param_tree_digest({"w": np.asarray([1.0], np.float32)})
+    good = f"{float_bits_hex(7.0)}/{pd:08x}"     # == p0's own vote
+    ch.script["sdc"] = {1: f"{float_bits_hex(7.0)}/deadbeef", 2: good}
+    ch.script["sdcblame"] = {1: "0", 2: "0"}
+    verdict = pol.at_boundary(2,
+                              lambda s, b: (s, {"grad_digest": 7.0}))
+    assert verdict is not None and verdict["culprits"] == [1]
+    assert "digest minority" in verdict["detail"]
+
+
+def test_vote_tie_quarantines_all_disagreeing_voters(tmp_path):
+    from raft_tpu.resilience.sdc import float_bits_hex
+
+    # 2-way tie AND no self-blame: cannot localize — quarantine both
+    # (over-quarantine is operator-recoverable; training on a
+    # corrupting host is not)
+    ch = _VoteChannel(process_index=0, process_count=2)
+    pol = _policy(vote_every=2, channel=ch,
+                  qfile=str(tmp_path / "q.json"))
+    pol.capture(2, _fake_state([1.0]), None)
+    pol.on_window(1, [{"grad_digest": 6.0}, {"grad_digest": 7.0}])
+    ch.script["sdc"] = {1: f"{float_bits_hex(7.0)}/ffffffff"}
+    ch.script["sdcblame"] = {1: "0"}
+    verdict = pol.at_boundary(2,
+                              lambda s, b: (s, {"grad_digest": 7.0}))
+    assert verdict is not None and verdict["culprits"] == [0, 1]
+    assert "cannot localize" in verdict["detail"]
+
+
+# ---------------------------------------------------------------------------
+# supervisor: restart policy + crash-loop fence
+# ---------------------------------------------------------------------------
+
+def test_supervisor_exit_code_pins():
+    from raft_tpu.parallel.elastic import WATCHDOG_EXIT_CODE
+    from raft_tpu.resilience.supervisor import (CRASH_LOOP_EXIT_CODE,
+                                                ELASTIC_RESUME_EXIT_CODE)
+
+    # supervisor.py deliberately avoids importing jax-heavy
+    # parallel/elastic; this pin keeps the duplicated constant honest
+    assert ELASTIC_RESUME_EXIT_CODE == WATCHDOG_EXIT_CODE == 13
+    assert CRASH_LOOP_EXIT_CODE == 15
+
+
+def test_supervisor_classify_table():
+    from raft_tpu.resilience.supervisor import RunSupervisor
+
+    assert RunSupervisor.classify(0) == "done"
+    assert RunSupervisor.classify(13) == "restart"
+    assert RunSupervisor.classify(-9) == "restart"   # signal-killed
+    assert RunSupervisor.classify(1) == "stop"
+    assert RunSupervisor.classify(2) == "stop"
+    assert RunSupervisor.classify(14) == "stop"
+
+
+def test_supervisor_restart_resume_and_done(tmp_path):
+    from raft_tpu.resilience.supervisor import RunSupervisor
+
+    seq = [13, -15, 0]
+    attempts = []
+
+    def launch(a):
+        attempts.append((a.index, a.resume, tuple(a.excluded)))
+        return seq[a.index]
+
+    slept = []
+    sup = RunSupervisor(launch, sleep=slept.append)
+    assert sup.run() == 0
+    assert attempts == [(0, False, ()), (1, True, ()), (2, True, ())]
+    assert sup.restarts == 2 and len(slept) == 2
+    assert slept == [1.0, 2.0]                  # exponential backoff
+
+
+def test_supervisor_rereads_quarantine_between_attempts(tmp_path):
+    from raft_tpu.resilience.sdc import write_quarantine
+    from raft_tpu.resilience.supervisor import RunSupervisor
+
+    q = str(tmp_path / "quarantine.json")
+    seen = []
+
+    def launch(a):
+        seen.append(tuple(a.excluded))
+        if a.index == 0:
+            # the run quarantined a host DURING this attempt
+            write_quarantine(q, [1], "sdc vote")
+            return 13
+        return 0
+
+    sup = RunSupervisor(launch, quarantine_file=q, sleep=lambda s: None)
+    assert sup.run() == 0
+    assert seen == [(), (1,)]
+
+
+def test_supervisor_crash_loop_fence_and_budget(tmp_path):
+    from raft_tpu.resilience.supervisor import (CRASH_LOOP_EXIT_CODE,
+                                                RestartPolicy,
+                                                RunSupervisor)
+
+    incidents = []
+    sup = RunSupervisor(
+        lambda a: 13,
+        policy=RestartPolicy(backoff_base_s=0.0, crash_loop_restarts=2,
+                             crash_loop_window_s=60.0),
+        record=lambda k, d: incidents.append((k, d)),
+        sleep=lambda s: None)
+    assert sup.run() == CRASH_LOOP_EXIT_CODE
+    assert incidents and incidents[0][0] == "crash-loop"
+    assert "2" in incidents[0][1]
+    # restarts spaced OUTSIDE the window never trip the fence; the
+    # total budget does instead
+    clock = {"t": 0.0}
+
+    def tick():
+        clock["t"] += 100.0
+        return clock["t"]
+
+    sup2 = RunSupervisor(
+        lambda a: 13,
+        policy=RestartPolicy(max_restarts=3, backoff_base_s=0.0,
+                             crash_loop_restarts=2,
+                             crash_loop_window_s=50.0),
+        record=lambda k, d: incidents.append((k, d)),
+        clock=tick, sleep=lambda s: None)
+    assert sup2.run() == CRASH_LOOP_EXIT_CODE
+    assert sup2.restarts == 3
+    assert "budget exhausted" in incidents[-1][1]
+
+
+def test_supervise_cli_aggregate_rc():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from supervise import aggregate_rc
+    finally:
+        sys.path.pop(0)
+    assert aggregate_rc([0, 0]) == 0
+    assert aggregate_rc([13, 1]) == 13           # 13 beats peer-fatal 1
+    assert aggregate_rc([1, 13]) == 13
+    assert aggregate_rc([-9, 1]) == -9           # signal beats fatal
+    assert aggregate_rc([1, 0]) == 1
+
+
+# ---------------------------------------------------------------------------
+# param-digest checkpoint fence (training/state.py)
+# ---------------------------------------------------------------------------
+
+def _mini_state(step=0, scale=0.0):
+    import optax
+
+    from raft_tpu.training.state import TrainState
+
+    tx = optax.adam(1e-3)
+    params = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3) + scale}
+    return TrainState.create(apply_fn=None, params=params, tx=tx,
+                             batch_stats={}, rng=jax.random.PRNGKey(0)
+                             ).replace(step=jnp.asarray(step))
+
+
+def test_manifest_carries_param_digest_and_restore_verifies(tmp_path):
+    from raft_tpu.training.state import (manifest_path,
+                                         restore_latest_verified,
+                                         save_checkpoint)
+
+    path = str(tmp_path / "10_exp.msgpack")
+    save_checkpoint(path, _mini_state(step=10), fingerprint="cafe")
+    manifest = json.loads(open(manifest_path(path)).read())
+    assert isinstance(manifest["param_digest"], int)
+    incidents = []
+    restored, got = restore_latest_verified(
+        str(tmp_path), _mini_state(), prefix="exp",
+        on_incident=lambda k, d: incidents.append((k, d)))
+    assert got == path and incidents == []
+    assert int(restored.step) == 10
+
+
+def test_param_flip_passes_bytes_but_fails_fence(tmp_path):
+    """THE fence scenario: the param-flip fault leaves a checkpoint
+    whose size/sha256 verify CLEAN (the manifest was re-hashed, as a
+    corruption upstream of hashing would) — only the value-level digest
+    can reject it, falling back to the older verified save."""
+    from raft_tpu.resilience import FaultPlan
+    from raft_tpu.training.state import (restore_latest_verified,
+                                         save_checkpoint,
+                                         verify_checkpoint)
+
+    old = str(tmp_path / "5_exp.msgpack")
+    new = str(tmp_path / "10_exp.msgpack")
+    save_checkpoint(old, _mini_state(step=5), fingerprint="cafe")
+    time.sleep(0.05)                      # distinct mtimes: new wins
+    save_checkpoint(new, _mini_state(step=10, scale=1.0),
+                    fingerprint="cafe")
+    plan = FaultPlan.from_spec("param-flip@1")
+    plan.after_checkpoint_save(new)
+    assert plan.summary() == {"param-flip": 1}
+    ok, reason = verify_checkpoint(new)
+    assert ok, reason                     # bytes verify clean!
+    incidents = []
+    restored, got = restore_latest_verified(
+        str(tmp_path), _mini_state(), prefix="exp",
+        on_incident=lambda k, d: incidents.append((k, d)))
+    assert got == old                     # fence rejected the newest
+    assert int(restored.step) == 5
+    assert incidents and incidents[0][0] == "ckpt-corrupt"
+    assert "param-tree digest mismatch" in incidents[0][1]
+
+
+def test_shard_manifests_agree_on_param_digest(tmp_path):
+    from raft_tpu.training.state import (manifest_path,
+                                         save_checkpoint_sharded,
+                                         shard_path, verify_shard_set)
+
+    base = str(tmp_path / "7_exp.msgpack")
+    state = _mini_state(step=7)
+    for i in range(2):
+        save_checkpoint_sharded(base, state, i, 2, fingerprint="beef")
+    ok, reason, meta = verify_shard_set(base)
+    assert ok, reason
+    assert isinstance(meta["param_digest"], int)
+    m0 = json.loads(open(manifest_path(shard_path(base, 0, 2))).read())
+    m1 = json.loads(open(manifest_path(shard_path(base, 1, 2))).read())
+    # the full-tree digest, identical from every writer (replicated
+    # state) — a shard set whose writers disagreed would fail quorum
+    assert m0["param_digest"] == m1["param_digest"] \
+        == meta["param_digest"]
+
+
+# ---------------------------------------------------------------------------
+# taxonomy + report
+# ---------------------------------------------------------------------------
+
+def test_sdc_taxonomy_severity_pins():
+    from raft_tpu.obs.events import DEFAULT_INCIDENT_SEVERITY
+
+    for kind in ("sdc-detected", "sdc-replay-mismatch",
+                 "sdc-serve-canary", "crash-loop"):
+        assert DEFAULT_INCIDENT_SEVERITY[kind] == "fatal", kind
+
+
+def _rec(kind, **kw):
+    return {"v": 1, "kind": kind, "t": 0.0, "run": "r1", **kw}
+
+
+def test_report_renders_sdc_subsection_and_pod_merge():
+    from raft_tpu.obs.report import (build_pod_report, build_report,
+                                     render_pod_report, render_report)
+
+    sdc = {"vote_every": 2, "votes": 3, "digests_compared": 6,
+           "replays": 1, "mismatches": {"sdc-detected": 1},
+           "quarantined": ["p1"]}
+    records = [
+        _rec("run_start", meta={"entry": "train"}),
+        _rec("incident", incident="sdc-detected", step=4,
+             detail="vote disagreed", severity="fatal"),
+        _rec("run_end", summary={"sdc": sdc}),
+    ]
+    rep = build_report(records)
+    assert rep["resilience"]["sdc"] == sdc
+    text = render_report(rep)
+    assert "sdc: 3 vote(s), 6 digest(s) compared, 1 replay(s)" in text
+    assert "sdc-detected=1" in text and "quarantined: p1" in text
+    # clean armed run still shows the subsection (proof it RAN)
+    clean = build_report([
+        _rec("run_start", meta={}),
+        _rec("run_end", summary={"sdc": {"vote_every": 2, "votes": 5,
+                                         "digests_compared": 10,
+                                         "replays": 0}})])
+    assert "sdc: 5 vote(s)" in render_report(clean)
+    # pod merge: counters sum, quarantine union dedupes
+    pod = build_pod_report({0: records, 1: records})
+    assert pod["resilience"]["sdc"]["votes"] == 6
+    assert pod["resilience"]["sdc"]["quarantined"] == ["p1"]
+    assert "sdc: 6 vote(s)" in render_pod_report(pod)
+
+
+def test_report_renders_serving_canary_line():
+    from raft_tpu.obs.report import build_report, render_report
+
+    records = [
+        _rec("run_start", meta={"entry": "serve"}),
+        _rec("run_end", summary={"serving": {
+            "submitted": 8, "served": 8, "rejected_total": 0,
+            "unaccounted": 0,
+            "canary": {"probes": 4, "mismatches": 1, "recompiles": 1,
+                       "families": 1}}}),
+    ]
+    text = render_report(build_report(records))
+    assert "sdc canary: 4 probe(s)" in text
+    assert "1 mismatch(es)" in text and "1 recompile-and-recheck(s)" in text
+
+
+# ---------------------------------------------------------------------------
+# serving canary (stub engine: pure choreography, no compiles)
+# ---------------------------------------------------------------------------
+
+class _StubEngine:
+    batch_size = 2
+    warm_channels = 2
+    aot = None
+    spans = None
+
+    def __init__(self, heal_on_invalidate=True):
+        self.scale = np.float32(1.0)
+        self.invalidated = 0
+        self._heal = heal_on_invalidate
+
+    def warmup(self, fams, levels, warm_too=True):
+        return 0.0
+
+    def is_compiled(self, hw, iters, warm=False):
+        return True
+
+    def invalidate(self, hw, iters, warm=False):
+        self.invalidated += 1
+        if self._heal:
+            self.scale = np.float32(1.0)
+        return True
+
+    def forward(self, hw, iters, img1, img2, flow_init=None):
+        H, W = hw
+        B = self.batch_size
+        low = np.full((B, H // 8, W // 8, 2), 0.5, np.float32)
+        up = img1[..., :2] * np.float32(0.001) * self.scale
+        return low * self.scale, up
+
+
+def _canary_server(engine, every=1):
+    from raft_tpu.serve.server import FlowServer
+
+    return FlowServer(engine, buckets={"session": (16, 16)},
+                      queue_capacity=8, iter_levels=(4, 2),
+                      slo_ms=None, degrade=False, canary_every=every)
+
+
+def _drive(server, n=2):
+    futs = []
+    for _ in range(n):
+        img = np.random.default_rng(0).uniform(
+            0, 255, (16, 16, 3)).astype(np.float32)
+        futs.append(server.submit(img, img))
+    for f in futs:
+        f.result(timeout=30)
+
+
+def _wait_for(cond, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_canary_clean_then_mismatch_recompile_recovers():
+    eng = _StubEngine(heal_on_invalidate=True)
+    server = _canary_server(eng, every=1)
+    try:
+        server.warmup()
+        assert len(server._canary) == 1       # one golden pair recorded
+        _drive(server, 2)                     # batch 1 -> clean probe
+        assert _wait_for(lambda:
+                         server._canary_counts["probes"] >= 1)
+        assert server._canary_counts["mismatches"] == 0
+        eng.scale = np.float32(1.001)         # the flaky chip
+        _drive(server, 2)                     # batch 2 -> probe trips
+        assert _wait_for(lambda:
+                         server._canary_counts["mismatches"] >= 1)
+        assert eng.invalidated >= 1
+        assert server._canary_counts["recompiles"] >= 1
+        assert server.ready()                 # recheck healed: serving
+        assert server._incident_counts.get("sdc-serve-canary") == 1
+    finally:
+        summary = server.close()
+    canary = summary["canary"]
+    assert canary["mismatches"] == 1 and canary["families"] == 1
+
+
+def test_canary_persistent_mismatch_flips_readiness():
+    eng = _StubEngine(heal_on_invalidate=False)
+    server = _canary_server(eng, every=1)
+    try:
+        server.warmup()
+        assert server.ready()
+        eng.scale = np.float32(1.001)
+        _drive(server, 2)
+        assert _wait_for(lambda: server._canary_failed)
+        assert not server.ready()             # the replica drains
+        assert not server.health()["ready"]
+        assert server.health()["canary_failed"]
+    finally:
+        server.close()
+
+
+def test_canary_disabled_costs_nothing():
+    eng = _StubEngine()
+    server = _canary_server(eng, every=0)
+    try:
+        server.warmup()
+        assert server._canary == {}
+        _drive(server, 2)
+        assert server._canary_counts["probes"] == 0
+        assert "canary" not in server.serving_summary()
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# THE flagship gate (slow): pod vote -> quarantine -> supervised
+# elastic relaunch -> trajectory matches the unkilled twin
+# ---------------------------------------------------------------------------
+
+def _twin_env():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _pod_cli(workdir, steps):
+    return [sys.executable, "-m", "raft_tpu.cli.train",
+            "--stage", "synthetic", "--small", "--iters", "2",
+            "--batch_size", "2", "--image_size", "64", "64",
+            "--num_steps", str(steps), "--sum_freq", "1",
+            "--val_freq", "2", "--keep_ckpts", "4",
+            "--no_tensorboard", "--seed", "7", "--name", "twin",
+            "--data_parallel", "2", "--multihost",
+            "--sdc_vote_every", "2",
+            "--checkpoint_dir", os.path.join(workdir, "ckpts"),
+            "--log_dir", os.path.join(workdir, "runs")]
+
+
+def _run_pod_twin(workdir, steps, extra, env, expect_rcs):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        penv = dict(env,
+                    XLA_FLAGS="--xla_force_host_platform_device_count=1",
+                    COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                    NUM_PROCESSES="2", PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            _pod_cli(workdir, steps) + extra, cwd=REPO, env=penv,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            out, _ = p.communicate()
+            out = (out or "") + "\nTIMEOUT"
+        outs.append(out or "")
+    rcs = [p.returncode for p in procs]
+    assert rcs == expect_rcs, (rcs, outs[0][-3000:], outs[1][-3000:])
+    return outs
+
+
+def _losses_by_step(ledger_path, run_index=-1):
+    from raft_tpu.obs.events import read_ledger
+
+    records = read_ledger(ledger_path)
+    run_ids = [r["run"] for r in records if r["kind"] == "run_start"]
+    picked = run_ids[run_index]
+    return {r["step"]: r["means"]["loss"] for r in records
+            if r.get("kind") == "metrics" and r["run"] == picked}
+
+
+# Cross-topology amplification envelope: the 2-proc gloo pod and the
+# 1-proc (2 virtual device) resume lower the gradient all-reduce with
+# different f32 accumulation order (~1e-7 on the first replayed step on
+# this container), and training chaos amplifies that per step (measured
+# 1.5e-5 by the 3rd replayed step, 5e-3 by the 5th; PR 7's elastic
+# flagship has the same property and its pinned 1e-6 fails at the BASE
+# tree here).  The first replayed step is pinned at the PR 6 rtol —
+# that is the restore-fidelity claim — and the full post-fault
+# trajectory at this envelope; bit-level faithfulness is proven by the
+# matched-topology replayability leg below instead.
+CROSS_TOPOLOGY_RTOL = 2e-2
+
+
+def _run_supervised_lifecycle(workdir, env, N):
+    """One full supervised run: pod attempt dies typed at the step-4
+    vote, the supervisor relaunches 1 rank elastically with --resume."""
+    os.makedirs(workdir, exist_ok=True)
+    qfile = os.path.join(workdir, "ckpts", "quarantine.json")
+    cmd = [sys.executable, os.path.join(REPO, "scripts", "supervise.py"),
+           "--pod", "2", "--cpu-devices", "2", "--backoff-base", "0.1",
+           "--quarantine", qfile,
+           "--ledger", os.path.join(workdir, "supervise.jsonl"),
+           "--"] + _pod_cli(workdir, N) + ["--inject", "grad-skew@4:1"]
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          timeout=900)
+    assert proc.returncode == 0, proc.stdout[-4000:]
+    # deterministic resume point: the step-3 state saved at the step-3
+    # boundary, one full step before the fault fired
+    assert "at step 3" in proc.stdout, proc.stdout[-3000:]
+    return proc, qfile
+
+
+@pytest.mark.slow
+def test_sdc_flagship_vote_localizes_quarantines_and_supervised_resume_matches_twin(tmp_path):
+    """THE SDC acceptance gate: 2-proc pod, grad-skew injected on p1 at
+    step 4 -> typed sdc-detected names p1 within one vote window (the
+    step-4 vote, compared at the step-4 boundary) -> p1 quarantined ->
+    coordinated rc 13 -> scripts/supervise.py relaunches elastically
+    (1 rank, 2 virtual devices, --resume, p1 excluded) -> the merged
+    loss trajectory matches the unkilled twin: EXACTLY pre-fault,
+    within the PR 6 pinned 1e-6 rtol on the first post-rollback step
+    (restore fidelity across the 2->1 re-shard), and inside the
+    measured cross-topology envelope after; a SECOND full supervised
+    lifecycle reproduces the resumed trajectory BIT-exactly (the
+    rollback-relaunch is replayable, same-topology)."""
+    env = _twin_env()
+    N = 8
+
+    # the unkilled twin: same pod shape, SDC armed, no fault — its
+    # votes must all agree (the healthy path is load-bearing too)
+    clean = str(tmp_path / "clean")
+    os.makedirs(clean)
+    _run_pod_twin(clean, N, [], env, [0, 0])
+    unkilled = _losses_by_step(
+        os.path.join(clean, "runs", "twin", "events.jsonl.p0"))
+    assert sorted(unkilled) == list(range(1, N + 1))
+
+    faulted = str(tmp_path / "faulted")
+    proc, qfile = _run_supervised_lifecycle(faulted, env, N)
+
+    # the vote localized and quarantined exactly p1
+    qdoc = json.loads(open(qfile).read())
+    assert [e["process"] for e in qdoc["quarantined"]] == [1]
+
+    # typed trail: sdc-detected (fatal) on the pod ledgers, naming p1
+    from raft_tpu.obs.events import read_ledger
+    pod_ledger = os.path.join(faulted, "runs", "twin",
+                              "events.jsonl.p0")
+    incidents = [r for r in read_ledger(pod_ledger)
+                 if r.get("kind") == "incident"
+                 and r.get("incident") == "sdc-detected"]
+    assert incidents and "p1" in incidents[0]["detail"]
+    assert incidents[0]["step"] == 4           # within one vote window
+    assert incidents[0]["severity"] == "fatal"
+
+    # merged trajectory vs the twin
+    pod_half = _losses_by_step(pod_ledger, run_index=0)
+    resumed = _losses_by_step(
+        os.path.join(faulted, "runs", "twin", "events.jsonl"))
+    assert sorted(resumed) == list(range(4, N + 1))
+    merged = {s: v for s, v in pod_half.items() if s <= 3}
+    merged.update(resumed)
+    assert set(range(1, N + 1)) <= set(merged)
+    # pre-fault prefix: same topology, fresh computation -> EXACT
+    for s in range(1, 4):
+        assert merged[s] == unkilled[s], (s, merged[s], unkilled[s])
+    # first post-rollback step: the PR 6 pinned tolerance — the 2-shard
+    # set restored bit-faithfully into the shrunken pod
+    np.testing.assert_allclose(merged[4], unkilled[4], rtol=1e-6, atol=0,
+                               err_msg="restore across the 2->1 re-shard "
+                                       "is not faithful")
+    # full post trajectory: the cross-topology envelope (see constant)
+    post = np.asarray([merged[s] for s in range(4, N + 1)])
+    ref = np.asarray([unkilled[s] for s in range(4, N + 1)])
+    np.testing.assert_allclose(post, ref, rtol=CROSS_TOPOLOGY_RTOL,
+                               atol=0,
+                               err_msg="supervised rollback-relaunch "
+                                       "diverged from the unkilled twin "
+                                       "beyond the measured envelope")
+
+    # the supervisor's own books: one elastic restart, clean finish
+    summary = json.loads(
+        [ln for ln in proc.stdout.splitlines()
+         if ln.startswith('{"supervise_summary"')][-1])["supervise_summary"]
+    assert summary["restarts"] == 1 and summary["final_rc"] == 0
+    assert summary["excluded"] == [1]
+
+    # replayability: a second, fully independent supervised lifecycle
+    # reproduces the resumed trajectory BIT-exactly (same checkpoint
+    # bits, same topology, same executable) — detection, quarantine,
+    # rollback and relaunch are deterministic end to end
+    twin2 = str(tmp_path / "faulted2")
+    _run_supervised_lifecycle(twin2, env, N)
+    resumed2 = _losses_by_step(
+        os.path.join(twin2, "runs", "twin", "events.jsonl"))
+    assert resumed2 == resumed, (resumed2, resumed)
