@@ -42,6 +42,8 @@ import time
 
 import numpy as np
 
+from raft_tpu.resilience.exit_codes import ExitCode
+
 A100_BASELINE_PAIRS_PER_S = 7.0
 
 # Dense bf16 peak FLOP/s by TPU generation (device_kind substrings,
@@ -66,7 +68,7 @@ def _fail(reason: str, backend_down: bool = True) -> None:
         "vs_baseline": 0.0,
         "error": reason + suffix,
     }))
-    sys.exit(1)
+    sys.exit(ExitCode.FATAL)
 
 
 def preflight(timeout_s: int = 150) -> str:

@@ -24,6 +24,14 @@ Engine-specific extras:
   participation consistent, and NO stale inline waivers (staleness
   gates here; ``--audits coverage,budgets,trace,participation,waivers``
   selects sub-checks).
+- ``--engine concurrency`` runs the concurrency & incident-contract
+  auditor over the threaded serve/resilience stack: lock discipline,
+  incident-taxonomy conformance (both directions), the typed
+  exit-code registry, Future terminal-claim discipline, and
+  thread-boundary I/O guards (``--audits
+  locks,incidents,exitcodes,terminals,threadio`` selects rule
+  families).  Pure stdlib AST — never imports jax, so it needs no
+  CPU-device forcing and finishes in seconds.
 - ``--prune-budgets`` previews the ledger rows a full
   ``--update-budgets`` run would drop (entries that no longer exist in
   the registry), then exits 0.
@@ -74,8 +82,9 @@ def collect_waivers(paths) -> list:
     rot), plus the data-declared jaxpr/HLO waiver tuples.
 
     Activity comes from registry_audit.active_waiver_keys — the SAME
-    computation engine 5's stale-waiver gate uses (engine-1 rules plus
-    the coverage scan, so an inline ``unregistered-entrypoint`` waiver
+    computation engine 5's stale-waiver gate uses (engine-1 rules,
+    engine-6's concurrency rules, plus the coverage scan, so an inline
+    ``unregistered-entrypoint`` or ``unclaimed-terminal`` waiver
     counts as active here exactly when the gate says so).
     """
     import inspect
@@ -147,14 +156,15 @@ def main(argv=None) -> int:
         "python -m raft_tpu.analysis",
         description="graftlint: AST lint + jaxpr audit + HLO "
                     "collective/cost audit + numerics/Pallas audit + "
-                    "registry coverage audit for raft_tpu")
+                    "registry coverage audit + concurrency/incident "
+                    "audit for raft_tpu")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories for the AST engine "
                         "(default: raft_tpu/, scripts/, bench.py, "
                         "__graft_entry__.py)")
     p.add_argument("--engine",
                    choices=["lint", "jaxpr", "hlo", "numerics",
-                            "registry", "all"],
+                            "registry", "concurrency", "all"],
                    default="all")
     p.add_argument("--rules", default=None,
                    help="comma-separated lint rule ids to run "
@@ -250,6 +260,11 @@ def main(argv=None) -> int:
             from raft_tpu.analysis.registry_audit import CHECKS
 
             known |= set(CHECKS)
+        if args.engine in ("concurrency", "all"):
+            from raft_tpu.analysis.concurrency_audit import \
+                CHECKS as CONC_CHECKS
+
+            known |= set(CONC_CHECKS)
         unknown = sorted(set(audits) - known)
         if unknown:
             p.error(f"unknown audit(s) {unknown}; known: {sorted(known)}")
@@ -357,8 +372,39 @@ def main(argv=None) -> int:
             all_findings += rfs
             report["registry"] = rreport
         timings["registry"] = round(time.monotonic() - t0, 2)
+    if args.engine in ("concurrency", "all"):
+        # pure AST — no platform setup, no jax import (pinned by
+        # tests/test_static_analysis.py's jax-free check)
+        t0 = time.monotonic()
+        from raft_tpu.analysis.concurrency_audit import \
+            CHECKS as CONC_CHECKS, run_concurrency_audit
+
+        conc_names = audits
+        if audits is not None:
+            conc_names = [a for a in audits if a in CONC_CHECKS]
+        if conc_names != []:
+            cfs, creport = run_concurrency_audit(
+                conc_names, paths=args.paths or None)
+            all_findings += cfs
+            report["concurrency"] = creport
+        timings["concurrency"] = round(time.monotonic() - t0, 2)
 
     report["engine_timings"] = timings
+    # the merged per-engine summary scripts/graftlint.py --json
+    # aggregates across its six subprocesses (satellite: one
+    # machine-readable verdict per engine, not five interleaved blobs)
+    by_engine = {}
+    for f in all_findings:
+        by_engine.setdefault(f.engine, []).append(f)
+    report["engines"] = {}
+    for eng, secs in timings.items():
+        efs = by_engine.get(eng, [])
+        unwaived = [f for f in efs
+                    if not f.waived and f.severity == "error"]
+        report["engines"][eng] = {
+            "status": "findings" if unwaived else "clean",
+            "findings": len(efs), "unwaived": len(unwaived),
+            "seconds": secs}
     out = (fmod.render_json(all_findings, report) if args.json
            else fmod.render_text(all_findings, report,
                                  verbose=args.verbose))

@@ -1,0 +1,879 @@
+"""graftlint engine 6: concurrency & incident-contract auditor.
+
+The serve/resilience stack is threaded — batcher loops, watchdog
+daemons, replica done-callbacks, heartbeat publishers, background
+checkpoint writers — and PRs 10-15's review rounds kept hand-catching
+the same five defect classes.  This engine makes each one a
+structural, file:line-attributed exit-1 check (the same philosophy as
+engines 1-5: the invariant is stated once, as code, and the tree is
+gated on it):
+
+``locks``
+    Lock discipline (the PR-10 round-4 "counters under ONE lock hold"
+    class).  Per class, the lock-GUARDED attribute set is inferred
+    from ``with self._lock:`` bodies: any ``self.X`` the class ever
+    writes under its lock is a shared-state attribute.  Any write to a
+    guarded attribute from a method reachable — without the lock held
+    — off a thread entry point (a ``threading.Thread(target=...)``,
+    an ``add_done_callback``, or a ``self.<method>``/lambda escaped as
+    a callback argument) is an ``unguarded-write`` finding.
+
+``incidents``
+    Incident-contract conformance, both directions.  Every literal
+    incident kind at a writer call (``*.incident(...)``,
+    ``*_incident(...)``, ``on_incident(...)``) must exist in
+    ``DEFAULT_INCIDENT_SEVERITY`` (``unknown-incident-kind``), and a
+    literal ``severity=`` stamp must be the taxonomy default, an
+    escalation to "fatal", or a demotion sanctioned by
+    ``ALLOWED_SEVERITY_OVERRIDES`` (``incident-severity-drift``).
+    In the other direction every taxonomy kind must be written
+    somewhere in the production tree (``orphan-incident-kind``) and
+    referenced by at least one test or chaos row
+    (``untested-incident-kind``) — taxonomy rot is a finding, not a
+    code comment.
+
+``exitcodes``
+    The typed exit codes live in ONE place
+    (:mod:`raft_tpu.resilience.exit_codes`).  A bare
+    ``os._exit(<int>)``/``sys.exit(<int>)`` literal
+    (``bare-exit-literal``), a module-level ``*_EXIT_CODE = <int>``
+    assignment outside the registry (``exit-code-constant``), or a
+    returncode comparison against a bare registry integer
+    (``exit-code-comparison``) is a finding.
+
+``terminals``
+    Terminal-claim discipline (the PR-14 "served AND rejected" class).
+    Every ``Future.set_result``/``set_exception`` site must be
+    dominated by a ``set_running_or_notify_cancel()`` claim on the
+    same future within the same function — unless the future was
+    created in that same function (single-owner, nobody else can
+    race the claim).  Violations are ``unclaimed-terminal``.
+
+``threadio``
+    Thread-boundary I/O guards (the PR-10 round-5 ENOSPC class).
+    Ledger writes (any call through a ``ledger`` receiver, a
+    ``spans.flush``, or a builtin ``open``) reachable from a thread
+    entry point must sit inside a ``try`` whose handlers catch
+    ``OSError``/``ValueError`` (or broader) — full-disk on a daemon
+    thread must degrade the ledger, never kill the batcher.
+    Violations are ``unguarded-thread-io``.
+
+Everything is stdlib ``ast`` — no jax import, so the engine runs in
+well under a second and keeps ``scripts/graftlint.py``'s parallel gate
+wall clock pinned by the compile-heavy engines.  ``raft_tpu/analysis/``
+itself is out of scope by design (its fixtures seed violations on
+purpose).  Findings respect the shared inline-waiver machinery
+(``# graftlint: disable=<rule> -- <reason>``), and engine 5's
+stale-waiver gate counts this engine's waivers as active.
+
+Scoping model: with explicit ``paths`` (the seeded-fixture tests),
+every rule runs over exactly those files, and the taxonomy is taken
+from a ``DEFAULT_INCIDENT_SEVERITY`` definition found IN those files
+when present (falling back to the repo's ``obs/events.py`` for kind
+validation).  The repo-wide directions (``orphan-incident-kind``
+requires the production scan; ``untested-incident-kind`` requires the
+test tree) run only when their scan scope is real: orphans whenever
+the taxonomy definition itself is inside the scanned paths, test
+references only on a default (repo) run.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from raft_tpu.analysis import budgets as budgets_mod
+from raft_tpu.analysis.findings import Finding
+
+CHECKS = ("locks", "incidents", "exitcodes", "terminals", "threadio")
+
+# -- rule (b): incident-contract --------------------------------------------
+
+# call names (last dotted segment) treated as incident writers; the
+# first positional argument (or incident=/kind=) names the kind
+WRITER_NAMES = ("incident", "_incident", "on_incident", "_on_incident",
+                "record_incident", "write_incident")
+
+# -- rule (c): exit codes ---------------------------------------------------
+
+# the one module allowed to spell termination codes as integers
+EXIT_REGISTRY_BASENAME = "exit_codes.py"
+# registry integers a returncode comparison must name, not inline
+# (0/1/2 stay comparable as bare ints — they are generic unix codes)
+TYPED_EXIT_INTS = (13, 14, 15)
+
+# -- rule (a)/(e): lock & thread inference ----------------------------------
+
+# method-call names on a self attribute that count as WRITES to it
+# when inferring (and enforcing) the lock-guarded attribute set
+MUTATOR_NAMES = frozenset({
+    "append", "appendleft", "add", "clear", "discard", "extend",
+    "insert", "move_to_end", "pop", "popleft", "popitem", "remove",
+    "setdefault", "sort", "update"})
+
+# exception names that satisfy the thread-boundary I/O guard
+GUARD_EXC_NAMES = frozenset({
+    "OSError", "IOError", "ValueError", "Exception", "BaseException"})
+
+
+def _dotted(node: ast.AST) -> Optional[List[str]]:
+    """``self.ledger.incident`` -> ["self","ledger","incident"]; None
+    when the chain bottoms out in something that is not a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> "X" (one level only)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _recv_key(func: ast.Attribute) -> Optional[str]:
+    """Stable receiver identity for ``<recv>.set_result`` matching."""
+    chain = _dotted(func.value)
+    return ".".join(chain) if chain else None
+
+
+def _self_methods_in(node: ast.AST) -> Set[str]:
+    """Every ``self.<m>`` referenced anywhere under ``node`` — used to
+    extract thread targets / escaped callbacks from arbitrary
+    expressions (conditional targets, lambdas, partials)."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        attr = _self_attr(n)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+def _is_future_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    chain = _dotted(value.func)
+    return bool(chain) and chain[-1] == "Future"
+
+
+def _catches_guard_excs(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:           # bare except
+        return True
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    names = set()
+    for t in types:
+        chain = _dotted(t)
+        if chain:
+            names.add(chain[-1])
+    # the convention guards BOTH OSError (disk) and ValueError (closed
+    # file object); broader catches subsume it
+    if names & {"Exception", "BaseException"}:
+        return True
+    return ("OSError" in names or "IOError" in names) \
+        and "ValueError" in names
+
+
+class _MethodFacts:
+    """Per-method facts rules (a)/(e) consume."""
+
+    def __init__(self, name: str, lineno: int):
+        self.name = name
+        self.lineno = lineno
+        # (attr, line, under_lock) for every self.X write
+        self.writes: List[Tuple[str, int, bool]] = []
+        # (callee, line, under_lock) for every self.<m>() call
+        self.calls: List[Tuple[str, int, bool]] = []
+        # (dotted chain, line, guarded) for ledger/file I/O sites
+        self.io_calls: List[Tuple[str, int, bool]] = []
+
+
+class _ClassFacts(ast.NodeVisitor):
+    """One class's lock/thread/shared-state structure."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.name = cls.name
+        self.lock_attrs: Set[str] = set()
+        self.guarded_attrs: Dict[str, int] = {}   # attr -> first line
+        self.methods: Dict[str, _MethodFacts] = {}
+        self.thread_entries: Dict[str, int] = {}  # method -> line
+        self._collect_locks(cls)
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_method(item)
+
+    # .. lock attribute discovery ..........................................
+
+    def _collect_locks(self, cls: ast.ClassDef) -> None:
+        for node in ast.walk(cls):
+            # self._lock = threading.Lock() / RLock() / Condition(...)
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                chain = _dotted(node.value.func)
+                if chain and chain[-1] in ("Lock", "RLock", "Condition"):
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr:
+                            self.lock_attrs.add(attr)
+            # any `with self.X:` where X smells like a lock
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr and "lock" in attr.lower():
+                        self.lock_attrs.add(attr)
+
+    def _is_lock_ctx(self, expr: ast.AST) -> bool:
+        attr = _self_attr(expr)
+        return attr is not None and (attr in self.lock_attrs
+                                     or "lock" in attr.lower())
+
+    # .. per-method walk ....................................................
+
+    def _walk_method(self, fn: ast.FunctionDef) -> None:
+        facts = _MethodFacts(fn.name, fn.lineno)
+        self.methods[fn.name] = facts
+        init = fn.name in ("__init__", "__new__")
+
+        def walk(node: ast.AST, locked: bool, guarded: bool) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                now_locked = locked or any(
+                    self._is_lock_ctx(i.context_expr) for i in node.items)
+                for item in node.items:
+                    walk(item.context_expr, locked, guarded)
+                for child in node.body:
+                    walk(child, now_locked, guarded)
+                return
+            if isinstance(node, ast.Try):
+                body_guarded = guarded or any(
+                    _catches_guard_excs(h) for h in node.handlers)
+                for child in node.body:
+                    walk(child, locked, body_guarded)
+                for h in node.handlers:
+                    walk(h, locked, guarded)
+                for child in node.orelse + node.finalbody:
+                    walk(child, locked, guarded)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    base = tgt
+                    if isinstance(base, (ast.Subscript, ast.Starred)):
+                        base = base.value
+                    attr = _self_attr(base)
+                    if attr:
+                        facts.writes.append((attr, tgt.lineno, locked))
+                        if locked and not init:
+                            self.guarded_attrs.setdefault(attr, tgt.lineno)
+            if isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    base = (tgt.value if isinstance(tgt, ast.Subscript)
+                            else tgt)
+                    attr = _self_attr(base)
+                    if attr:
+                        facts.writes.append((attr, tgt.lineno, locked))
+                        if locked and not init:
+                            self.guarded_attrs.setdefault(attr, tgt.lineno)
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func)
+                if chain and chain[0] == "self":
+                    if len(chain) == 2:
+                        facts.calls.append((chain[1], node.lineno, locked))
+                    elif len(chain) == 3 and chain[-1] in MUTATOR_NAMES:
+                        # self.X.pop(...) mutates X
+                        facts.writes.append((chain[1], node.lineno, locked))
+                        if locked and not init:
+                            self.guarded_attrs.setdefault(chain[1],
+                                                          node.lineno)
+                if chain:
+                    is_io = ("ledger" in (s.lower() for s in chain[:-1])
+                             or (chain[-1] == "flush"
+                                 and "spans" in chain[:-1])
+                             or chain == ["open"])
+                    if is_io:
+                        facts.io_calls.append((".".join(chain),
+                                               node.lineno, guarded))
+                self._collect_thread_entries(node)
+            for child in ast.iter_child_nodes(node):
+                walk(child, locked, guarded)
+
+        for stmt in fn.body:
+            walk(stmt, False, False)
+
+    def _collect_thread_entries(self, call: ast.Call) -> None:
+        chain = _dotted(call.func)
+        if not chain:
+            return
+        if chain[-1] == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    for m in _self_methods_in(kw.value):
+                        self.thread_entries.setdefault(m, call.lineno)
+        elif chain[-1] == "add_done_callback":
+            for arg in call.args:
+                for m in _self_methods_in(arg):
+                    self.thread_entries.setdefault(m, call.lineno)
+        else:
+            # self.<m> (or a lambda closing over it) escaping as a
+            # callback argument: watchdog on_incident=..., ledger
+            # record=..., health sentinel wiring.  Conservative: any
+            # self-method referenced inside an argument that is not a
+            # plain call on self is treated as thread-reachable.
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    for m in _self_methods_in(arg.body):
+                        self.thread_entries.setdefault(m, call.lineno)
+                else:
+                    attr = _self_attr(arg)
+                    if attr:
+                        self.thread_entries.setdefault(attr, call.lineno)
+
+    # .. reachability ........................................................
+
+    def reachable(self, lock_free_only: bool) -> Set[str]:
+        """Methods reachable from a thread entry.  With
+        ``lock_free_only`` an edge taken under the lock does not
+        propagate (the callee runs with the lock held — its writes are
+        guarded by the caller's hold)."""
+        seen: Set[str] = set()
+        frontier = [m for m in self.thread_entries if m in self.methods]
+        while frontier:
+            m = frontier.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            for callee, _line, locked in self.methods[m].calls:
+                if callee not in self.methods:
+                    continue
+                if lock_free_only and locked:
+                    continue
+                if callee not in seen:
+                    frontier.append(callee)
+        return seen
+
+
+# --------------------------------------------------------------------------
+# file scan
+# --------------------------------------------------------------------------
+
+class _FileScan:
+    """Everything the five rules need from one parsed file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.classes = [_ClassFacts(n) for n in ast.walk(tree)
+                        if isinstance(n, ast.ClassDef)]
+        # module-level NAME = "literal" constants (incident kinds ride
+        # through names like CACHE_CORRUPT_INCIDENT)
+        self.str_constants: Dict[str, str] = {}
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.str_constants[tgt.id] = node.value.value
+        # every string constant in the file (the lenient writer scan)
+        self.all_strings: Set[str] = {
+            n.value for n in ast.walk(tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+def default_scan_paths() -> List[str]:
+    """The production tree minus ``analysis/`` (whose fixtures seed
+    violations on purpose) — same exclusion rule as engine 5."""
+    from raft_tpu.analysis.__main__ import default_paths
+    from raft_tpu.analysis.lint import iter_python_files
+
+    analysis_dir = os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for p in iter_python_files(default_paths()):
+        if os.path.dirname(os.path.abspath(p)).startswith(analysis_dir):
+            continue
+        out.append(p)
+    return out
+
+
+def _load(paths: Sequence[str]) -> List[_FileScan]:
+    from raft_tpu.analysis.lint import iter_python_files
+
+    scans = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError):
+            continue
+        scans.append(_FileScan(path, source, tree))
+    return scans
+
+
+# --------------------------------------------------------------------------
+# rule (a): lock discipline
+# --------------------------------------------------------------------------
+
+def check_locks(scans: Sequence[_FileScan]) -> List[Finding]:
+    out: List[Finding] = []
+    for scan in scans:
+        for cls in scan.classes:
+            if not cls.thread_entries or not cls.guarded_attrs:
+                continue
+            reach = cls.reachable(lock_free_only=True)
+            for mname in sorted(reach):
+                facts = cls.methods[mname]
+                if facts.name in ("__init__", "__new__"):
+                    continue
+                for attr, line, locked in facts.writes:
+                    if locked or attr not in cls.guarded_attrs:
+                        continue
+                    entry = min(cls.thread_entries.items(),
+                                key=lambda kv: kv[1])
+                    out.append(Finding(
+                        engine="concurrency", rule="unguarded-write",
+                        path=budgets_mod.display_path(scan.path),
+                        line=line,
+                        message=f"{cls.name}.{mname} writes self.{attr} "
+                                f"without the lock, but {cls.name} "
+                                f"guards self.{attr} under its lock "
+                                f"elsewhere (first at line "
+                                f"{cls.guarded_attrs[attr]}) and "
+                                f"{mname} is reachable from the thread "
+                                f"entry {entry[0]} (line {entry[1]}) — "
+                                f"take the lock around this write or "
+                                f"stop sharing the attribute",
+                        data={"class": cls.name, "method": mname,
+                              "attr": attr}))
+    return out
+
+
+# --------------------------------------------------------------------------
+# rule (b): incident contract
+# --------------------------------------------------------------------------
+
+def _parse_taxonomy(scan: _FileScan) -> Optional[Dict]:
+    """``DEFAULT_INCIDENT_SEVERITY`` (+ severities and sanctioned
+    overrides) parsed STATICALLY from a file that defines it."""
+    tax: Optional[Dict] = None
+    for node in scan.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if "DEFAULT_INCIDENT_SEVERITY" in names and isinstance(
+                node.value, ast.Dict):
+            tax = tax or {"path": scan.path, "kinds": {}, "severities":
+                          set(), "overrides": {}}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant)
+                        and isinstance(v, ast.Constant)):
+                    tax["kinds"][k.value] = (v.value, k.lineno)
+        if "INCIDENT_SEVERITIES" in names and isinstance(
+                node.value, (ast.Tuple, ast.List)):
+            tax = tax or {"path": scan.path, "kinds": {}, "severities":
+                          set(), "overrides": {}}
+            tax["severities"] = {e.value for e in node.value.elts
+                                 if isinstance(e, ast.Constant)}
+        if "ALLOWED_SEVERITY_OVERRIDES" in names and isinstance(
+                node.value, ast.Dict):
+            tax = tax or {"path": scan.path, "kinds": {}, "severities":
+                          set(), "overrides": {}}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant)
+                        and isinstance(v, (ast.Tuple, ast.List))):
+                    tax["overrides"][k.value] = {
+                        e.value for e in v.elts
+                        if isinstance(e, ast.Constant)}
+    return tax
+
+
+def _repo_taxonomy() -> Optional[Dict]:
+    events = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "obs", "events.py")
+    if not os.path.exists(events):
+        return None
+    with open(events, encoding="utf-8") as f:
+        source = f.read()
+    return _parse_taxonomy(_FileScan(events, source, ast.parse(source)))
+
+
+def _test_reference_text() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    chunks = []
+    for cand in ([os.path.join(root, "scripts", "chaos_dryrun.py")]
+                 + sorted(
+                     os.path.join(root, "tests", f)
+                     for f in (os.listdir(os.path.join(root, "tests"))
+                               if os.path.isdir(
+                                   os.path.join(root, "tests")) else [])
+                     if f.endswith(".py"))):
+        try:
+            with open(cand, encoding="utf-8") as f:
+                chunks.append(f.read())
+        except OSError:
+            continue
+    return "\n".join(chunks)
+
+
+def _writer_kind(call: ast.Call, scan: _FileScan) -> Optional[Tuple]:
+    """(kind, line) for a writer call with a resolvable literal kind."""
+    cand: Optional[ast.AST] = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg in ("incident", "kind"):
+            cand = kw.value
+    if isinstance(cand, ast.Constant) and isinstance(cand.value, str):
+        return cand.value, call.lineno
+    if isinstance(cand, ast.Name) and cand.id in scan.str_constants:
+        return scan.str_constants[cand.id], call.lineno
+    return None
+
+
+def check_incidents(scans: Sequence[_FileScan],
+                    check_tests: bool) -> Tuple[List[Finding], Dict]:
+    out: List[Finding] = []
+    tax = None
+    tax_in_scan = False
+    for scan in scans:
+        parsed = _parse_taxonomy(scan)
+        if parsed and parsed["kinds"]:
+            tax, tax_in_scan = parsed, True
+            break
+    if tax is None:
+        tax = _repo_taxonomy()
+    report = {"kinds": len(tax["kinds"]) if tax else 0,
+              "writer_sites": 0}
+    if tax is None:
+        return out, report
+    tax_path = os.path.abspath(tax["path"])
+
+    written: Set[str] = set()
+    for scan in scans:
+        if os.path.abspath(scan.path) == tax_path:
+            continue
+        written |= tax["kinds"].keys() & scan.all_strings
+        for node in ast.walk(scan.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func)
+            if not chain or chain[-1] not in WRITER_NAMES:
+                continue
+            got = _writer_kind(node, scan)
+            if got is None:
+                continue
+            kind, line = got
+            report["writer_sites"] += 1
+            if kind not in tax["kinds"]:
+                out.append(Finding(
+                    engine="concurrency", rule="unknown-incident-kind",
+                    path=budgets_mod.display_path(scan.path), line=line,
+                    message=f"incident kind {kind!r} is not in "
+                            f"DEFAULT_INCIDENT_SEVERITY "
+                            f"({budgets_mod.display_path(tax['path'])})"
+                            f" — typed incidents must come from the "
+                            f"taxonomy; add the kind (with its default "
+                            f"severity) before writing it",
+                    data={"kind": kind}))
+                continue
+            for kw in node.keywords:
+                if kw.arg != "severity" or not isinstance(kw.value,
+                                                          ast.Constant):
+                    continue
+                sev = kw.value.value
+                default, _kline = tax["kinds"][kind]
+                allowed = ({default, "fatal"}
+                           | tax["overrides"].get(kind, set()))
+                if tax["severities"] and sev not in tax["severities"]:
+                    allowed = set()     # not even a valid severity
+                if sev not in allowed:
+                    out.append(Finding(
+                        engine="concurrency",
+                        rule="incident-severity-drift",
+                        path=budgets_mod.display_path(scan.path),
+                        line=line,
+                        message=f"incident {kind!r} stamped severity="
+                                f"{sev!r} but the taxonomy default is "
+                                f"{default!r} and the demotion is not "
+                                f"in ALLOWED_SEVERITY_OVERRIDES — "
+                                f"document the recovery path there or "
+                                f"drop the stamp",
+                        data={"kind": kind, "severity": sev}))
+
+    if tax_in_scan:
+        for kind, (sev, line) in sorted(tax["kinds"].items()):
+            if kind not in written:
+                out.append(Finding(
+                    engine="concurrency", rule="orphan-incident-kind",
+                    path=budgets_mod.display_path(tax["path"]),
+                    line=line,
+                    message=f"taxonomy kind {kind!r} has no writer in "
+                            f"the production tree — nothing can ever "
+                            f"ledger it; delete the row or wire the "
+                            f"writer",
+                    data={"kind": kind}))
+    if check_tests and tax_in_scan:
+        text = _test_reference_text()
+        for kind, (sev, line) in sorted(tax["kinds"].items()):
+            if f'"{kind}"' in text or f"'{kind}'" in text:
+                continue
+            out.append(Finding(
+                engine="concurrency", rule="untested-incident-kind",
+                path=budgets_mod.display_path(tax["path"]), line=line,
+                message=f"taxonomy kind {kind!r} is never referenced "
+                        f"by tests/ or the chaos matrix — an incident "
+                        f"no test can observe regresses silently; "
+                        f"reference it from a test or chaos row",
+                data={"kind": kind}))
+    report["written_kinds"] = len(written)
+    return out, report
+
+
+# --------------------------------------------------------------------------
+# rule (c): exit-code registry
+# --------------------------------------------------------------------------
+
+def check_exitcodes(scans: Sequence[_FileScan]) -> List[Finding]:
+    out: List[Finding] = []
+    for scan in scans:
+        if os.path.basename(scan.path) == EXIT_REGISTRY_BASENAME:
+            continue
+        for node in ast.walk(scan.tree):
+            if isinstance(node, ast.Call):
+                chain = _dotted(node.func)
+                if (chain and chain[-1] in ("_exit", "exit")
+                        and chain[0] in ("os", "sys", "exit", "_exit")
+                        and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, int)
+                        and not isinstance(node.args[0].value, bool)):
+                    fn = ".".join(chain)
+                    val = node.args[0].value
+                    out.append(Finding(
+                        engine="concurrency", rule="bare-exit-literal",
+                        path=budgets_mod.display_path(scan.path),
+                        line=node.lineno,
+                        message=f"{fn}({val}) spells a termination "
+                                f"code as a bare integer — use "
+                                f"raft_tpu.resilience.exit_codes."
+                                f"ExitCode so the supervisor policy "
+                                f"table and the chaos matrix stay in "
+                                f"sync with it",
+                        data={"value": val}))
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                        node.value.value, int):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Name)
+                            and tgt.id.endswith("_EXIT_CODE")):
+                        out.append(Finding(
+                            engine="concurrency",
+                            rule="exit-code-constant",
+                            path=budgets_mod.display_path(scan.path),
+                            line=node.lineno,
+                            message=f"{tgt.id} = "
+                                    f"{node.value.value} re-declares a "
+                                    f"typed exit code outside "
+                                    f"resilience/exit_codes.py — "
+                                    f"import the registry member "
+                                    f"instead of pinning a copy",
+                            data={"name": tgt.id,
+                                  "value": node.value.value}))
+            if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                sides = [node.left] + node.comparators
+                lits = [s for s in sides
+                        if isinstance(s, ast.Constant)
+                        and s.value in TYPED_EXIT_INTS
+                        and not isinstance(s.value, bool)]
+                names = []
+                for s in sides:
+                    chain = _dotted(s)
+                    if chain:
+                        names.append(chain[-1].lower())
+                if lits and any("rc" in n or "returncode" in n
+                                or "exit" in n or "code" in n
+                                for n in names):
+                    out.append(Finding(
+                        engine="concurrency", rule="exit-code-comparison",
+                        path=budgets_mod.display_path(scan.path),
+                        line=node.lineno,
+                        message=f"returncode compared against bare "
+                                f"{lits[0].value} — name the "
+                                f"exit_codes.ExitCode member so the "
+                                f"policy reads as the verdict it "
+                                f"checks",
+                        data={"value": lits[0].value}))
+    return out
+
+
+# --------------------------------------------------------------------------
+# rule (d): terminal-claim discipline
+# --------------------------------------------------------------------------
+
+def check_terminals(scans: Sequence[_FileScan]) -> List[Finding]:
+    out: List[Finding] = []
+    for scan in scans:
+        funcs = [n for n in ast.walk(scan.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # innermost enclosing function per terminal site
+        for fn in funcs:
+            nested = {id(sub) for sub in ast.walk(fn)
+                      for subfn in [sub]
+                      if isinstance(subfn, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))
+                      and subfn is not fn
+                      for sub2 in ast.walk(subfn)
+                      for sub in [sub2] if sub2 is not subfn}
+            own = [n for n in ast.walk(fn)
+                   if id(n) not in nested or n is fn]
+            claims: List[Tuple[str, int]] = []
+            local_futures: Set[str] = set()
+            terminals: List[Tuple[str, int, str]] = []
+            for node in own:
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    if node.value is not None and _is_future_ctor(
+                            node.value):
+                        for tgt in targets:
+                            if isinstance(tgt, ast.Name):
+                                local_futures.add(tgt.id)
+                if not isinstance(node, ast.Call) or not isinstance(
+                        node.func, ast.Attribute):
+                    continue
+                recv = _recv_key(node.func)
+                if recv is None:
+                    continue
+                if node.func.attr == "set_running_or_notify_cancel":
+                    claims.append((recv, node.lineno))
+                elif node.func.attr in ("set_result", "set_exception"):
+                    terminals.append((recv, node.lineno, node.func.attr))
+            for recv, line, what in terminals:
+                if recv in local_futures:
+                    continue        # single owner: created right here
+                if any(c_recv == recv and c_line <= line
+                       for c_recv, c_line in claims):
+                    continue
+                out.append(Finding(
+                    engine="concurrency", rule="unclaimed-terminal",
+                    path=budgets_mod.display_path(scan.path), line=line,
+                    message=f"{recv}.{what} is not dominated by a "
+                            f"{recv}.set_running_or_notify_cancel() "
+                            f"claim in {fn.name} — two resolution "
+                            f"paths (or a consumer cancel) can race "
+                            f"this terminal into InvalidStateError or "
+                            f"a double-served request; claim the "
+                            f"future exactly once before resolving it",
+                    data={"receiver": recv, "terminal": what}))
+    return out
+
+
+# --------------------------------------------------------------------------
+# rule (e): thread-boundary I/O guards
+# --------------------------------------------------------------------------
+
+def check_threadio(scans: Sequence[_FileScan]) -> List[Finding]:
+    out: List[Finding] = []
+    for scan in scans:
+        for cls in scan.classes:
+            if not cls.thread_entries:
+                continue
+            reach = cls.reachable(lock_free_only=False)
+            for mname in sorted(reach):
+                for chain, line, guarded in cls.methods[mname].io_calls:
+                    if guarded:
+                        continue
+                    entry = min(cls.thread_entries.items(),
+                                key=lambda kv: kv[1])
+                    out.append(Finding(
+                        engine="concurrency", rule="unguarded-thread-io",
+                        path=budgets_mod.display_path(scan.path),
+                        line=line,
+                        message=f"{cls.name}.{mname} performs ledger/"
+                                f"file I/O ({chain}) on a path "
+                                f"reachable from the thread entry "
+                                f"{entry[0]} without the OSError/"
+                                f"ValueError guard — a full disk or a "
+                                f"closed ledger must degrade the "
+                                f"record, never kill the thread",
+                        data={"class": cls.name, "method": mname,
+                              "call": chain}))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the audit
+# --------------------------------------------------------------------------
+
+def run_concurrency_audit(names: Optional[Sequence[str]] = None,
+                          paths: Optional[Sequence[str]] = None
+                          ) -> Tuple[List[Finding], Dict]:
+    """Run the named rule families (default: all of :data:`CHECKS`)
+    over ``paths`` (default: the production tree minus analysis/).
+    Returns ``(findings, report)``; inline waivers applied per file."""
+    selected = set(CHECKS if names is None else names)
+    unknown = selected - set(CHECKS)
+    if unknown:
+        raise KeyError(f"unknown concurrency audit(s) {sorted(unknown)}; "
+                       f"known: {list(CHECKS)}")
+    t0 = time.monotonic()
+    repo_mode = paths is None
+    scans = _load(default_scan_paths() if repo_mode else paths)
+
+    findings: List[Finding] = []
+    report: Dict = {"files": len(scans)}
+    if "locks" in selected:
+        findings += check_locks(scans)
+    if "incidents" in selected:
+        inc, inc_report = check_incidents(scans, check_tests=repo_mode)
+        findings += inc
+        report["incidents"] = inc_report
+    if "exitcodes" in selected:
+        findings += check_exitcodes(scans)
+    if "terminals" in selected:
+        findings += check_terminals(scans)
+    if "threadio" in selected:
+        findings += check_threadio(scans)
+
+    # inline waivers, applied against each finding's own file (taxonomy
+    # findings land on the taxonomy file's lines, so a sanctioned
+    # exception is waived WHERE the kind is declared)
+    from raft_tpu.analysis.lint import apply_waivers, parse_waivers
+
+    sources = {os.path.abspath(s.path): s.source for s in scans}
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    waived: List[Finding] = []
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for rel, fs in by_path.items():
+        ap = rel if os.path.isabs(rel) else os.path.join(root, rel)
+        ap = os.path.abspath(ap)
+        source = sources.get(ap)
+        if source is None:
+            try:
+                with open(ap, encoding="utf-8") as fh:
+                    source = fh.read()
+            except OSError:
+                waived += fs
+                continue
+        waivers, _ = parse_waivers(source, ap)
+        waived += apply_waivers(fs, waivers)
+    rules: Dict[str, int] = {}
+    for f in waived:
+        if not f.waived:
+            rules[f.rule] = rules.get(f.rule, 0) + 1
+    report["rules"] = rules
+    report["seconds"] = round(time.monotonic() - t0, 2)
+    return waived, report

@@ -436,22 +436,35 @@ def active_waiver_keys(paths: Sequence[str],
                        extra_findings: Sequence[Finding] = ()
                        ) -> Set[Tuple[str, int]]:
     """``(abs_path, line)`` of every inline waiver currently
-    suppressing a finding — engine 1's rules plus this engine's
-    coverage findings (``extra_findings``).  ONE implementation shared
-    by :func:`check_waiver_staleness` and ``--list-waivers``'s activity
-    column, so the gate and the inventory can never disagree about
-    which waivers are alive."""
+    suppressing a finding — engine 1's rules, engine 6's concurrency
+    rules, plus this engine's coverage findings (``extra_findings``).
+    ONE implementation shared by :func:`check_waiver_staleness` and
+    ``--list-waivers``'s activity column, so the gate and the
+    inventory can never disagree about which waivers are alive."""
+    from raft_tpu.analysis.concurrency_audit import run_concurrency_audit
     from raft_tpu.analysis.lint import run_lint
 
     lint_findings = run_lint(paths)
     active = {(os.path.abspath(f.path), f.line)
               for f in lint_findings if f.waived}
-    # engine-5 findings carry repo-relative display paths (absolute
+    # engine-6 waivers live on the same inline syntax; run its audit
+    # over the same scope so its suppressions count as alive too (a
+    # concurrency waiver must not show STALE just because engine 1
+    # has no rule at that line).  The audit's own default scope equals
+    # default_paths() minus analysis/, so pass paths straight through
+    # only when the caller narrowed them.
+    from raft_tpu.analysis.__main__ import default_paths
+
+    default_set = {os.path.abspath(p) for p in default_paths()}
+    given_set = {os.path.abspath(p) for p in paths}
+    conc_paths = None if given_set == default_set else paths
+    conc_findings, _ = run_concurrency_audit(paths=conc_paths)
+    # engine-5/6 findings carry repo-relative display paths (absolute
     # when outside the repo): resolve against the repo root
     root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     active |= {(os.path.abspath(os.path.join(root, f.path)), f.line)
-               for f in extra_findings if f.waived}
+               for f in list(extra_findings) + conc_findings if f.waived}
     return active
 
 

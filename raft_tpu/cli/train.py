@@ -336,6 +336,7 @@ def train(args) -> str:
     from raft_tpu.parallel.step import (make_parallel_train_step,
                                         replicate_state)
     from raft_tpu.resilience import FaultPlan, InjectedFatal, RecoveryPolicy
+    from raft_tpu.resilience.exit_codes import ExitCode
     from raft_tpu.training import create_train_state, make_optimizer
     from raft_tpu.training.checkpoint_async import (
         AsyncCheckpointer, install_preemption_handler, preempted)
@@ -700,7 +701,7 @@ def train(args) -> str:
             s["sdc"] = sdc.summary()
         return s | (extra or {})
 
-    def fatal(kind: str, detail: str, exit_code: int = 1,
+    def fatal(kind: str, detail: str, exit_code: int = ExitCode.FATAL,
               announce: bool = True, step=None) -> SystemExit:
         """Typed-incident termination: ledger says why, exit is nonzero
         — the chaos contract's 'cleanly terminated' leg.  Under a pod
@@ -737,7 +738,7 @@ def train(args) -> str:
                 _time.sleep((watchdog.interval if watchdog is not None
                              else 5.0) * 2)
             os._exit(exit_code)
-        if exit_code != 1:
+        if exit_code != ExitCode.FATAL:
             # non-default code single-process: SystemExit(str) exits 1,
             # so the typed detail prints here and the code rides _exit
             print(f"fatal [{kind}]: {detail}", file=sys.stderr)

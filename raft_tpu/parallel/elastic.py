@@ -51,12 +51,17 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from raft_tpu.resilience import exit_codes
+
 logger = logging.getLogger(__name__)
 
 # Exit status for watchdog terminations: distinct from argparse (2) and
 # generic failure (1) so the chaos matrix can assert the DEATH was the
 # watchdog's typed verdict, not a crash that happened to race it.
-WATCHDOG_EXIT_CODE = 13
+# The integer lives in resilience/exit_codes.py (the typed registry
+# graftlint engine 6 gates on); this name stays as the historical
+# import surface (tests, train CLI).
+WATCHDOG_EXIT_CODE = exit_codes.WATCHDOG_EXIT_CODE
 
 # Pre-first-step stall bound, as a multiple of the collective timeout:
 # compilation may legitimately exceed one step-time bound many times

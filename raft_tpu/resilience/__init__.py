@@ -20,11 +20,16 @@ nothing corrupts silently:
 - :mod:`raft_tpu.resilience.supervisor` — the crash-loop-aware run
   supervisor (``scripts/supervise.py``): exit-code-typed restarts,
   bounded backoff, elastic relaunch excluding quarantined hosts;
+- :mod:`raft_tpu.resilience.exit_codes` — the ONE registry of typed
+  termination codes (``ExitCode`` IntEnum) every exit site and the
+  supervisor's policy table draw from; jax-free by design, and
+  graftlint engine 6 gates that no bare integer copy reappears;
 - checkpoint hardening lives with the checkpoints themselves
   (training/state.py: per-save manifest, verify-on-restore,
   fallback restore, keep-last-k retention).
 """
 
+from raft_tpu.resilience.exit_codes import ExitCode
 from raft_tpu.resilience.faults import (Fault, FaultInjectingDataset,
                                         FaultPlan, InjectedFatal,
                                         parse_fault_spec)
@@ -33,6 +38,7 @@ from raft_tpu.resilience.sdc import SDCPolicy, param_tree_digest
 from raft_tpu.resilience.supervisor import (RestartPolicy, RunSupervisor)
 
 __all__ = [
+    "ExitCode",
     "Fault",
     "FaultInjectingDataset",
     "FaultPlan",

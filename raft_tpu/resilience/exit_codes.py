@@ -1,0 +1,67 @@
+"""The typed exit-code registry: every process-termination code in one
+enum, so the supervisor policy table, the chaos matrices and the
+watchdogs all speak from a single source of truth.
+
+PRs 6-15 grew the exit-code contract one constant at a time —
+``WATCHDOG_EXIT_CODE = 13`` in parallel/elastic.py, ``14`` in
+serve/watchdog.py, ``13``/``15`` again in resilience/supervisor.py,
+import-free copies in scripts/chaos_dryrun.py — four files each
+carrying a bare integer whose MEANING lived in a comment somewhere
+else.  graftlint engine 6 (analysis/concurrency_audit.py, rule
+``exitcodes``) now gates the tree on this module being the only
+place a termination code is spelled as an integer: any bare
+``os._exit(<int>)``/``sys.exit(<int>)`` literal or module-level
+``*_EXIT_CODE = <int>`` assignment outside this file is a finding.
+
+The historic module-level names (``WATCHDOG_EXIT_CODE``,
+``SERVE_WATCHDOG_EXIT_CODE``, ``ELASTIC_RESUME_EXIT_CODE``,
+``CRASH_LOOP_EXIT_CODE``) remain importable from their original homes
+as re-exports of these members — the PR-15 jax-free-import pin
+(scripts/supervise.py must start without dragging jax in) holds
+because this module, like resilience/supervisor.py, imports nothing
+heavier than ``enum``.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ExitCode(enum.IntEnum):
+    """Process exit codes with a typed meaning in the restart policy.
+
+    ==============  =======================================================
+    code            meaning / supervisor action
+    ==============  =======================================================
+    OK (0)          schedule completed (or rescue save landed + resumed)
+    FATAL (1)       typed fatal: config/data problem a restart cannot fix
+    USAGE (2)       argparse/CLI usage error — also unretryable
+    ELASTIC_RESUME  (13) "this host set is wrong, state is protected —
+                    relaunch me elastically": the collective watchdog
+                    (host lost), the SDC vote (chip quarantined) and the
+                    replay sentinel share it because the remedy is one
+    SERVE_STALLED   (14) the serve dispatch watchdog tripped — distinct
+                    from 13 so chaos matrices can tell the pod watchdog's
+                    verdict from the serving fleet's
+    CRASH_LOOP      (15) the SUPERVISOR gave up (restart fence/budget) —
+                    distinct from every child code so a wrapper can tell
+                    "the child was fatal" from "the supervisor stopped"
+    ==============  =======================================================
+    """
+
+    OK = 0
+    FATAL = 1
+    USAGE = 2
+    ELASTIC_RESUME = 13
+    SERVE_STALLED = 14
+    CRASH_LOOP = 15
+
+
+# The watchdogs' historical spellings, kept as named aliases so call
+# sites read as the verdict they mean (both are IntEnum members — they
+# compare and format as their integers everywhere, including across a
+# subprocess boundary via proc.returncode).
+WATCHDOG_EXIT_CODE = ExitCode.ELASTIC_RESUME
+SERVE_WATCHDOG_EXIT_CODE = ExitCode.SERVE_STALLED
+ELASTIC_RESUME_EXIT_CODE = ExitCode.ELASTIC_RESUME
+CRASH_LOOP_EXIT_CODE = ExitCode.CRASH_LOOP

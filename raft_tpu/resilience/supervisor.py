@@ -47,6 +47,7 @@ import logging
 import time
 from typing import Callable, Dict, List, Optional
 
+from raft_tpu.resilience import exit_codes
 from raft_tpu.resilience.sdc import read_quarantine
 
 logger = logging.getLogger(__name__)
@@ -55,15 +56,15 @@ logger = logging.getLogger(__name__)
 # protected — relaunch me elastically".  One code shared by the
 # collective watchdog (host lost), the SDC vote (chip quarantined) and
 # the replay sentinel, because the supervisor's remedy is identical.
-# Numerically pinned to parallel/elastic.py WATCHDOG_EXIT_CODE without
-# importing it: the supervisor is a driver-side module and importing
-# raft_tpu.parallel drags jax into every scripts/supervise.py startup
-# (test-pinned equal in tests/test_sdc.py).
-ELASTIC_RESUME_EXIT_CODE = 13
+# The integer lives in resilience/exit_codes.py — a jax-free sibling,
+# so the PR-15 rule (scripts/supervise.py startup must not drag jax in
+# via raft_tpu.parallel) holds; tests/test_sdc.py still pins it equal
+# to parallel/elastic.py WATCHDOG_EXIT_CODE.
+ELASTIC_RESUME_EXIT_CODE = exit_codes.ELASTIC_RESUME_EXIT_CODE
 
 # Distinct from the child's codes (0/1/2/13/14) so a wrapper script can
 # tell "the child was fatal" from "the SUPERVISOR gave up".
-CRASH_LOOP_EXIT_CODE = 15
+CRASH_LOOP_EXIT_CODE = exit_codes.CRASH_LOOP_EXIT_CODE
 
 
 @dataclasses.dataclass(frozen=True)

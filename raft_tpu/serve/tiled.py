@@ -174,6 +174,11 @@ def submit_tiled(server, image1: np.ndarray, image2: np.ndarray,
     results: List[Optional[Dict]] = [None] * len(futures)
 
     def blend_and_resolve() -> None:
+        # claim the frame future exactly once: if the consumer already
+        # cancelled it, drop the blend instead of racing set_result
+        # into InvalidStateError on this thread
+        if not out.set_running_or_notify_cancel():
+            return
         try:
             flows = [r["flow"] for r in results]
             blended = blend_tiles(hw, tile_hw, plan, overlap, flows)
@@ -189,7 +194,11 @@ def submit_tiled(server, image1: np.ndarray, image2: np.ndarray,
             if out.done():
                 return
             if exc is not None:
-                out.set_exception(exc)
+                # the done() check above runs under OUR lock, not the
+                # future's — a consumer cancel can still land between
+                # it and the terminal, so claim before resolving
+                if out.set_running_or_notify_cancel():
+                    out.set_exception(exc)
                 return
             results[i] = f.result()
             remaining[0] -= 1

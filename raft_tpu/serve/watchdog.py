@@ -26,7 +26,12 @@ import threading
 import time
 from typing import Callable, Optional
 
-SERVE_WATCHDOG_EXIT_CODE = 14
+from raft_tpu.resilience import exit_codes
+
+# The integer lives in resilience/exit_codes.py (the typed registry);
+# this name stays as the historical import surface (tests, serve CLI,
+# chaos matrix).
+SERVE_WATCHDOG_EXIT_CODE = exit_codes.SERVE_WATCHDOG_EXIT_CODE
 
 STARTUP_TIMEOUT_FACTOR = 10
 

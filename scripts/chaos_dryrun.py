@@ -136,9 +136,11 @@ import tempfile
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-WATCHDOG_EXIT_CODE = 13     # parallel/elastic.py (import-free: workers
-                            # must not drag jax into this driver)
-SERVE_WATCHDOG_EXIT_CODE = 14   # serve/watchdog.py (same import rule)
+# The typed exit-code registry is jax-free by design (unlike
+# raft_tpu.parallel, which this driver must never import), so the
+# import-free integer copies PRs 7-14 carried here are gone.
+from raft_tpu.resilience.exit_codes import (  # noqa: E402
+    CRASH_LOOP_EXIT_CODE, SERVE_WATCHDOG_EXIT_CODE, WATCHDOG_EXIT_CODE)
 
 
 def read_incident_kinds(ledger_path):
@@ -898,8 +900,9 @@ def main(argv=None):
                     seen.update(ks)
                 except (OSError, ValueError):
                     pass
-        if rc != 15:       # CRASH_LOOP_EXIT_CODE (supervisor.py)
-            fail = f"supervisor exit {rc} != 15 (crash-loop)\n{tail}"
+        if rc != CRASH_LOOP_EXIT_CODE:
+            fail = (f"supervisor exit {rc} != {CRASH_LOOP_EXIT_CODE} "
+                    f"(crash-loop)\n{tail}")
         elif "crash-loop" not in seen or "sdc-replay-mismatch" not in seen:
             fail = (f"missing typed incident(s): expected crash-loop + "
                     f"sdc-replay-mismatch, saw {sorted(seen)}")

@@ -1,25 +1,31 @@
 #!/usr/bin/env python
-"""graftlint gate: all five analysis engines, exit nonzero on findings.
+"""graftlint gate: all six analysis engines, exit nonzero on findings.
 
 Thin wrapper over ``python -m raft_tpu.analysis`` so CI lanes and
 pre-push hooks have a stable entry point:
 
-    python scripts/graftlint.py                   # full gate: lint + jaxpr + hlo + numerics + registry
-    python scripts/graftlint.py --engine lint     # sub-second, jax-free
-    python scripts/graftlint.py --engine numerics # dtype/range + Pallas verifier
-    python scripts/graftlint.py --engine registry # entry-point coverage vs entrypoints.py
-    python scripts/graftlint.py --json            # machine-readable
-    python scripts/graftlint.py --list-waivers    # waiver inventory
+    python scripts/graftlint.py                      # full gate: lint + jaxpr + hlo + numerics + registry + concurrency
+    python scripts/graftlint.py --engine lint        # sub-second, jax-free
+    python scripts/graftlint.py --engine numerics    # dtype/range + Pallas verifier
+    python scripts/graftlint.py --engine registry    # entry-point coverage vs entrypoints.py
+    python scripts/graftlint.py --engine concurrency # lock/incident/exit-code/terminal/thread-io audit, jax-free
+    python scripts/graftlint.py --json               # machine-readable, with a per-engine "engines" summary
+    python scripts/graftlint.py --list-waivers       # waiver inventory
 
-The full gate fans the five engines out as PARALLEL subprocesses —
-they are independent (each forces its own 8-virtual-device CPU
-backend), so the wall clock is max(engine) rather than sum(engine):
-the HLO engine's compiles dominate (numerics traces in ~25-40 s, the
-registry auditor ~20 s), keeping the whole gate around ~100 s wall vs
-~150 s serial and inside the tier-1 timeout budget.  A per-engine
-timing line is printed either way.  Any other flag combination (a
-single --engine, --update-budgets, --list-waivers, explicit paths)
-delegates to the module CLI in-process.
+The full gate fans the six engines out as PARALLEL subprocesses —
+they are independent (each jax engine forces its own 8-virtual-device
+CPU backend; lint and concurrency never import jax), so the wall
+clock is max(engine) rather than sum(engine): the HLO engine's
+compiles dominate (numerics traces in ~25-40 s, the registry auditor
+~20 s, concurrency ~3 s), keeping the whole gate around ~100 s wall
+vs ~150 s serial and inside the tier-1 timeout budget.  A per-engine
+timing line is printed either way.  Under ``--json`` the merged
+report carries an ``engines`` map — one row per engine with
+``status`` ("clean" | "findings" | "timeout" | "crash"), finding
+counts, and wall seconds — so CI consumes ONE summary instead of six
+interleaved blobs.  Any other flag combination (a single --engine,
+--update-budgets, --list-waivers, explicit paths) delegates to the
+module CLI in-process.
 
 Every engine subprocess runs under a timeout (default 600 s; override
 with ``RAFT_GRAFTLINT_ENGINE_TIMEOUT`` seconds): a wedged engine (a
@@ -42,7 +48,7 @@ import time
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
-ENGINES = ("lint", "jaxpr", "hlo", "numerics", "registry")
+ENGINES = ("lint", "jaxpr", "hlo", "numerics", "registry", "concurrency")
 
 # Per-engine subprocess budget, measured from the common spawn point.
 # Generous vs the slowest engine (hlo ~100 s): tripping it means a
@@ -67,6 +73,7 @@ def parallel_gate(json_out: bool, verbose: bool) -> int:
         for engine in ENGINES
     }
     findings, report, timings, rc_usage = [], {}, {}, 0
+    engines_summary = {}
     for engine, proc in procs.items():
         # all engines started together at t0, so each one's budget is
         # the remainder of the shared deadline — a wedged engine gets
@@ -90,6 +97,9 @@ def parallel_gate(json_out: bool, verbose: bool) -> int:
                         f"RAFT_GRAFTLINT_ENGINE_TIMEOUT if the engine "
                         f"legitimately grew)"))
             timings[engine] = round(time.monotonic() - t0, 2)
+            engines_summary[engine] = {
+                "status": "timeout", "findings": 1, "unwaived": 1,
+                "seconds": timings[engine]}
             continue
         if proc.returncode == 2:
             rc_usage = 2
@@ -108,17 +118,24 @@ def parallel_gate(json_out: bool, verbose: bool) -> int:
                 message=f"engine subprocess died with rc "
                         f"{proc.returncode} before reporting findings "
                         f"(stderr on the gate's stderr)"))
+            engines_summary[engine] = {
+                "status": "crash", "findings": 1, "unwaived": 1,
+                "seconds": round(time.monotonic() - t0, 2)}
             continue
         findings += [fmod.Finding(**f) for f in payload["findings"]]
         engine_report = payload.get("report", {})
         timings[engine] = engine_report.pop("engine_timings",
                                             {}).get(engine, 0.0)
+        # each child reports its OWN "engines" row; merge them by hand
+        # (report.update below would clobber five of the six)
+        engines_summary.update(engine_report.pop("engines", {}))
         # merge at top level so the wrapper's --json schema is identical
         # to `python -m raft_tpu.analysis --engine all --json` (jaxpr
         # audit reports top-level, hlo under "hlo")
         report.update(engine_report)
     wall = time.monotonic() - t0
 
+    report["engines"] = engines_summary
     if json_out:
         report["engine_timings"] = dict(timings, wall=round(wall, 2))
         print(fmod.render_json(findings, report))

@@ -156,6 +156,11 @@ def test_loader_gives_up_loudly_when_substitutes_fail():
                     retries=0, retry_backoff=0.001)
     with pytest.raises(RuntimeError, match="refusing to fabricate"):
         list(dl)
+    # the train CLI converts exactly this RuntimeError into the typed
+    # "data-unreadable" fatal (cli/train.py) — pin the taxonomy contract
+    # it relies on here, where the failure is actually exercised
+    from raft_tpu.obs.events import DEFAULT_INCIDENT_SEVERITY
+    assert DEFAULT_INCIDENT_SEVERITY["data-unreadable"] == "fatal"
 
 
 def test_fault_injecting_dataset_drives_loader_quarantine():

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import textwrap
+import time
 
 import jax
 import jax.numpy as jnp
@@ -1265,11 +1266,12 @@ def _load_graftlint_script():
     return mod
 
 
-def test_graftlint_wrapper_fans_out_five_engines():
-    """The CI wrapper must run all five engines in parallel — the
+def test_graftlint_wrapper_fans_out_six_engines():
+    """The CI wrapper must run all six engines in parallel — the
     per-engine timing line is its contract with the tier-1 budget."""
     mod = _load_graftlint_script()
-    assert mod.ENGINES == ("lint", "jaxpr", "hlo", "numerics", "registry")
+    assert mod.ENGINES == ("lint", "jaxpr", "hlo", "numerics",
+                           "registry", "concurrency")
     # the per-engine timeout exists and is generous vs the slowest
     # engine (hlo ~100 s) — tripping it means wedged, not slow
     assert mod.ENGINE_TIMEOUT_S >= 300
@@ -1594,3 +1596,283 @@ def test_registry_add_an_entry_contract(tmp_path, monkeypatch):
 
     # (4) bench stamping: the lane -> entry map the scoreboard embeds
     assert ep.bench_lanes()["toy_lane"] == "toy_workload"
+
+# --------------------------------------------------------------------------
+# engine 6: the concurrency & incident-contract auditor
+# --------------------------------------------------------------------------
+
+from raft_tpu.analysis import concurrency_audit as ca     # noqa: E402
+
+
+def _conc(tmp_path, source, name="fix.py"):
+    """Run engine 6 over one fixture file via the module CLI (the
+    same in-process path the gate uses); returns (rc, stdout)."""
+    from raft_tpu.analysis.__main__ import main
+
+    fixture = tmp_path / name
+    fixture.write_text(textwrap.dedent(source))
+    return main(["--engine", "concurrency", str(fixture)]), fixture
+
+
+def test_concurrency_seeded_unguarded_write(tmp_path, capsys):
+    """Lock discipline: a thread-reachable method writing an attribute
+    the class guards under its lock elsewhere must exit 1 with
+    file:line."""
+    rc, fixture = _conc(tmp_path, """\
+        import threading
+
+        class Batcher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._served = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self._served += 1
+
+            def note(self):
+                with self._lock:
+                    self._served += 1
+    """)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "unguarded-write" in out
+    assert f"{fixture}:12" in out
+    assert "_served" in out and "_run" in out
+
+
+def test_concurrency_seeded_unknown_incident_kind(tmp_path, capsys):
+    """A writer ledgering a kind absent from DEFAULT_INCIDENT_SEVERITY
+    (the repo taxonomy backstops fixtures that define none) exits 1."""
+    rc, fixture = _conc(tmp_path, """\
+        class Loop:
+            def tick(self):
+                self.ledger.incident("no-such-kind", step=3, detail="x")
+    """)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "unknown-incident-kind" in out
+    assert f"{fixture}:3" in out and "no-such-kind" in out
+
+
+def test_concurrency_seeded_orphan_taxonomy_kind(tmp_path, capsys):
+    """A taxonomy row no production file ever writes is dead contract:
+    flagged AT the taxonomy line (plus the seeded severity demotion
+    that bypasses ALLOWED_SEVERITY_OVERRIDES)."""
+    fixture = tmp_path / "events_fix.py"
+    fixture.write_text(textwrap.dedent("""\
+        INCIDENT_SEVERITIES = ("recovered", "fatal", "warn")
+        DEFAULT_INCIDENT_SEVERITY = {
+            "host-lost": "fatal",
+            "never-written": "warn",
+        }
+        ALLOWED_SEVERITY_OVERRIDES = {}
+    """))
+    writer = tmp_path / "writer_fix.py"
+    writer.write_text(textwrap.dedent("""\
+        class W:
+            def go(self):
+                self.ledger.incident("host-lost", severity="recovered")
+    """))
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--engine", "concurrency", str(fixture), str(writer)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "orphan-incident-kind" in out
+    assert f"{fixture}:4" in out and "never-written" in out
+    # the unsanctioned fatal->recovered demotion rides the same run
+    assert "incident-severity-drift" in out
+    assert f"{writer}:3" in out
+
+
+def test_concurrency_seeded_bare_exit_literal(tmp_path, capsys):
+    """Termination codes spelled as integers (call sites or module
+    constants) outside resilience/exit_codes.py exit 1."""
+    rc, fixture = _conc(tmp_path, """\
+        import os
+
+        MY_EXIT_CODE = 13
+
+        def trip():
+            os._exit(13)
+    """)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "bare-exit-literal" in out and f"{fixture}:6" in out
+    assert "exit-code-constant" in out and f"{fixture}:3" in out
+
+
+def test_concurrency_seeded_double_claimed_terminal(tmp_path, capsys):
+    """A set_result/set_exception on a future the function did not
+    create, with no set_running_or_notify_cancel claim dominating it,
+    exits 1 — the InvalidStateError race class."""
+    rc, fixture = _conc(tmp_path, """\
+        def resolve(fut, value):
+            fut.set_result(value)
+    """)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "unclaimed-terminal" in out
+    assert f"{fixture}:2" in out and "set_running_or_notify_cancel" in out
+
+
+def test_concurrency_seeded_unguarded_thread_io(tmp_path, capsys):
+    """Ledger I/O reachable from a thread entry without the
+    OSError/ValueError guard exits 1."""
+    rc, fixture = _conc(tmp_path, """\
+        import threading
+
+        class Heartbeat:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self.ledger.event("beat", step=0)
+    """)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "unguarded-thread-io" in out
+    assert f"{fixture}:8" in out
+
+
+def test_concurrency_guarded_and_claimed_fixtures_pass(tmp_path):
+    """The disciplined forms of every seeded violation exit 0: lock
+    held via the reachable path, claim dominating the terminal,
+    guarded thread I/O, registry-typed exits."""
+    rc, _ = _conc(tmp_path, """\
+        import os
+        import threading
+        from concurrent.futures import Future
+        from raft_tpu.resilience.exit_codes import ExitCode
+
+        class Batcher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._served = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self._lock:
+                    self._served += 1
+                try:
+                    self.ledger.event("beat", step=0)
+                except (ValueError, OSError):
+                    pass
+
+        def resolve(fut, value):
+            if fut.set_running_or_notify_cancel():
+                fut.set_result(value)
+
+        def local_owner():
+            out = Future()
+            out.set_result(1)   # single owner: created right here
+            return out
+
+        def trip():
+            os._exit(ExitCode.CRASH_LOOP)
+    """)
+    assert rc == 0
+
+
+def test_concurrency_waiver_with_reason_waives(tmp_path):
+    """Engine 6 rides the shared inline-waiver machinery: a reasoned
+    disable on the flagged line drops the finding; reasonless waives
+    nothing."""
+    rc, _ = _conc(tmp_path, """\
+        import os
+
+        def trip():
+            os._exit(13)  # graftlint: disable=bare-exit-literal -- fixture
+    """)
+    assert rc == 0
+    rc, _ = _conc(tmp_path, """\
+        import os
+
+        def trip():
+            os._exit(13)  # graftlint: disable=bare-exit-literal
+    """, name="fix2.py")
+    assert rc == 1
+
+
+def test_concurrency_cli_usage_errors():
+    """A typo'd rule-family name is a usage error (exit 2), never a
+    silently green zero-rule run."""
+    from raft_tpu.analysis.__main__ import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["--engine", "concurrency", "--audits", "no_such_rule"])
+    assert e.value.code == 2
+    # the runner itself enforces the same contract
+    with pytest.raises(KeyError):
+        ca.run_concurrency_audit(names=["bogus"])
+
+
+def test_concurrency_engine_is_jax_free():
+    """Engine 6 is pure stdlib AST — importing or running it must never
+    drag jax in (that is what keeps it a ~3 s lane and lets the gate
+    run it without the 8-virtual-device dance)."""
+    import subprocess
+    import sys
+
+    code = ("import sys\n"
+            "import raft_tpu.analysis.__main__ as m\n"
+            "rc = m.main(['--engine', 'concurrency'])\n"
+            "assert 'jax' not in sys.modules, 'jax leaked'\n"
+            "sys.exit(rc)\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr.decode()[-800:]
+
+
+def test_concurrency_gate_repo_clean():
+    """THE gate: the production tree carries zero unwaived concurrency
+    findings — no bare exit literal anywhere in raft_tpu/, both
+    incident-taxonomy directions satisfied — and the whole audit stays
+    a sub-30 s lane."""
+    t0 = time.monotonic()
+    findings, report = ca.run_concurrency_audit()
+    wall = time.monotonic() - t0
+    assert fmod.gate(findings) == [], [
+        f"{f.rule} {f.path}:{f.line}" for f in fmod.gate(findings)]
+    # the scan really covered the threaded stack (not an empty glob)
+    assert report["files"] > 50
+    # both taxonomy directions ran: every kind known, written, tested
+    assert report["incidents"]["kinds"] >= 37
+    assert report["incidents"]["written_kinds"] == \
+        report["incidents"]["kinds"]
+    assert report["incidents"]["writer_sites"] >= 20
+    assert wall < 30.0, f"concurrency audit took {wall:.1f}s"
+
+
+def test_graftlint_json_merged_engine_summary(tmp_path, capsys):
+    """The wrapper's --json carries ONE merged per-engine summary
+    (status/findings/unwaived/seconds per engine) built by hand-merging
+    each child's "engines" row — report.update alone would keep only
+    the last child's.  Exercised with the two jax-free engines so the
+    real subprocess fan-out stays cheap; the six-tuple itself is
+    pinned by test_graftlint_wrapper_fans_out_six_engines."""
+    mod = _load_graftlint_script()
+    mod.ENGINES = ("lint", "concurrency")
+    rc = mod.parallel_gate(json_out=True, verbose=False)
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(payload) == {"findings", "report", "gate"}
+    engines = payload["report"]["engines"]
+    assert set(engines) == {"lint", "concurrency"}
+    for row in engines.values():
+        assert set(row) == {"status", "findings", "unwaived", "seconds"}
+        assert row["status"] == "clean" and row["unwaived"] == 0
+    assert payload["report"]["engine_timings"]["wall"] > 0
+    # single-engine module runs emit the same row shape
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--engine", "concurrency", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    row = payload["report"]["engines"]["concurrency"]
+    assert row["status"] == "clean" and row["findings"] == 0
