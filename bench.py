@@ -538,6 +538,80 @@ def main():
         print(f"serve bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
 
+    # Int8 serving lane (raft_tpu/serve/quant.py, the graph graftlint
+    # engine 7 certifies): the same synthetic request load through a
+    # QuantServeEngine — q8 requests/s and p95 land NEXT TO the bf16
+    # serving lane so the quantization win (or regression) is a
+    # scoreboard delta, not an assertion.  ``q8_epe_delta`` is the
+    # quality price: mean EPE between the q8 and bf16 twins' upsampled
+    # flow on one identical batch (the 12-vs-32-iter harness in
+    # tests/test_quant.py gates the same delta against a budget; here
+    # it is measured and published every round).  ``q8_fallbacks``
+    # must stay 0 on this in-range load — a nonzero count means the
+    # calibrated envelope no longer covers ordinary pixels.
+    def _q8_serve_lane():
+        from raft_tpu.serve.quant import QuantServeEngine
+        from raft_tpu.serve.server import FlowServer
+
+        serve_vars = {"params": state.params}
+        bs = getattr(state, "batch_stats", None)
+        if bs:
+            serve_vars["batch_stats"] = bs
+        serve_b = min(2, B)
+        engine = QuantServeEngine(RAFT(cfg), serve_vars,
+                                  batch_size=serve_b)
+        server = FlowServer(engine, buckets={"bench": (H, W)},
+                            queue_capacity=max(8, 4 * serve_b),
+                            iter_levels=(iters,), degrade=False)
+        try:
+            server.warmup(warm_too=False)
+            rng_q = np.random.default_rng(7)  # the bf16 lane's load
+
+            def frame():
+                return rng_q.uniform(0, 255, (H, W, 3)).astype(np.float32)
+
+            n_req = 4 if tiny else 24
+            t0 = time.perf_counter()
+            done = []
+            for i in range(n_req):
+                done.append(server.submit(frame(), frame()))
+                if (i + 1) % serve_b == 0:
+                    for f in done[-serve_b:]:
+                        f.result(timeout=600)
+            for f in done:
+                f.result(timeout=600)
+            wall = time.perf_counter() - t0
+            summary = server.close()
+            server = None
+            # quality delta: one identical batch through both twins the
+            # engine holds (executables already warm from the load)
+            img1 = np.stack([frame() for _ in range(serve_b)])
+            img2 = np.stack([frame() for _ in range(serve_b)])
+            _, up_q = engine.forward((H, W), iters, img1, img2)
+            _, up_f = engine.fallback.forward((H, W), iters, img1, img2)
+            epe_delta = float(np.mean(np.linalg.norm(
+                np.asarray(up_q, np.float32)
+                - np.asarray(up_f, np.float32), axis=-1)))
+            return {
+                "q8_requests_per_s_per_chip": round(n_req / wall, 3),
+                "q8_latency_p95_ms": summary.get("latency_p95_ms", 0.0),
+                "q8_epe_delta": round(epe_delta, 4),
+                "q8_fallbacks": engine.fallbacks,
+            }
+        finally:
+            if server is not None:
+                server.close()
+
+    q8_metrics = {"q8_requests_per_s_per_chip": 0.0,
+                  "q8_latency_p95_ms": 0.0,
+                  "q8_epe_delta": 0.0,
+                  "q8_fallbacks": 0}
+    try:
+        q8_metrics = _q8_serve_lane()
+    except Exception as e:  # the q8 lane must never sink the scoreboard
+        print(f"q8 serve bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # Fleet lane (raft_tpu/serve/fleet.py): N=3 local replicas behind
     # the stream-affinity front door under a POISSON arrival process —
     # aggregate requests/s and the fleet-wide p95 join the scoreboard
@@ -804,7 +878,8 @@ def main():
                         "fed_pairs_per_s_host":
                             round(fed_pairs_per_s_host, 3),
                         "fed_lane": fed_lane}
-                     | serve_metrics | fleet_metrics | stereo_metrics
+                     | serve_metrics | q8_metrics
+                     | fleet_metrics | stereo_metrics
                      | sdc_metrics
                      | {"confidence_overhead_pct":
                             confidence_overhead_pct,
@@ -828,6 +903,10 @@ def main():
         # serving lane: synthetic requests through the real FlowServer
         # (queue -> batcher -> AOT executor) at this resolution
         **serve_metrics,
+        # int8 serving lane (serve/quant.py, certified by graftlint
+        # engine 7): same load through the QuantServeEngine, plus the
+        # q8-vs-bf16 EPE delta and the in-range fallback count
+        **q8_metrics,
         # fleet lane: N=3 local replicas behind the stream-affinity
         # front door under poisson arrivals (serve/fleet.py)
         **fleet_metrics,

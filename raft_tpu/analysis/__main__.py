@@ -24,6 +24,12 @@ Engine-specific extras:
   participation consistent, and NO stale inline waivers (staleness
   gates here; ``--audits coverage,budgets,trace,participation,waivers``
   selects sub-checks).
+- ``--engine quant`` runs the quantization-safety certifier over the
+  registered int8 serve entries: every quantize/dequantize/integer-
+  contraction site is certified against the ``quant`` calibration
+  section of ``budgets.json`` (range-overflow, unproven-range,
+  narrow-accum, requant-hygiene, stale-calibration);
+  ``--update-budgets`` re-baselines the calibration ledger.
 - ``--engine concurrency`` runs the concurrency & incident-contract
   auditor over the threaded serve/resilience stack: lock discipline,
   incident-taxonomy conformance (both directions), the typed
@@ -119,11 +125,13 @@ def collect_waivers(paths) -> list:
                 "invariant": w.invariant, "provenance": w.provenance,
                 "scalar_only": w.scalar_only, "reason": w.reason})
 
-    from raft_tpu.analysis import hlo_audit, jaxpr_audit, numerics_audit
+    from raft_tpu.analysis import (hlo_audit, jaxpr_audit, numerics_audit,
+                                   quant_audit)
 
     data_waivers("jaxpr", jaxpr_audit)
     data_waivers("hlo", hlo_audit)
     data_waivers("numerics", numerics_audit)
+    data_waivers("quant", quant_audit)
     return out
 
 
@@ -142,12 +150,12 @@ def render_waivers(waivers) -> str:
             lines.append(f"{w['path']}:{w['line']}: {w['engine']} "
                          f"{w['invariant']} @ {w['provenance']}{scope} "
                          f"-- {w['reason']}")
-    n = {"lint": 0, "jaxpr": 0, "hlo": 0, "numerics": 0}
+    n = {"lint": 0, "jaxpr": 0, "hlo": 0, "numerics": 0, "quant": 0}
     for w in waivers:
         n[w["engine"]] += 1
     lines.append(f"graftlint waivers: {n['lint']} lint ({stale} stale), "
                  f"{n['jaxpr']} jaxpr, {n['hlo']} hlo, "
-                 f"{n['numerics']} numerics")
+                 f"{n['numerics']} numerics, {n['quant']} quant")
     return "\n".join(lines)
 
 
@@ -163,7 +171,7 @@ def main(argv=None) -> int:
                         "(default: raft_tpu/, scripts/, bench.py, "
                         "__graft_entry__.py)")
     p.add_argument("--engine",
-                   choices=["lint", "jaxpr", "hlo", "numerics",
+                   choices=["lint", "jaxpr", "hlo", "numerics", "quant",
                             "registry", "concurrency", "all"],
                    default="all")
     p.add_argument("--rules", default=None,
@@ -197,11 +205,12 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.update_budgets and args.engine not in ("hlo", "numerics",
-                                                   "all"):
-        p.error("--update-budgets requires --engine hlo or numerics "
-                "(or all)")
+                                                   "quant", "all"):
+        p.error("--update-budgets requires --engine hlo, numerics or "
+                "quant (or all)")
 
-    if args.engine in ("jaxpr", "hlo", "numerics", "registry", "all"):
+    if args.engine in ("jaxpr", "hlo", "numerics", "quant", "registry",
+                       "all"):
         _force_cpu_with_virtual_devices()
 
     from raft_tpu.analysis import findings as fmod
@@ -256,6 +265,11 @@ def main(argv=None) -> int:
             numerics_known = (set(_NE) | set(_NF)
                               | set(pallas_audit.FIXTURE_ENTRIES.keys()))
             known |= numerics_known
+        if args.engine in ("quant", "all"):
+            from raft_tpu.analysis.quant_audit import \
+                ENTRIES as _QE, FIXTURE_ENTRIES as _QF
+
+            known |= set(_QE) | set(_QF)
         if args.engine in ("registry", "all"):
             from raft_tpu.analysis.registry_audit import CHECKS
 
@@ -283,10 +297,14 @@ def main(argv=None) -> int:
                 # would silently no-op
                 budgetable |= {n for n, e in _N.items()
                                if e.pallas and e.budgeted}
+            if args.engine in ("quant", "all"):
+                from raft_tpu.analysis.quant_audit import ENTRIES as _Q
+
+                budgetable |= {n for n, e in _Q.items() if e.budgeted}
             if not any(a in budgetable for a in audits):
                 p.error("--update-budgets needs --audits to name at "
-                        "least one hlo audit or pallas-carrying "
-                        "numerics audit (or drop --audits to "
+                        "least one hlo audit, pallas-carrying numerics "
+                        "audit or quant audit (or drop --audits to "
                         "re-baseline everything) — nothing would be "
                         "written")
     all_findings = []
@@ -354,6 +372,25 @@ def main(argv=None) -> int:
             all_findings += nfs
             report["numerics"] = nreport
         timings["numerics"] = round(time.monotonic() - t0, 2)
+    if args.engine in ("quant", "all"):
+        from raft_tpu.utils.platform import ensure_platform
+
+        ensure_platform(strict=True)
+        t0 = time.monotonic()
+        from raft_tpu.analysis.quant_audit import ENTRIES as QENT, \
+            FIXTURE_ENTRIES as QFIX, run_quant_audit
+
+        quant_names = audits
+        if audits is not None:
+            quant_names = [a for a in audits
+                           if a in QENT or a in QFIX]
+        if quant_names != []:
+            qfs, qreport = run_quant_audit(
+                quant_names, budgets_path=args.budgets,
+                update=args.update_budgets)
+            all_findings += qfs
+            report["quant"] = qreport
+        timings["quant"] = round(time.monotonic() - t0, 2)
     if args.engine in ("registry", "all"):
         from raft_tpu.utils.platform import ensure_platform
 
@@ -391,8 +428,8 @@ def main(argv=None) -> int:
 
     report["engine_timings"] = timings
     # the merged per-engine summary scripts/graftlint.py --json
-    # aggregates across its six subprocesses (satellite: one
-    # machine-readable verdict per engine, not five interleaved blobs)
+    # aggregates across its seven subprocesses (satellite: one
+    # machine-readable verdict per engine, not six interleaved blobs)
     by_engine = {}
     for f in all_findings:
         by_engine.setdefault(f.engine, []).append(f)

@@ -1055,6 +1055,31 @@ def fmap_ranges(args) -> List[VRange]:
     return out
 
 
+def quant_ranges(args) -> List[VRange]:
+    """Input ranges for the int8 serve entries (serve/quant.py): the
+    QTensor code leaves (int dtype) live in [-127, 127] by construction
+    (codes clamp before the int8 cast — the wider bound matters: the
+    declared ``params`` assumption of +/-PARAM_BOUND would be UNSOUND
+    for codes); their per-tensor ``.scale`` leaves are positive,
+    floored at 1e-8 and bounded by PARAM_BOUND/127 < 1; everything
+    else (images, batch_stats) follows :func:`declared_ranges`."""
+    import jax
+
+    base = declared_ranges(args)
+    leaves = jax.tree_util.tree_flatten_with_path(args)[0]
+    out = []
+    for (path, leaf), r in zip(leaves, base):
+        name = jax.tree_util.keystr(path)
+        dt = str(getattr(leaf, "dtype", ""))
+        if dt.startswith("int") or dt.startswith("uint"):
+            out.append(VRange(-127.0, 127.0))
+        elif name.endswith(".scale"):
+            out.append(VRange(1e-8, 1.0, nonzero=True))
+        else:
+            out.append(r)
+    return out
+
+
 def device_aug_ranges(batch_sds) -> List[VRange]:
     """Input ranges for the device-augmentation entry, keyed on the
     batch dict's field names (scales provably nonzero — the sampler
@@ -1121,6 +1146,7 @@ RANGE_RECIPES: Dict[str, Callable[[tuple], List[VRange]]] = {
     "declared": lambda args: declared_ranges(args),
     "fmap": lambda args: fmap_ranges(args),
     "device_aug": lambda args: device_aug_ranges(args[0]),
+    "quant": lambda args: quant_ranges(args),
 }
 
 

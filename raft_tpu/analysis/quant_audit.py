@@ -1,0 +1,861 @@
+"""graftlint engine 7: the quantization-safety certifier.
+
+Engine 4 proves value intervals; this engine asks the question those
+intervals exist to answer on the int8 serve path (serve/quant.py):
+*"is every narrowing cast in this graph safe at its assigned scale?"*
+It pushes engine 4's VRange lattice through each registered quantized
+entry (``registry.quant_entries()``, today ``serve_forward_q8`` /
+``serve_forward_q8_warm``), records every quantize / dequantize /
+integer-contraction site it meets, and certifies each against the
+checked-in calibration ledger — the ``quant`` section of
+``analysis/budgets.json`` (same ``--update-budgets`` merge/prune flow
+as engines 3/4).
+
+Rules (provenance-anchored, same waiver machinery as engines 2-4 plus
+the shared inline ``# graftlint: disable=`` syntax, whose activity
+engine 5's stale-waiver gate counts):
+
+- ``range-overflow`` — a float->int8/fp8 cast whose operand's PROVEN
+  interval exceeds the target dtype's representable span at the
+  assigned scale (XLA's out-of-range float->int conversion is
+  implementation-defined: wrap or saturate, both silently wrong), or a
+  ledger row whose recorded code range exceeds the span it claims.
+- ``unproven-range`` — a quantizing cast whose operand the lattice
+  cannot bound at all (interval widened to +/-inf): an unbounded
+  tensor must stay bf16 or carry a reasoned waiver; "probably fits" is
+  not a certificate.
+- ``narrow-accum`` — an integer dot/conv/reduce that ACCUMULATES in
+  int8/int16 over more than :data:`NARROW_ACCUM_THRESHOLD` contraction
+  elements (int8 partial sums wrap at 128; the int8 corr contraction
+  must carry ``preferred_element_type=int32``) — the integer mirror of
+  engine 4's ``bf16-accum`` rule.
+- ``requant-hygiene`` — a dequantized int8 value reaching a residual
+  ``add``/``sub`` or a GRU gate nonlinearity (``tanh``/``logistic``/
+  ``exp``) before its per-tensor scale is re-applied: codes are in
+  scale units, and mixing them with real-unit values silently rescales
+  the math.  The walk is structural (through broadcast/reshape/
+  transpose hops); a ``mul``/``div`` on the path is the scale
+  application that discharges the rule.
+- ``stale-calibration`` — a ledger row whose producing entry left the
+  registry, whose site vanished from the traced graph, or whose
+  recorded scale/range/dtype/verdict no longer matches the live
+  measurement: calibration is only a certificate while the graph it
+  measured still exists (engine 5's prune semantics).
+
+Each certified site lands in the ledger as ``entry/kind.N`` (kinds:
+``quantize``, ``dequantize``, ``int_dot``, ``int_conv``; N is the
+ordinal of the distinct source site in deterministic visit order) with
+``{prim, dtype, scale, lo, hi, verdict, count}``.  ``verdict`` is
+``proven`` (finite lattice bound), ``calibrated`` (a clamp bounds the
+operand structurally — the bound is the calibration's, not the
+spec's), or ``unproven`` (also a finding unless waived).  ``scale`` is
+the per-tensor step size recovered from the quantize multiplier
+literal (``clip/127``), ``None`` where the scale is a runtime tensor.
+
+``FIXTURE_ENTRIES`` are deliberately-broken programs (an unclamped
+overflowing cast, an unbounded cast, an int8 K=1024 matmul
+accumulating in int8, a tanh on raw codes); they never run by default
+— tests select them with ``--audits`` to prove each rule trips with
+exit 1 and file:line attribution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu import entrypoints as registry
+from raft_tpu.analysis import budgets as budgets_mod
+from raft_tpu.analysis.findings import Finding
+from raft_tpu.analysis.jaxpr_audit import (JaxprWaiver, apply_data_waivers,
+                                           provenance)
+from raft_tpu.analysis.numerics_audit import (INF, RANGE_RECIPES, TOP,
+                                              Interpreter, VRange,
+                                              _dtype_str, _is_float,
+                                              _reduce_count, finding_anchor)
+
+# Integer accumulation threshold — the int mirror of engine 4's
+# REDUCE_ACCUM_THRESHOLD: int8 wraps far earlier than bf16 rounds, but
+# the shared pin keeps "how long may a narrow accumulator run" one
+# number across both engines.
+NARROW_ACCUM_THRESHOLD = 512
+
+ALL_QUANT_RULES = frozenset({"range-overflow", "unproven-range",
+                             "narrow-accum", "requant-hygiene"})
+
+# Dtypes this engine treats as quantized storage ("codes"): casting
+# INTO one is a quantize site, OUT of one a dequantize site.  int32+
+# accumulators are deliberately excluded — they are arithmetic, not
+# storage, and are covered by narrow-accum instead.
+_CODE_SPANS = {
+    "int8": (-128.0, 127.0),
+    "uint8": (0.0, 255.0),
+    "int4": (-8.0, 7.0),
+    "uint4": (0.0, 15.0),
+    "float8_e4m3fn": (-448.0, 448.0),
+    "float8_e5m2": (-57344.0, 57344.0),
+}
+
+# Accumulator dtypes wide enough for an int8 contraction.
+_WIDE_ACCUMS = ("int32", "int64", "uint32", "uint64",
+                "float32", "float64")
+
+# Hops the requant walk may cross between a dequantizing convert and
+# its consumer without a scale application in between.
+_REQUANT_TRANSPARENT = ("broadcast_in_dim", "reshape", "transpose",
+                        "squeeze", "expand_dims", "slice", "copy",
+                        "stop_gradient", "neg")
+
+# Nonlinearities (GRU gates) + residual arithmetic that must only ever
+# see real-unit values, never raw codes.
+_SCALE_SENSITIVE = ("tanh", "logistic", "exp", "add", "sub")
+
+
+def _is_code_dtype(dt: str) -> bool:
+    return dt in _CODE_SPANS
+
+
+def _is_int(dt: str) -> bool:
+    return dt.startswith(("int", "uint"))
+
+
+# No data waivers yet: the production int8 path (ops/corr.py
+# build_corr_pyramid_q8 + serve/quant.py dequantize) certifies clean.
+# The tuple exists so a future waiver carries a reason the same way
+# engines 2-4's do.
+WAIVERS: Tuple[JaxprWaiver, ...] = ()
+
+
+# --------------------------------------------------------------------------
+# the interpreter
+# --------------------------------------------------------------------------
+
+class QuantInterpreter(Interpreter):
+    """Engine 4's interval interpreter, re-aimed: the transfer
+    functions are inherited unchanged (same lattice, same fixpoint);
+    only the per-eqn CHECKS differ — engine 4's float-hazard rules are
+    its own business (it audits these entries too), this subclass
+    checks the quantization contract and records calibration sites."""
+
+    def __init__(self, entry: str, rules: frozenset):
+        super().__init__(entry, rules)
+        # (kind, record) in deterministic visit order; distinct source
+        # sites only — a quantize helper called in a loop is ONE site
+        # with a call count, which is what keeps the ledger readable.
+        self.sites: List[Tuple[str, Dict]] = []
+        self._site_seen: Dict[Tuple, Dict] = {}
+
+    def _emit(self, rule: str, eqn, message: str, severity: str = "error",
+              data: Optional[Dict] = None):
+        if rule not in self.rules:
+            return
+        prov = provenance(eqn)
+        path, line = finding_anchor(prov)
+        key = (rule, path, line, eqn.primitive.name)
+        if key in self._seen:
+            d = self._seen[key].data
+            if d is not None:
+                d["count"] = d.get("count", 1) + 1
+            return
+        f = Finding(engine="quant", rule=rule, path=path, line=line,
+                    message=f"{self.entry}: {message} [at {prov}]",
+                    severity=severity,
+                    data=dict(data or {}, entry=self.entry, count=1))
+        self._seen[key] = f
+        self.findings.append(f)
+
+    # -- site ledger -------------------------------------------------------
+
+    _VERDICT_ORDER = {"unproven": 0, "calibrated": 1, "proven": 2}
+
+    def _record_site(self, kind: str, eqn, rec: Dict) -> None:
+        path, line = finding_anchor(provenance(eqn))
+        key = (kind, path, line)
+        prior = self._site_seen.get(key)
+        if prior is not None:
+            prior["count"] += 1
+            if prior.get("lo") is None or rec.get("lo") is None:
+                prior["lo"] = prior["hi"] = None
+            else:
+                prior["lo"] = min(prior["lo"], rec["lo"])
+                prior["hi"] = max(prior["hi"], rec["hi"])
+            if (self._VERDICT_ORDER.get(rec.get("verdict"), 0)
+                    < self._VERDICT_ORDER.get(prior.get("verdict"), 0)):
+                prior["verdict"] = rec["verdict"]
+            return
+        rec = dict(rec, count=1, _path=path, _line=line)
+        self._site_seen[key] = rec
+        self.sites.append((kind, rec))
+
+    @staticmethod
+    def _round_range(r: VRange) -> Tuple[Optional[float], Optional[float]]:
+        if r.lo == -INF or r.hi == INF:
+            return None, None
+        return round(r.lo, 6), round(r.hi, 6)
+
+    # -- structural walks --------------------------------------------------
+
+    def _literal_value(self, atom, defs, depth: int = 4) -> Optional[float]:
+        import jax._src.core as jcore
+
+        for _ in range(depth):
+            if isinstance(atom, jcore.Literal):
+                try:
+                    return float(atom.val)
+                except (TypeError, ValueError):
+                    return None
+            d = defs.get(atom)
+            if d is None or d.primitive.name not in (
+                    "broadcast_in_dim", "convert_element_type", "copy"):
+                return None
+            atom = d.invars[0]
+        return None
+
+    def _calibration(self, var, defs) -> Tuple[str, Optional[float]]:
+        """Walk a quantize operand's def chain for the clamp+scale
+        pattern (``clip(round(x * inv_scale))``): a clamp (or a
+        min+max pair — ``jnp.clip`` lowers to ``min(max(lo, x), hi)``
+        inside a named pjit) makes the verdict ``calibrated`` (the
+        bound is the calibration's own), and the multiplier literal
+        recovers the per-tensor scale.  The walk descends into
+        pjit/remat bodies, popping back to the caller's frame when it
+        reaches a sub-jaxpr input."""
+        import jax._src.core as jcore
+
+        clamped = False
+        clamped_lo = clamped_hi = False
+        scale: Optional[float] = None
+        frames: List[Tuple[Dict, Dict]] = [(defs, {})]
+
+        def lookup(v):
+            while True:
+                dmap, invmap = frames[-1]
+                if v in dmap:
+                    return dmap[v], v
+                if v in invmap and len(frames) > 1:
+                    v = invmap[v]
+                    frames.pop()
+                    continue
+                return None, v
+
+        for _ in range(24):
+            if isinstance(var, jcore.Literal):
+                break
+            d, var = lookup(var)
+            if d is None:
+                break
+            p = d.primitive.name
+            if p in ("pjit", "closed_call", "core_call", "remat",
+                     "remat2", "checkpoint"):
+                sub = d.params.get("jaxpr") or d.params.get("call_jaxpr")
+                if sub is None:
+                    break
+                if isinstance(sub, jcore.Jaxpr):
+                    sub = jcore.ClosedJaxpr(sub, [])
+                try:
+                    i = list(d.outvars).index(var)
+                except ValueError:
+                    break
+                sub_defs: Dict = {}
+                for se in sub.jaxpr.eqns:
+                    for ov in se.outvars:
+                        sub_defs[ov] = se
+                # positional binding, tail-aligned like Interpreter._sub
+                inv = list(sub.jaxpr.invars)
+                outer = list(d.invars)
+                n = min(len(inv), len(outer))
+                invmap = dict(zip(inv[-n:], outer[-n:]))
+                frames.append((sub_defs, invmap))
+                var = sub.jaxpr.outvars[i]
+            elif p == "clamp":
+                clamped = True
+                var = d.invars[1]
+            elif p in ("max", "min"):
+                if p == "max":
+                    clamped_lo = True
+                else:
+                    clamped_hi = True
+                nxt = None
+                for a in d.invars:     # follow the data (non-scalar) arm
+                    if isinstance(a, jcore.Literal):
+                        continue
+                    if getattr(getattr(a, "aval", None),
+                               "shape", ()) != ():
+                        nxt = a
+                        break
+                if nxt is None:
+                    break
+                var = nxt
+            elif p in ("round", "round_nearest_even"):
+                var = d.invars[0]
+            elif p == "mul":
+                for a in d.invars:
+                    v = self._literal_value(a, frames[-1][0])
+                    if v:
+                        scale = round(1.0 / v, 9)
+                break
+            elif p in _REQUANT_TRANSPARENT or p == "convert_element_type":
+                var = d.invars[0]
+            else:
+                break
+        if clamped_lo and clamped_hi:
+            clamped = True
+        return ("calibrated" if clamped else "proven"), scale
+
+    def _raw_dequant(self, var, defs, depth: int = 8) -> bool:
+        """Does ``var`` trace back to a convert-from-codes with NO
+        scale application (mul/div) on the path?"""
+        import jax._src.core as jcore
+
+        for _ in range(depth):
+            if isinstance(var, jcore.Literal):
+                return False
+            d = defs.get(var)
+            if d is None:
+                return False
+            p = d.primitive.name
+            if p == "convert_element_type":
+                if _is_code_dtype(_dtype_str(d.invars[0].aval)):
+                    return True
+                var = d.invars[0]
+            elif p in _REQUANT_TRANSPARENT:
+                var = d.invars[0]
+            else:
+                return False
+        return False
+
+    # -- checks ------------------------------------------------------------
+
+    def _check_eqn(self, eqn, in_rs, out_rs, env, defs):
+        p = eqn.primitive.name
+        if p == "convert_element_type":
+            self._check_convert(eqn, in_rs, defs)
+        elif p in ("dot_general", "conv_general_dilated"):
+            self._check_contraction(eqn, in_rs, out_rs)
+        elif p == "reduce_sum":
+            self._check_int_reduce(eqn)
+        if p in _SCALE_SENSITIVE:
+            self._check_requant(eqn, defs)
+
+    def _check_convert(self, eqn, in_rs, defs):
+        in_dt = _dtype_str(eqn.invars[0].aval)
+        out_dt = _dtype_str(eqn.outvars[0].aval)
+        if _is_code_dtype(out_dt) and _is_float(in_dt):
+            r = in_rs[0]
+            lo_span, hi_span = _CODE_SPANS[out_dt]
+            verdict, scale = self._calibration(eqn.invars[0], defs)
+            if r.lo == -INF or r.hi == INF:
+                verdict = "unproven"
+                self._emit(
+                    "unproven-range", eqn,
+                    f"{in_dt}->{out_dt} quantize of a tensor the "
+                    f"lattice cannot bound — an unbounded value must "
+                    f"stay bf16 or carry a reasoned waiver; clamp to "
+                    f"the code span before the cast to make the bound "
+                    f"provable",
+                    data={"dtype": out_dt})
+            elif r.lo < lo_span - 0.5 or r.hi > hi_span + 0.5:
+                self._emit(
+                    "range-overflow", eqn,
+                    f"{in_dt}->{out_dt} quantize whose operand spans "
+                    f"[{r.lo:.6g}, {r.hi:.6g}] — exceeds the {out_dt} "
+                    f"span [{lo_span:.6g}, {hi_span:.6g}] at the "
+                    f"assigned scale; XLA's out-of-range float->int "
+                    f"cast is implementation-defined (wrap or "
+                    f"saturate).  Clamp before the cast or widen the "
+                    f"calibration clip",
+                    data={"dtype": out_dt, "lo": r.lo, "hi": r.hi})
+            lo, hi = self._round_range(r)
+            self._record_site("quantize", eqn, {
+                "prim": eqn.primitive.name, "dtype": out_dt,
+                "scale": scale, "lo": lo, "hi": hi, "verdict": verdict})
+        elif _is_code_dtype(in_dt) and _is_float(out_dt):
+            r = in_rs[0]
+            lo, hi = self._round_range(r)
+            self._record_site("dequantize", eqn, {
+                "prim": eqn.primitive.name, "dtype": in_dt,
+                "scale": None, "lo": lo, "hi": hi,
+                "verdict": "proven" if lo is not None else "unproven"})
+
+    def _check_contraction(self, eqn, in_rs, out_rs):
+        lhs_dt = _dtype_str(eqn.invars[0].aval)
+        rhs_dt = _dtype_str(eqn.invars[1].aval)
+        if not (_is_int(lhs_dt) and _is_int(rhs_dt)):
+            return
+        p = eqn.primitive.name
+        out_dt = _dtype_str(eqn.outvars[0].aval)
+        if p == "dot_general":
+            kind = "int_dot"
+            (lc, _rc), _ = eqn.params["dimension_numbers"]
+            shape = eqn.invars[0].aval.shape
+            k = 1
+            for d in lc:
+                k *= shape[d]
+        else:
+            kind = "int_conv"
+            dn = eqn.params["dimension_numbers"]
+            rhs_shape = eqn.invars[1].aval.shape
+            k = 1
+            for i, dim in enumerate(rhs_shape):
+                if i != dn.rhs_spec[0]:   # every dim but output features
+                    k *= dim
+        if out_dt not in _WIDE_ACCUMS and k > NARROW_ACCUM_THRESHOLD:
+            self._emit(
+                "narrow-accum", eqn,
+                f"{lhs_dt}x{rhs_dt} {p} accumulates {k} products in "
+                f"{out_dt} — int8 partial sums wrap at 128; pass "
+                f"preferred_element_type=jnp.int32 (the int8 corr "
+                f"contraction contract, ops/corr.py)",
+                data={"k": k, "accum": out_dt})
+        lo, hi = self._round_range(out_rs[0])
+        self._record_site(kind, eqn, {
+            "prim": p, "dtype": out_dt, "scale": None,
+            "lo": lo, "hi": hi,
+            "verdict": "proven" if lo is not None else "unproven",
+            "k": k})
+
+    def _check_int_reduce(self, eqn):
+        in_dt = _dtype_str(eqn.invars[0].aval)
+        out_dt = _dtype_str(eqn.outvars[0].aval)
+        if not (_is_int(in_dt) and out_dt not in _WIDE_ACCUMS):
+            return
+        n = _reduce_count(eqn)
+        if n > NARROW_ACCUM_THRESHOLD:
+            self._emit(
+                "narrow-accum", eqn,
+                f"reduce_sum over {n} {in_dt} elements accumulating "
+                f"in {out_dt} — widen the accumulator to int32",
+                data={"k": n, "accum": out_dt})
+
+    def _check_requant(self, eqn, defs):
+        import jax._src.core as jcore
+
+        for var in eqn.invars:
+            if isinstance(var, jcore.Literal):
+                continue
+            if not _is_float(_dtype_str(var.aval)):
+                continue
+            if self._raw_dequant(var, defs):
+                self._emit(
+                    "requant-hygiene", eqn,
+                    f"{eqn.primitive.name} consumes a dequantized "
+                    f"value whose per-tensor scale was never "
+                    f"re-applied — codes are in scale units; multiply "
+                    f"by the scale (serve/quant.py "
+                    f"dequantize_variables) before residual adds or "
+                    f"gate nonlinearities",
+                    data={"consumer": eqn.primitive.name})
+
+
+# --------------------------------------------------------------------------
+# the calibration ledger
+# --------------------------------------------------------------------------
+
+def _site_kind(key: str) -> str:
+    """``entry/kind.N`` -> ``kind``."""
+    tail = key.split("/", 1)[-1]
+    return tail.rsplit(".", 1)[0]
+
+
+def _scales_differ(a: Optional[float], b: Optional[float]) -> bool:
+    if a is None or b is None:
+        return (a is None) != (b is None)
+    return abs(a - b) > 1e-9 * max(abs(a), abs(b), 1.0)
+
+
+def _ranges_differ(m: Dict, rec: Dict) -> bool:
+    for field in ("lo", "hi"):
+        a, b = m.get(field), rec.get(field)
+        if a is None or b is None:
+            if (a is None) != (b is None):
+                return True
+            continue
+        if abs(a - b) > max(1e-6, 1e-3 * abs(b)):
+            return True
+    return False
+
+
+def compare_quant_budgets(measurements: Dict[str, Dict],
+                          budgets_path: Optional[str] = None,
+                          update: bool = False,
+                          full_run: bool = False
+                          ) -> Tuple[List[Finding], Dict]:
+    """Measured quantization sites vs the ledger's ``quant`` section.
+
+    Site facts compare exactly (scale/range drift, dtype or verdict
+    change, site count change -> ``stale-calibration``); a ledger row
+    claiming a range outside its own dtype's span is
+    ``range-overflow`` at the ledger line.  ``update=True``
+    merge-writes the section (commit the budgets.json diff); with
+    ``full_run`` the write also prunes rows whose entry left the
+    registry or whose site left the graph, each dropped row printed as
+    a note finding — engine 5's prune semantics applied to
+    calibration.
+    """
+    if not measurements and not update:
+        return [], {}
+    ledger_path = budgets_path or budgets_mod.default_budgets_path()
+    ledger = budgets_mod.load_budgets(ledger_path) or {}
+    section = ledger.get("quant", {})
+    findings: List[Finding] = []
+    report: Dict = {}
+
+    clean = {k: {f: v for f, v in rec.items() if not f.startswith("_")}
+             for k, rec in measurements.items()}
+    report["measured"] = clean
+
+    if update:
+        if not clean:
+            report["budgets_written"] = {"rows": []}
+            return findings, report
+        prune: List[str] = []
+        if full_run:
+            sanctioned = set(registry.expected_budget_rows("quant"))
+            measured_prefixes = {k.split("/", 1)[0] for k in clean}
+            for row in sorted(section):
+                if row in clean:
+                    continue
+                prefix = row.split("/", 1)[0]
+                if prefix in sanctioned and prefix not in measured_prefixes:
+                    continue      # entry registered but skipped here
+                prune.append(row)
+                why = ("its entry left the registry"
+                       if prefix not in sanctioned
+                       else "its site left the traced graph")
+                findings.append(Finding(
+                    engine="quant", rule="budget-pruned",
+                    path=budgets_mod.display_path(ledger_path),
+                    line=budgets_mod.budget_line(ledger_path, row),
+                    message=f"pruned quant row '{row}' — {why}; "
+                            f"dropped record: "
+                            f"{json.dumps(section[row], sort_keys=True)}",
+                    severity="note", data={"row": row}))
+        meta = ledger.get("meta") or {}
+        budgets_mod.save_budgets(ledger_path, meta or None, clean,
+                                 section="quant", prune=prune)
+        report["budgets_written"] = {
+            "path": budgets_mod.display_path(ledger_path),
+            "rows": sorted(clean),
+            "pruned": prune}
+        return findings, report
+
+    disp = budgets_mod.display_path(ledger_path)
+    for key, m in sorted(measurements.items()):
+        rec = section.get(key)
+        clean_m = clean[key]
+        if rec is None:
+            findings.append(Finding(
+                engine="quant", rule="budget-missing", path=disp,
+                line=0,
+                message=f"quantization site '{key}' has no quant "
+                        f"ledger row — run `python -m raft_tpu."
+                        f"analysis --engine quant --update-budgets` "
+                        f"and commit the budgets.json diff",
+                data={"row": key}))
+            continue
+        drifts = []
+        if _scales_differ(m.get("scale"), rec.get("scale")):
+            drifts.append(f"scale {rec.get('scale')} -> "
+                          f"{m.get('scale')}")
+        if m.get("dtype") != rec.get("dtype"):
+            drifts.append(f"dtype {rec.get('dtype')} -> "
+                          f"{m.get('dtype')}")
+        if m.get("verdict") != rec.get("verdict"):
+            drifts.append(f"verdict {rec.get('verdict')} -> "
+                          f"{m.get('verdict')}")
+        if m.get("count") != rec.get("count"):
+            drifts.append(f"count {rec.get('count')} -> "
+                          f"{m.get('count')}")
+        if _ranges_differ(clean_m, rec):
+            drifts.append(f"range [{rec.get('lo')}, {rec.get('hi')}] "
+                          f"-> [{m.get('lo')}, {m.get('hi')}]")
+        if drifts:
+            findings.append(Finding(
+                engine="quant", rule="stale-calibration", path=disp,
+                line=budgets_mod.budget_line(ledger_path, key),
+                message=f"{key}: calibration drifted ({'; '.join(drifts)}) "
+                        f"— the graph this row certified no longer "
+                        f"exists; recalibrate with `--engine quant "
+                        f"--update-budgets` and re-review the diff",
+                data={"row": key, "drift": drifts}))
+
+    # ledger-side checks: rows claiming impossible ranges, and rows
+    # whose producing entry/site is gone (the stale-calibration class
+    # engine 5's orphan scan also surfaces, anchored here at the row)
+    sanctioned = set(registry.expected_budget_rows("quant"))
+    measured_prefixes = {k.split("/", 1)[0] for k in measurements}
+    stale: List[str] = []
+    for row in sorted(section):
+        rec = section[row]
+        if (_site_kind(row) == "quantize"
+                and rec.get("dtype") in _CODE_SPANS
+                and rec.get("lo") is not None):
+            lo_span, hi_span = _CODE_SPANS[rec["dtype"]]
+            if rec["lo"] < lo_span - 0.5 or rec["hi"] > hi_span + 0.5:
+                findings.append(Finding(
+                    engine="quant", rule="range-overflow", path=disp,
+                    line=budgets_mod.budget_line(ledger_path, row),
+                    message=f"{row}: ledger row records range "
+                            f"[{rec['lo']}, {rec['hi']}] outside the "
+                            f"{rec['dtype']} span [{lo_span:.6g}, "
+                            f"{hi_span:.6g}] — the calibration itself "
+                            f"sanctions an overflowing cast",
+                    data={"row": row}))
+        if row in measurements:
+            continue
+        prefix = row.split("/", 1)[0]
+        if prefix not in sanctioned or (full_run
+                                        and prefix in measured_prefixes):
+            why = ("entry left the registry"
+                   if prefix not in sanctioned
+                   else "site left the traced graph")
+            findings.append(Finding(
+                engine="quant", rule="stale-calibration", path=disp,
+                line=budgets_mod.budget_line(ledger_path, row),
+                message=f"quant row '{row}' certifies nothing — its "
+                        f"{why}; prune it with a full `--engine quant "
+                        f"--update-budgets` run",
+                data={"row": row}))
+        else:
+            stale.append(row)
+    if stale and measurements:
+        report["not_measured"] = stale
+    return findings, report
+
+
+# --------------------------------------------------------------------------
+# entries
+# --------------------------------------------------------------------------
+
+SkipEntry = registry.SkipEntry
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantEntry:
+    name: str
+    builder: Callable[[], Tuple]
+    rules: frozenset = ALL_QUANT_RULES
+    budgeted: bool = True         # fixtures never get ledger records
+
+
+def _from_registry(e: "registry.EntryPoint") -> QuantEntry:
+    """Adapt a registry entry to this engine's builder shape
+    ``() -> (fn, args, ranges[, ctx])`` — same adapter contract as
+    engine 4's, sharing its RANGE_RECIPES table."""
+    def build():
+        fn, args = e.build()
+        ranges = RANGE_RECIPES[e.ranges](args)
+        if e.needs_mesh:
+            return fn, args, ranges, registry.trace_context(e)
+        return fn, args, ranges
+
+    return QuantEntry(e.name, build, budgeted=e.budgeted)
+
+
+# entry enumeration — derived from raft_tpu/entrypoints.py (engine 5
+# cross-checks this derivation against the declared participation)
+ENTRIES: Dict[str, QuantEntry] = {
+    name: _from_registry(e)
+    for name, e in registry.quant_entries().items()}
+
+
+# --------------------------------------------------------------------------
+# seeded fixtures — deliberately broken, never run by default
+# --------------------------------------------------------------------------
+
+def _fixture_quant_overflow():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        # unclamped, unscaled cast straight to int8: the proven
+        # operand range [0, 1e4] exceeds the +/-127 span
+        return (x * 100.0).astype(jnp.int8)
+
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    return jax.jit(fn), (sds,), [VRange(0.0, 100.0)]
+
+
+def _fixture_quant_unproven():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        # quantizing a tensor with NO declared bound: the lattice has
+        # nothing to certify against
+        return x.astype(jnp.int8)
+
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    return jax.jit(fn), (sds,), [TOP]
+
+
+def _fixture_quant_narrow_accum():
+    import jax
+
+    def fn(a, b):
+        # int8 x int8 dot WITHOUT preferred_element_type: XLA keeps
+        # the int8 output dtype and the K=1024 partial sums wrap
+        return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())))
+
+    import jax.numpy as jnp
+
+    a = jax.ShapeDtypeStruct((8, 1024), jnp.int8)
+    b = jax.ShapeDtypeStruct((1024, 8), jnp.int8)
+    return (jax.jit(fn), (a, b),
+            [VRange(-127.0, 127.0), VRange(-127.0, 127.0)])
+
+
+def _fixture_quant_requant():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(q):
+        # gate nonlinearity on RAW codes — the per-tensor scale was
+        # never re-applied after the dequantizing convert
+        return jnp.tanh(q.astype(jnp.float32))
+
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.int8)
+    return jax.jit(fn), (sds,), [VRange(-127.0, 127.0)]
+
+
+FIXTURE_ENTRIES: Dict[str, QuantEntry] = {
+    "seeded_quant_overflow": QuantEntry("seeded_quant_overflow",
+                                        _fixture_quant_overflow,
+                                        budgeted=False),
+    "seeded_quant_unproven": QuantEntry("seeded_quant_unproven",
+                                        _fixture_quant_unproven,
+                                        budgeted=False),
+    "seeded_quant_narrow_accum": QuantEntry("seeded_quant_narrow_accum",
+                                            _fixture_quant_narrow_accum,
+                                            budgeted=False),
+    "seeded_quant_requant": QuantEntry("seeded_quant_requant",
+                                       _fixture_quant_requant,
+                                       budgeted=False),
+}
+
+
+# --------------------------------------------------------------------------
+# the audit
+# --------------------------------------------------------------------------
+
+def _note(entry: str, message: str) -> Finding:
+    return Finding(engine="quant", rule="quant-audit", path=entry,
+                   line=0, message=message, severity="note")
+
+
+def _apply_inline_waivers(findings: List[Finding]) -> List[Finding]:
+    """Apply the shared ``# graftlint: disable=`` syntax against each
+    finding's own file (engine 6's convention): a waived
+    unproven-range is the "reasoned waiver" the rule text demands, and
+    engine 5's stale-waiver gate counts it as active."""
+    from raft_tpu.analysis.lint import apply_waivers, parse_waivers
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for rel, fs in by_path.items():
+        ap = rel if os.path.isabs(rel) else os.path.join(root, rel)
+        try:
+            with open(os.path.abspath(ap), encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            out += fs
+            continue
+        waivers, _ = parse_waivers(source, ap)
+        out += apply_waivers(fs, waivers)
+    return out
+
+
+def _apply_waivers(findings: List[Finding]) -> List[Finding]:
+    return _apply_inline_waivers(apply_data_waivers(findings, WAIVERS))
+
+
+def run_quant_audit(names: Optional[Sequence[str]] = None,
+                    budgets_path: Optional[str] = None,
+                    update: bool = False
+                    ) -> Tuple[List[Finding], Dict]:
+    """Run the named quant audits (default: every non-fixture entry).
+
+    Traces each quantized entry's builder, abstract-interprets the
+    jaxpr under the quant input specs, certifies each quantize/
+    dequantize/contraction site, and compares the site ledger against
+    the ``quant`` section of budgets.json (``update=True``
+    re-baselines it, merge semantics).  Returns ``(findings, report)``.
+    """
+    import jax
+
+    all_entries = dict(ENTRIES)
+    all_entries.update(FIXTURE_ENTRIES)
+    if names is None:
+        selected = list(ENTRIES)
+    else:
+        unknown = [n for n in names if n not in all_entries]
+        if unknown:
+            raise KeyError(f"unknown quant audit(s) {unknown}; known: "
+                           f"{sorted(all_entries)}")
+        selected = list(names)
+
+    findings: List[Finding] = []
+    report: Dict = {}
+    measurements: Dict[str, Dict] = {}
+    for name in selected:
+        entry = all_entries[name]
+        t0 = time.monotonic()
+        try:
+            built = entry.builder()
+        except SkipEntry as e:
+            findings.append(_note(name, f"skipped: {e}"))
+            continue
+        except ImportError as e:
+            findings.append(_note(name, f"skipped: unavailable here ({e})"))
+            continue
+        if len(built) == 4:
+            fn, args, ranges, ctx = built
+        else:
+            fn, args, ranges = built
+            ctx = None
+        try:
+            if ctx is not None:
+                with ctx:
+                    closed = jax.make_jaxpr(fn)(*args)
+            else:
+                closed = jax.make_jaxpr(fn)(*args)
+        except (TypeError, ValueError, NotImplementedError,
+                jax.errors.JAXTypeError) as e:
+            findings.append(_note(
+                name, f"skipped: does not trace on this jax "
+                      f"({type(e).__name__}: {e})"))
+            continue
+        interp = QuantInterpreter(name, entry.rules)
+        interp.run(closed, ranges)
+        findings.extend(interp.findings)
+        ordinals: Dict[str, int] = {}
+        entry_sites = []
+        for kind, rec in interp.sites:
+            n = ordinals.get(kind, 0)
+            ordinals[kind] = n + 1
+            key = f"{name}/{kind}.{n}"
+            entry_sites.append(key)
+            if entry.budgeted:
+                measurements[key] = rec
+        report[name] = {
+            "eqns": interp.eqn_count,
+            "top_outputs": interp.top_outputs,
+            "findings": len(interp.findings),
+            "sites": entry_sites,
+            "seconds": round(time.monotonic() - t0, 2),
+        }
+
+    cfs, creport = compare_quant_budgets(
+        measurements, budgets_path=budgets_path, update=update,
+        full_run=names is None)
+    findings.extend(cfs)
+    if creport:
+        report["quant_ledger"] = creport
+    return _apply_waivers(findings), report

@@ -312,6 +312,27 @@ def check_budgets(budgets_path: Optional[str] = None) -> List[Finding]:
                     f"raft_tpu.analysis --engine numerics "
                     f"--update-budgets` and commit the diff",
             data={"section": "pallas_vmem", "row": name}))
+
+    quant_sanctioned = set(registry.expected_budget_rows("quant"))
+    quant_rows = set(ledger.get("quant", {}))
+    for row in sorted(quant_rows):
+        if row.split("/", 1)[0] not in quant_sanctioned:
+            findings.append(Finding(
+                engine="registry", rule="orphan-budget", path=disp,
+                line=budgets_mod.budget_line(ledger_path, row),
+                message=f"quant row '{row}' has no registered "
+                        f"quantized entry prefix — prune it with a "
+                        f"full `--engine quant --update-budgets` run",
+                data={"section": "quant", "row": row}))
+    quant_prefixes = {r.split("/", 1)[0] for r in quant_rows}
+    for name in sorted(quant_sanctioned - quant_prefixes):
+        findings.append(Finding(
+            engine="registry", rule="missing-budget", path=disp, line=0,
+            message=f"registered quantized entry '{name}' has no "
+                    f"quant calibration rows — run `python -m "
+                    f"raft_tpu.analysis --engine quant "
+                    f"--update-budgets` and commit the diff",
+            data={"section": "quant", "row": name}))
     return findings
 
 
@@ -321,11 +342,14 @@ def orphan_rows(budgets_path: Optional[str] = None) -> Dict[str, List[str]]:
     ledger = budgets_mod.load_budgets(budgets_path) or {}
     entries = set(registry.expected_budget_rows("entries"))
     pallas = set(registry.expected_budget_rows("pallas_vmem"))
+    quant = set(registry.expected_budget_rows("quant"))
     return {
         "entries": sorted(r for r in ledger.get("entries", {})
                           if r not in entries),
         "pallas_vmem": sorted(r for r in ledger.get("pallas_vmem", {})
                               if r.split("/", 1)[0] not in pallas),
+        "quant": sorted(r for r in ledger.get("quant", {})
+                        if r.split("/", 1)[0] not in quant),
     }
 
 
@@ -403,6 +427,7 @@ def check_participation() -> List[Finding]:
         from raft_tpu.analysis.hlo_audit import ENTRIES as HLO
         from raft_tpu.analysis.jaxpr_audit import ENTRY_AUDITS
         from raft_tpu.analysis.numerics_audit import ENTRIES as NUM
+        from raft_tpu.analysis.quant_audit import ENTRIES as QUANT
     except Exception as e:
         # an engine module that no longer imports (e.g. a registry
         # audit kind without an implementation) is itself the finding
@@ -414,10 +439,12 @@ def check_participation() -> List[Finding]:
 
     mismatch("hlo", set(registry.hlo_entries()), set(HLO))
     mismatch("numerics", set(registry.numerics_entries()), set(NUM))
+    mismatch("quant", set(registry.quant_entries()), set(QUANT))
     mismatch("jaxpr", set(registry.jaxpr_audit_names()),
              set(ENTRY_AUDITS))
     for name, entry in registry.ENTRYPOINTS.items():
-        if not (entry.jaxpr or entry.hlo or entry.numerics):
+        if not (entry.jaxpr or entry.hlo or entry.numerics
+                or entry.quant):
             findings.append(Finding(
                 engine="registry", rule="engine-participation",
                 path="raft_tpu/entrypoints.py", line=0,
@@ -459,12 +486,23 @@ def active_waiver_keys(paths: Sequence[str],
     given_set = {os.path.abspath(p) for p in paths}
     conc_paths = None if given_set == default_set else paths
     conc_findings, _ = run_concurrency_audit(paths=conc_paths)
-    # engine-5/6 findings carry repo-relative display paths (absolute
+    # engine 7 shares the inline-waiver syntax too: a waived
+    # unproven-range on the int8 path must count as alive here, or the
+    # staleness gate would demand deleting the very waiver the quant
+    # rule demands exist.  Only pay the trace cost when quantized
+    # entries are registered.
+    quant_findings = []
+    if registry.quant_entries():
+        from raft_tpu.analysis.quant_audit import run_quant_audit
+
+        quant_findings, _ = run_quant_audit()
+    # engine-5/6/7 findings carry repo-relative display paths (absolute
     # when outside the repo): resolve against the repo root
     root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     active |= {(os.path.abspath(os.path.join(root, f.path)), f.line)
-               for f in list(extra_findings) + conc_findings if f.waived}
+               for f in list(extra_findings) + conc_findings
+               + quant_findings if f.waived}
     return active
 
 

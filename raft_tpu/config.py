@@ -164,6 +164,19 @@ class RAFTConfig:
     # — enabling it adds ONLY the head's parameters (conf_head/*) and an
     # extra output to __call__ (see models/raft.py).
     uncertainty_head: bool = False
+    # Int8 serving path (serve/quant.py, graftlint engine 7): the
+    # correlation-volume contraction runs on int8 codes — fmaps
+    # quantize at the static calibrated clip ``q8_clip`` (symmetric,
+    # scale = clip/127), each pyramid level contracts i8·i8→i32 on the
+    # MXU (the narrow-accum contract the certifier pins), and the
+    # model sows the observed fmap magnitude into the 'quant'
+    # collection so the serving tripwire can prove the calibration
+    # premise held at runtime.  Serve-only: training never sets it,
+    # and the flag composes only with the plain dense-pyramid layout
+    # (validation below) — the sharded/padded/pallas corr paths keep
+    # their own dtype policies.
+    quantized_serve: bool = False
+    q8_clip: float = 16.0
 
     def __post_init__(self):
         if self.lookup_impl not in ("einsum", "pallas", "pallas_stacked"):
@@ -211,6 +224,18 @@ class RAFTConfig:
         if self.scan_unroll < 1:
             raise ValueError(f"scan_unroll must be >= 1, got "
                              f"{self.scan_unroll}")
+        if self.quantized_serve and (
+                self.alternate_corr or self.corr_shard
+                or self.corr_pad_lanes or self.lookup_impl != "einsum"):
+            raise ValueError(
+                "quantized_serve runs the int8 dense-pyramid path and "
+                "composes only with the plain einsum lookup layout "
+                "(alternate_corr/corr_shard/corr_pad_lanes all False) — "
+                "any other corr layout would silently skip the "
+                "quantization")
+        if self.q8_clip <= 0.0:
+            raise ValueError(f"q8_clip must be > 0 (the int8 scale is "
+                             f"clip/127), got {self.q8_clip}")
         # corr_dtype applies to BOTH corr paths since round 4: the
         # all-pairs pyramid's storage/contraction dtype, and the
         # on-demand path's feature-block dtype (models/raft.py casts the

@@ -166,6 +166,7 @@ class EntryPoint:
     hlo: bool = False             # engine 3 compiles it
     numerics: bool = False        # engine 4 interprets it
     pallas: bool = False          # engine 4's Pallas verifier walks it
+    quant: bool = False           # engine 7 certifies its quantize sites
     # --- budgets.json participation -------------------------------------
     budgeted: bool = True         # measurements may enter the ledger
     # --- engine-3 structural facts --------------------------------------
@@ -190,6 +191,8 @@ class EntryPoint:
             sections += ("entries",)
         if self.pallas:
             sections += ("pallas_vmem",)
+        if self.quant:
+            sections += ("quant",)
         return sections
 
 
@@ -284,6 +287,18 @@ def _build_serve_forward_warm():
     from raft_tpu.serve.engine import abstract_serve_forward
 
     return abstract_serve_forward(iters=2, warm=True)
+
+
+def _build_serve_forward_q8():
+    from raft_tpu.serve.quant import abstract_serve_forward_q8
+
+    return abstract_serve_forward_q8(iters=2)
+
+
+def _build_serve_forward_q8_warm():
+    from raft_tpu.serve.quant import abstract_serve_forward_q8
+
+    return abstract_serve_forward_q8(iters=2, warm=True)
 
 
 def _build_tiled_serve_forward():
@@ -517,6 +532,28 @@ ENTRYPOINTS: Dict[str, EntryPoint] = {e.name: e for e in (
         build=_build_serve_forward_warm,
         jaxpr=("serve_forward",), hlo=True, numerics=True, deep=True,
         cache_tag="serve_forward"),
+    # the int8 serving pair (serve/quant.py): the serve forward with
+    # QTensor weights + the i8·i8→i32 corr contraction and the runtime
+    # range-tripwire output.  jaxpr rides the GENERIC workload audit
+    # (f64 hygiene / no scan transfers / all-f32 boundary — the oob
+    # flag leaves as f32), engine 3 pins its convert-op churn and zero
+    # collectives, engine 4 interprets it under the "quant" range
+    # recipe (int8 codes in [-127,127], scales in (0,1]), and engine 7
+    # certifies every quantize site against the `quant` ledger section.
+    EntryPoint(
+        "serve_forward_q8",
+        anchor=("raft_tpu.serve.quant", "abstract_serve_forward_q8"),
+        build=_build_serve_forward_q8,
+        jaxpr=("workload_forward",), hlo=True, numerics=True, deep=True,
+        quant=True, ranges="quant",
+        cache_tag="serve_forward_q8", bench_lane="serve_q8"),
+    EntryPoint(
+        "serve_forward_q8_warm",
+        anchor=("raft_tpu.serve.quant", "abstract_serve_forward_q8"),
+        build=_build_serve_forward_q8_warm,
+        jaxpr=("workload_forward",), hlo=True, numerics=True, deep=True,
+        quant=True, ranges="quant",
+        cache_tag="serve_forward_q8"),
     # the tiled 4K family (serve/tiled.py): the serve forward at the
     # tile bucket's static shape — tiles ride the ordinary batcher, so
     # the only new lowerable graph is the tile-shaped executable, and
@@ -695,6 +732,10 @@ def pallas_entries() -> Dict[str, EntryPoint]:
     return {n: e for n, e in ENTRYPOINTS.items() if e.pallas}
 
 
+def quant_entries() -> Dict[str, EntryPoint]:
+    return {n: e for n, e in ENTRYPOINTS.items() if e.quant}
+
+
 def expected_budget_rows(section: str) -> List[str]:
     """Registry-sanctioned row names (entry names for ``entries``,
     ``entry/`` prefixes for ``pallas_vmem``) — what engine 5's ledger
@@ -705,6 +746,9 @@ def expected_budget_rows(section: str) -> List[str]:
     if section == "pallas_vmem":
         return [n for n, e in ENTRYPOINTS.items()
                 if e.pallas and e.budgeted]
+    if section == "quant":
+        return [n for n, e in ENTRYPOINTS.items()
+                if e.quant and e.budgeted]
     raise KeyError(f"unknown budgets section {section!r}")
 
 

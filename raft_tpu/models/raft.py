@@ -37,6 +37,7 @@ from raft_tpu.ops.corr import (
     alternate_corr_lookup,
     build_corr_pyramid_direct,
     build_corr_pyramid_padded,
+    build_corr_pyramid_q8,
     build_fmap_pyramid,
     chunked_corr_lookup,
     corr_lookup,
@@ -227,6 +228,21 @@ class RAFT(nn.Module):
             corr_state = (fmap1.astype(corr_dt),
                           tuple(p.astype(corr_dt) for p in
                                 build_fmap_pyramid(fmap2, cfg.corr_levels)))
+        elif cfg.quantized_serve:
+            # Int8 serve path (serve/quant.py; config validation forbids
+            # combining with the sharded/padded/pallas corr layouts):
+            # the pyramid contracts int8 codes at the static q8_clip
+            # calibration, i32 accumulation.  The observed fmap
+            # magnitude is sown so the serving tripwire can check the
+            # calibration premise per batch and fall back TYPED to the
+            # bf16 executable when it fails — graftlint engine 7
+            # certifies the quantize sites statically, this sow is the
+            # runtime half of that contract.
+            pyramid, fmap_amax = build_corr_pyramid_q8(
+                fmap1, fmap2, cfg.corr_levels, corr_dt,
+                clip=cfg.q8_clip)
+            self.sow("quant", "fmap_amax", fmap_amax)
+            corr_state = tuple(pyramid)
         elif cfg.corr_shard and cfg.corr_shard_impl == "ring":
             # Explicit ring construction over the ambient mesh
             # (parallel/ring.py): fmap2 shards rotate via ppermute, the

@@ -171,6 +171,14 @@ incident                severity  meaning
                                   corruption healed), a failing one
                                   stays fatal and flips the readiness
                                   probe so the replica drains
+``serve-quant-fallback`` recovered the int8 serve path's range tripwire
+                                  fired (feature-map or input magnitude
+                                  outside the calibrated clip): the
+                                  request was re-served on the bf16
+                                  executable — typed degradation, the
+                                  request still completes and counts
+                                  as served (serve/quant.py
+                                  QuantServeEngine)
 ``crash-loop``          fatal     the run supervisor restarted the run
                                   K times inside W seconds (or spent
                                   its restart budget) and terminated
@@ -242,6 +250,7 @@ DEFAULT_INCIDENT_SEVERITY = {
     "sdc-detected": "fatal",
     "sdc-replay-mismatch": "fatal",
     "sdc-serve-canary": "fatal",
+    "serve-quant-fallback": "recovered",
     "crash-loop": "fatal",
 }
 

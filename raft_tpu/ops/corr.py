@@ -112,6 +112,67 @@ def build_corr_pyramid_direct(fmap1: jax.Array, fmap2: jax.Array,
     return pyramid
 
 
+# Symmetric int8 quantization span: codes live in [-127, 127] (the
+# -128 code is unused so negation round-trips), scale = clip / 127.
+Q8_SPAN = 127.0
+
+
+def build_corr_pyramid_q8(fmap1: jax.Array, fmap2: jax.Array,
+                          num_levels: int = 4, dtype=jnp.float32,
+                          clip: float = 16.0):
+    """Int8 variant of :func:`build_corr_pyramid_direct`.
+
+    Both fmaps quantize to int8 codes at a STATIC calibrated clip
+    (symmetric per-tensor scale ``clip / 127``; codes clamp before the
+    int8 convert, so the cast itself can never wrap — the structural
+    property graftlint engine 7's ``range-overflow`` rule proves).
+    Each pyramid level contracts the codes i8·i8→i32 on the MXU
+    (``preferred_element_type=int32`` — the ``narrow-accum``
+    contract: a C-deep int8 accumulation in i8 would wrap at C > 2),
+    then rescales ONCE by ``scale² / sqrt(C)`` back to float — the
+    requant-hygiene order engine 7 checks (integer codes never reach
+    a nonlinearity or residual add before their scale re-applies).
+
+    The pooling chain stays float32 (same reasoning as the bf16 path:
+    pooled magnitudes never exceed the clip, since averaging is a
+    contraction in max-norm, so one calibration covers every level).
+
+    Returns ``(levels, fmap_amax)`` — levels shaped like
+    ``build_corr_pyramid_direct``'s, plus the observed max |fmap|
+    scalar (f32) for the serving tripwire: ``fmap_amax > clip`` means
+    the calibration premise did NOT hold for this batch and the
+    serve path must fall back to the bf16 executable (typed, never
+    silent — serve/quant.py).
+    """
+    B, H, W, C = fmap1.shape
+    _check_pyramid_depth(H, W, num_levels)
+    f1 = fmap1.astype(jnp.float32)
+    f2 = fmap2.astype(jnp.float32)
+    fmap_amax = jnp.maximum(jnp.max(jnp.abs(f1)), jnp.max(jnp.abs(f2)))
+    inv_scale = jnp.float32(Q8_SPAN / clip)
+
+    def quantize(x):
+        codes = jnp.clip(jnp.round(x * inv_scale),
+                         -jnp.float32(Q8_SPAN), jnp.float32(Q8_SPAN))
+        return codes.astype(jnp.int8)
+
+    q1 = quantize(f1).reshape(B, H * W, C)
+    scale = jnp.float32(clip / Q8_SPAN)
+    corr_scale = scale * scale / jnp.sqrt(jnp.float32(C))
+    pyramid = []
+    for lvl in range(num_levels):
+        if lvl:
+            f2 = avg_pool2x(f2)
+        Hl, Wl = f2.shape[1], f2.shape[2]
+        q2 = quantize(f2).reshape(B, Hl * Wl, C)
+        corr = jax.lax.dot_general(
+            q1, q2, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)
+        pyramid.append((corr.astype(jnp.float32) * corr_scale)
+                       .reshape(B, H * W, Hl, Wl).astype(dtype))
+    return pyramid, fmap_amax
+
+
 def _build_padded_levels(fmap1: jax.Array, fmap2: jax.Array,
                          num_levels: int, dtype, q_pad_to: int,
                          extents_fn) -> List[jax.Array]:

@@ -43,6 +43,20 @@ the dispatch watchdog declares a wedge; 2 usage.
                      canary (--canary_every) must catch the digest
                      mismatch, recompile-and-recheck, and record a
                      recovered ``sdc-serve-canary``
+- ``quant-overflow@K`` (needs ``--quantize``) the Kth batch dispatch
+                     after warmup carries pixels far outside the int8
+                     calibration premise: the runtime range tripwire
+                     must fire, the request must be RE-SERVED on the
+                     bf16 executable (typed, recovered
+                     ``serve-quant-fallback``), and conservation must
+                     hold — quantization degrades typed, never wrong
+
+``--quantize`` serves the flow workload on the int8 path
+(serve/quant.py QuantServeEngine): int8 weight codes + int8 corr
+contraction, certified by graftlint engine 7 against the ``quant``
+calibration ledger, with a runtime range tripwire that falls back
+typed to the bf16 executable when an input leaves the calibrated
+envelope.
 
 ``--stereo_every N`` makes the session heterogeneous: every Nth
 request routes to a stereo disparity engine (workloads/stereo.py)
@@ -89,13 +103,19 @@ def parse_inject(spec):
         return None, 0
     kind, _, arg = spec.partition("@")
     kinds = ("overload", "deadline-storm", "poison", "sigkill", "stall",
-             "kill-replica", "rolling-restart", "canary-flip")
+             "kill-replica", "rolling-restart", "canary-flip",
+             "quant-overflow")
     if kind not in kinds:
         raise ValueError(f"unknown serve inject {kind!r} "
                          f"(known: {', '.join(kinds)})")
     if kind in ("poison", "sigkill", "kill-replica"):
         if not arg.isdigit():
             raise ValueError(f"inject {kind} needs @K (request ordinal)")
+        return kind, int(arg)
+    if kind == "quant-overflow":
+        if not arg.isdigit() or int(arg) < 1:
+            raise ValueError("inject quant-overflow needs @K (batch "
+                             "dispatch ordinal, 1-based)")
         return kind, int(arg)
     if kind == "rolling-restart":
         if arg and not arg.isdigit():
@@ -250,6 +270,11 @@ def parse_args(argv=None):
                         "between dispatches and compare digests "
                         "bit-exact against the warmup baseline "
                         "(resilience/sdc.py layer 4); 0 disables")
+    p.add_argument("--quantize", action="store_true",
+                   help="serve the flow workload on the int8 path "
+                        "(serve/quant.py): int8 weight codes + int8 "
+                        "corr contraction with a typed bf16 fallback "
+                        "when the runtime range tripwire fires")
     p.add_argument("--no_degrade", action="store_true")
     p.add_argument("--aot_cache", default=None,
                    help="AOT executable cache directory (warm restarts)")
@@ -460,6 +485,10 @@ def main(argv=None) -> int:
     if inject in ("kill-replica", "rolling-restart"):
         print(f"serve: inject {inject} needs --fleet N", file=sys.stderr)
         return 2
+    if inject == "quant-overflow" and not args.quantize:
+        print("serve: inject quant-overflow needs --quantize",
+              file=sys.stderr)
+        return 2
 
     import numpy as np
 
@@ -507,8 +536,15 @@ def main(argv=None) -> int:
     variables = model.init(jax.random.PRNGKey(args.seed), init_img,
                            init_img, iters=2, train=True)
 
-    engine = ServeEngine(model, variables, batch_size=args.batch_size,
-                         aot_cache=aot)
+    if args.quantize:
+        from raft_tpu.serve.quant import QuantServeEngine
+
+        engine = QuantServeEngine(model, variables,
+                                  batch_size=args.batch_size,
+                                  aot_cache=aot, on_incident=incident)
+    else:
+        engine = ServeEngine(model, variables,
+                             batch_size=args.batch_size, aot_cache=aot)
     if inject == "stall":
         real_forward = engine.forward
 
@@ -545,6 +581,25 @@ def main(argv=None) -> int:
         engine.forward = flaky_forward
         engine.invalidate = healed_invalidate
 
+    qo = {"armed": False, "n": 0}      # the quant-overflow chaos shim
+    if inject == "quant-overflow":
+        # The Kth post-warmup batch dispatch carries pixels far outside
+        # the int8 calibration premise (IMG_PREMISE_MAX): the in-graph
+        # tripwire must flag it and QuantServeEngine must re-serve the
+        # batch on its bf16 twin — typed degradation, zero drops.
+        real_q_fwd = engine.forward
+
+        def overflowing_forward(hw, iters, img1, img2, flow_init=None):
+            if qo["armed"]:
+                qo["n"] += 1
+                if qo["n"] == inject_arg:
+                    img1 = img1 * np.float32(1e5)
+                    img2 = img2 * np.float32(1e5)
+            return real_q_fwd(hw, iters, img1, img2,
+                              flow_init=flow_init)
+
+        engine.forward = overflowing_forward
+
     engines = {"flow": engine}
     if args.stereo_every:
         # heterogeneous session: a stereo disparity engine rides the
@@ -566,6 +621,7 @@ def main(argv=None) -> int:
     server.warmup(warm_too=args.video_streams > 0)
     startup_s = time.perf_counter() - t0
     flaky["on"] = True                 # no-op unless inject canary-flip
+    qo["armed"] = True                 # no-op unless inject quant-overflow
     stats = dict(aot.stats) if aot else {}
     print(json.dumps({"serve_startup": {
         "startup_s": round(startup_s, 3),

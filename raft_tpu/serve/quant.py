@@ -1,0 +1,335 @@
+"""Int8 serving: quantized weights + int8 corr contraction, certified.
+
+The serve forward's cost is encoder + update-block matmuls; this module
+quantizes BOTH halves for the serving path only (training never sees
+any of it):
+
+- **Weights**: every conv kernel under ``params/fnet``, ``params/cnet``
+  and ``params/refine/update_block`` is replaced by a
+  :class:`QTensor` — int8 codes plus a per-tensor symmetric f32 scale
+  (``scale = amax/127``, codes clamped before the int8 cast so the
+  conversion can never wrap).  Dequantization happens IN-GRAPH
+  (``codes.astype(f32) * scale`` — the scale re-applies before any
+  nonlinearity or residual add, the requant-hygiene order engine 7
+  checks), so ``model.apply`` sees an ordinary variables tree and the
+  model code is untouched.  Biases / norm parameters stay f32.
+- **The corr-volume contraction**: ``RAFTConfig.quantized_serve``
+  routes the pyramid through ``ops.corr.build_corr_pyramid_q8`` —
+  fmaps quantize at the static calibrated ``q8_clip``, each level
+  contracts i8·i8→i32 on the MXU (the narrow-accum contract), and the
+  observed fmap magnitude is sown into the ``'quant'`` collection.
+
+**The fallback contract** (the certifier's runtime half): graftlint
+engine 7 (``analysis/quant_audit.py``) proves the quantize sites safe
+under the declared input spec; at runtime the graph itself emits an
+``oob`` flag — the input premise (|pixels| <= ``IMG_PREMISE_MAX``) or
+the fmap calibration premise (|fmap| <= ``q8_clip``) failed for this
+batch.  :class:`QuantServeEngine` checks the flag on the host and, when
+it fires, emits a typed ``serve-quant-fallback`` incident and re-runs
+the batch on the bf16 executable it keeps warm — degraded TYPED, never
+silently serving bad flow (the chaos ``serve-quant-overflow`` row
+drives exactly this path end to end).
+
+``abstract_serve_forward_q8`` is the lowerable entry behind the
+``serve_forward_q8``/``serve_forward_q8_warm`` registry records —
+exactly the graph :class:`QuantServeEngine` compiles, audited by all
+seven engines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.serve.engine import ServeEngine, serve_config
+
+logger = logging.getLogger(__name__)
+
+# The certifier's declared input premise: serve images are decoded
+# uint8 pixels in [0, 255]; 4x headroom tolerates mild preprocessing
+# drift without tripping, anything past it voids the range proof.
+IMG_PREMISE_MAX = 1024.0
+
+# Param subtrees whose conv kernels quantize (the serve-cost carriers:
+# feature/context encoders + the per-iteration update block).  Matched
+# against pytree key paths; everything else (biases, norm scales/means,
+# flow-head convs' biases, batch_stats) stays f32.
+QUANT_SCOPES = ("fnet", "cnet", "update_block")
+
+
+@dataclasses.dataclass(frozen=True)
+class QTensor:
+    """A quantized parameter leaf: int8 codes + per-tensor f32 scale.
+
+    Registered as a pytree WITH KEYS so cache-key tree signatures and
+    the audits' keypath-based range recipes see ``.q`` / ``.scale``
+    leaves by name.
+    """
+
+    q: Any
+    scale: Any
+
+    def tree_flatten_with_keys(self):
+        import jax
+
+        return (((jax.tree_util.GetAttrKey("q"), self.q),
+                 (jax.tree_util.GetAttrKey("scale"), self.scale)), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+
+def _register_qtensor():
+    import jax
+
+    try:
+        jax.tree_util.register_pytree_with_keys_class(QTensor)
+    except ValueError:
+        pass  # already registered (repeated import paths)
+
+
+_REGISTERED = False
+
+
+def _ensure_registered():
+    global _REGISTERED
+    if not _REGISTERED:
+        _register_qtensor()
+        _REGISTERED = True
+
+
+def _is_quant_path(path) -> bool:
+    keys = [getattr(k, "key", getattr(k, "name", None))
+            for k in path]
+    return (len(keys) > 0 and keys[-1] == "kernel"
+            and any(k in QUANT_SCOPES for k in keys if isinstance(k, str)))
+
+
+def quantize_variables(variables):
+    """Host-side: replace the quantizable kernels with QTensor leaves.
+
+    Symmetric per-tensor scale ``amax/127`` (floored so an all-zero
+    kernel still round-trips); codes clamp to [-127, 127] before the
+    int8 cast — the cast can never wrap, which is the structural
+    guarantee engine 7's range-overflow rule checks on the abstract
+    graph.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    _ensure_registered()
+
+    def q(path, leaf):
+        if not _is_quant_path(path):
+            return leaf
+        x = np.asarray(leaf, np.float32)
+        scale = max(float(np.abs(x).max()) / 127.0, 1e-8)
+        codes = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+        return QTensor(jnp.asarray(codes), jnp.float32(scale))
+
+    return jax.tree_util.tree_map_with_path(q, variables)
+
+
+def quantize_abstract(variables_sds):
+    """The ShapeDtypeStruct image of :func:`quantize_variables` — the
+    registry builders construct the audited graph without weights."""
+    import jax
+    import jax.numpy as jnp
+
+    _ensure_registered()
+
+    def q(path, leaf):
+        if not _is_quant_path(path):
+            return leaf
+        return QTensor(jax.ShapeDtypeStruct(leaf.shape, jnp.int8),
+                       jax.ShapeDtypeStruct((), jnp.float32))
+
+    return jax.tree_util.tree_map_with_path(q, variables_sds)
+
+
+def dequantize_variables(qvars, dtype=None):
+    """In-graph: QTensor leaves back to float kernels (scale re-applies
+    HERE, before the kernel reaches any conv — requant hygiene)."""
+    import jax
+    import jax.numpy as jnp
+
+    _ensure_registered()
+    dt = dtype or jnp.float32
+
+    def dq(leaf):
+        if isinstance(leaf, QTensor):
+            return leaf.q.astype(dt) * leaf.scale.astype(dt)
+        return leaf
+
+    return jax.tree_util.tree_map(
+        dq, qvars, is_leaf=lambda x: isinstance(x, QTensor))
+
+
+def q8_model(model):
+    """The int8-corr twin of a serving model: same params, same
+    architecture, ``quantized_serve=True`` corr path."""
+    cfg = dataclasses.replace(model.cfg, quantized_serve=True)
+    return type(model)(cfg)
+
+
+def make_q8_forward(model, iters: int, warm: bool):
+    """THE jitted int8 test_mode forward (cold / warm-start): the graph
+    the engines audit and :class:`QuantServeEngine` compiles.
+
+    Returns ``(flow_low, flow_up, oob)`` with ``oob`` an f32 scalar
+    (0.0/1.0 — workload outputs are a declared-f32 boundary): 1.0 means
+    a certifier premise failed at runtime (input pixels past
+    ``IMG_PREMISE_MAX`` or fmap magnitude past the calibrated clip)
+    and the caller must fall back to the bf16 executable.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    clip = jnp.float32(model.cfg.q8_clip)
+
+    def run(qv, a, b, f=None):
+        v = dequantize_variables(qv)
+        kw = {} if f is None else {"flow_init": f}
+        (flow_low, flow_up), mods = model.apply(
+            v, a, b, iters=iters, test_mode=True, mutable=["quant"],
+            **kw)
+        fmap_amax = mods["quant"]["fmap_amax"][0]
+        img_amax = jnp.maximum(jnp.max(jnp.abs(a)), jnp.max(jnp.abs(b)))
+        oob = jnp.maximum(
+            (fmap_amax > clip).astype(jnp.float32),
+            (img_amax > jnp.float32(IMG_PREMISE_MAX))
+            .astype(jnp.float32))
+        return flow_low, flow_up, oob
+
+    if warm:
+        return jax.jit(lambda qv, a, b, f: run(qv, a, b, f))
+    return jax.jit(lambda qv, a, b: run(qv, a, b))
+
+
+def compile_q8_forward(model, variables, img1_sds, img2_sds,
+                       iters: int, flow_sds=None):
+    """lower → compile :func:`make_q8_forward` — the AOT build recipe
+    behind every ``serve_forward_q8`` executable (``variables`` is the
+    QTensor tree)."""
+    fn = make_q8_forward(model, iters, warm=flow_sds is not None)
+    if flow_sds is not None:
+        return fn.lower(variables, img1_sds, img2_sds,
+                        flow_sds).compile()
+    return fn.lower(variables, img1_sds, img2_sds).compile()
+
+
+def abstract_serve_forward_q8(iters: int = 2,
+                              hw: Tuple[int, int] = (64, 64),
+                              batch: int = 2, warm: bool = False,
+                              overrides: Optional[Dict] = None):
+    """The int8 serving forward over abstract inputs: the lowerable
+    entry point behind ``serve_forward_q8``/``serve_forward_q8_warm``
+    in ``raft_tpu/entrypoints.py`` (exactly the graph
+    :class:`QuantServeEngine` compiles, built without weights).
+
+    Returns ``(fwd, args_sds)`` with args ``(qvars, img1, img2[,
+    flow_init])`` — qvars is the variables tree with QTensor (int8
+    codes + f32 scale) kernel leaves.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.models import RAFT
+
+    model = RAFT(serve_config(overrides=dict(overrides or {},
+                                             quantized_serve=True)))
+    H, W = hw
+    img_sds = jax.ShapeDtypeStruct((batch, H, W, 3), jnp.float32)
+    variables_sds = dict(jax.eval_shape(
+        lambda rng, a, b: model.init(rng, a, b, iters=iters, train=True),
+        jax.random.PRNGKey(0), img_sds, img_sds))
+    # init under quantized_serve also sows the 'quant' collection —
+    # it is an OUTPUT of apply(mutable=...), not an input
+    variables_sds.pop("quant", None)
+    qvars_sds = quantize_abstract(variables_sds)
+    fwd = make_q8_forward(model, iters, warm=warm)
+    if warm:
+        flow_sds = jax.ShapeDtypeStruct((batch, H // 8, W // 8, 2),
+                                        jnp.float32)
+        return fwd, (qvars_sds, img_sds, img_sds, flow_sds)
+    return fwd, (qvars_sds, img_sds, img_sds)
+
+
+class QuantServeEngine(ServeEngine):
+    """The int8 serving executor with the typed bf16 fallback.
+
+    Holds TWO executables per (family, iters, warm): the q8 one it
+    serves from, and the bf16 one it falls back to when the graph's
+    ``oob`` tripwire reports a violated calibration premise.  The
+    fallback emits a ``serve-quant-fallback`` incident through
+    ``on_incident`` (ledger-typed; the chaos row and the summary
+    counters read it) and re-runs the SAME batch on the bf16
+    executable — the request is always served, never silently wrong.
+
+    Canary coverage: FlowServer's golden-input canary stores a
+    reference to THIS engine per (workload, family), so its periodic
+    probe exercises the q8 executable and tripwire; ``invalidate``
+    evicts both twins so a canary recompile-and-recheck rebuilds the
+    pair coherently.
+    """
+
+    def __init__(self, model, variables, batch_size: int = 4,
+                 aot_cache=None, spans=None,
+                 cache_tag: str = "serve_forward_q8",
+                 warm_channels: int = 2, on_incident=None):
+        _ensure_registered()
+        qm = q8_model(model)
+        qvars = quantize_variables(variables)
+        super().__init__(qm, qvars, batch_size=batch_size,
+                         aot_cache=aot_cache, spans=spans,
+                         compile_fn=compile_q8_forward,
+                         cache_tag=cache_tag,
+                         warm_channels=warm_channels)
+        self.on_incident = on_incident
+        self.fallback = ServeEngine(model, variables,
+                                    batch_size=batch_size,
+                                    aot_cache=aot_cache, spans=spans,
+                                    warm_channels=warm_channels)
+        self.fallbacks = 0
+
+    def warmup(self, families, iters_levels, warm_too: bool = True
+               ) -> float:
+        # warm BOTH twins: a fallback mid-dispatch must never pay a
+        # compile inside the watchdog bracket
+        t = super().warmup(families, iters_levels, warm_too=warm_too)
+        return t + self.fallback.warmup(families, iters_levels,
+                                        warm_too=warm_too)
+
+    def invalidate(self, hw, iters, warm: bool = False) -> bool:
+        a = super().invalidate(hw, iters, warm=warm)
+        b = self.fallback.invalidate(hw, iters, warm=warm)
+        return a or b
+
+    def forward(self, hw, iters, img1, img2, flow_init=None):
+        warm = flow_init is not None
+        fn = self.executable(hw, iters, warm=warm)
+        with self.spans.span("dispatch"):
+            if warm:
+                flow_low, flow_up, oob = fn(self.variables, img1, img2,
+                                            flow_init)
+            else:
+                flow_low, flow_up, oob = fn(self.variables, img1, img2)
+            tripped = float(np.asarray(oob)) > 0.0
+            if not tripped:
+                return np.asarray(flow_low), np.asarray(flow_up)
+        # premise violated: typed incident + the SAME batch on bf16
+        self.fallbacks += 1
+        detail = (f"q8 range tripwire fired (hw={tuple(hw)} "
+                  f"iters={iters} warm={warm}): input or fmap "
+                  f"magnitude left the calibrated range — serving "
+                  f"this batch on the bf16 executable")
+        logger.warning("serve-quant-fallback: %s", detail)
+        if self.on_incident is not None:
+            self.on_incident("serve-quant-fallback", detail)
+        return self.fallback.forward(hw, iters, img1, img2,
+                                     flow_init=flow_init)

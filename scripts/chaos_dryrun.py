@@ -116,6 +116,12 @@ through ``obs report --fail-on-incident fatal``:
                          cadence, executor recompile-and-recheck heals
                          it, typed recovered ``sdc-serve-canary``, the
                          load still fully served
+- ``serve-quant-overflow`` an int8 session (--quantize) receives a
+                         batch whose pixels leave the calibrated
+                         envelope -> the runtime range tripwire fires,
+                         the batch is RE-SERVED on the bf16 executable
+                         (typed recovered ``serve-quant-fallback``),
+                         full load served, conservation holds
 
 This is the scripted, runnable form of the resilience acceptance
 criteria; tests/test_resilience.py runs the cheap unit half in tier-1,
@@ -452,7 +458,8 @@ def serve_main(args, env, workdir):
     all_names = ("serve-overload", "serve-deadline-storm", "serve-poison",
                  "serve-mixed-family", "serve-kill-restart-warm",
                  "serve-stall", "serve-kill-one-replica",
-                 "serve-rolling-restart", "serve-sdc-canary")
+                 "serve-rolling-restart", "serve-sdc-canary",
+                 "serve-quant-overflow")
     if args.only and args.only not in all_names:
         print(f"unknown serve scenario {args.only!r} "
               f"(known: {', '.join(all_names)})")
@@ -697,6 +704,23 @@ def serve_main(args, env, workdir):
         elif not canary.get("recompiles"):
             fail = f"no recompile-and-recheck ran ({canary})"
         finish(name, {"sdc-serve-canary"}, False, fail,
+               [ledger(name, "run")])
+
+    # -- quant overflow: int8 session, one batch leaves the calibrated
+    # envelope -> tripwire fires, batch re-served on the bf16 twin,
+    # typed recovered incident, zero drops, fatal gate green
+    if want("serve-quant-overflow"):
+        name, fail = "serve-quant-overflow", None
+        rc, _, summary, tail = run_serve(
+            workdir, name, base + ["--quantize",
+                                   "--inject", "quant-overflow@2"], env)
+        if rc != 0:
+            fail = f"exit {rc} != 0\n{tail}"
+        elif summary is None or summary["unaccounted"] != 0:
+            fail = f"silent drops: {summary and summary['unaccounted']}"
+        elif summary["served"] != 8:
+            fail = f"expected 8/8 served, got {summary['served']}"
+        finish(name, {"serve-quant-fallback"}, False, fail,
                [ledger(name, "run")])
 
     # -- stall: wedged dispatch -> watchdog exit 14, typed, gated

@@ -1,0 +1,371 @@
+"""Engine 7 (the quantization-safety certifier) + the int8 serve path.
+
+Tier-1 proofs for ISSUE 17:
+
+- one seeded failing fixture per quant rule family — ``range-overflow``,
+  ``unproven-range``, ``narrow-accum``, ``requant-hygiene`` — each exits
+  1 through the CLI with file:line attribution;
+- THE clean gate: the committed tree's quantized entries certify with
+  zero unwaived findings against the committed calibration ledger;
+- calibration-ledger semantics: round-trip is silent, perturbation
+  trips ``stale-calibration`` at the ledger line, an impossible row
+  trips ``range-overflow``, orphan rows prune on a full
+  ``--update-budgets`` run, and a partial update merges (other
+  sections and unmeasured quant rows survive byte-identical);
+- the int8 serving path itself: QTensor round-trip error bounded by
+  scale/2, batched-vs-solo q8 parity, the 12-vs-32-iter EPE delta vs
+  the bf16 twin inside the pinned budget, and the runtime range
+  tripwire emitting a typed ``serve-quant-fallback`` incident while
+  STILL serving the batch (on the bf16 executable).
+
+scripts/chaos_dryrun.py --serve drives the fallback contract through
+the real CLI (the ``serve-quant-overflow`` row).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.analysis import findings as fmod
+from raft_tpu.analysis import quant_audit as qa
+
+HW = (64, 64)
+B = 2
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    from raft_tpu.models import RAFT
+    from raft_tpu.serve.engine import serve_config
+
+    model = RAFT(serve_config(small=True))
+    img = np.zeros((1, HW[0], HW[1], 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), img, img, iters=2,
+                           train=True)
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def q8_engine(model_and_vars):
+    from raft_tpu.serve.quant import QuantServeEngine
+
+    model, variables = model_and_vars
+    return QuantServeEngine(model, variables, batch_size=B)
+
+
+# ---------------------------------------------------------------------------
+# seeded fixtures: one failing program per rule family, exit 1, file:line
+# ---------------------------------------------------------------------------
+
+def test_seeded_quant_overflow_exits_1_with_file_line(capsys):
+    """The unclamped (x*100).astype(int8) fixture through the REAL CLI:
+    exit 1, range-overflow, anchored at a quant_audit.py line."""
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--engine", "quant", "--audits", "seeded_quant_overflow",
+               "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    hits = [f for f in payload["findings"]
+            if f["rule"] == "range-overflow" and not f["waived"]]
+    assert hits, payload["findings"]
+    assert hits[0]["path"].endswith("quant_audit.py")
+    assert hits[0]["line"] > 0
+
+
+def _quant_fixture_findings(name):
+    findings, _ = qa.run_quant_audit([name])
+    return [f for f in findings if not f.waived and f.severity == "error"]
+
+
+@pytest.mark.parametrize("name,rule", [
+    ("seeded_quant_unproven", "unproven-range"),
+    ("seeded_quant_narrow_accum", "narrow-accum"),
+    ("seeded_quant_requant", "requant-hygiene"),
+])
+def test_seeded_quant_fixture_trips(name, rule):
+    out = _quant_fixture_findings(name)
+    hits = [f for f in out if f.rule == rule]
+    assert hits, [f.render() for f in out]
+    assert hits[0].path.endswith("quant_audit.py") and hits[0].line > 0
+
+
+# ---------------------------------------------------------------------------
+# THE clean gate: the committed tree certifies against the committed ledger
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def repo_quant_audit():
+    return qa.run_quant_audit()
+
+
+def test_quant_gate_repo_clean(repo_quant_audit):
+    """Every registered quantized entry certifies with zero unwaived
+    findings — the int8 serve graph's casts are proven/calibrated, the
+    accumulators are wide, the requant order is clean, and the
+    committed calibration ledger matches what the graph measures."""
+    findings, report = repo_quant_audit
+    assert fmod.gate(findings) == [], [
+        f"{f.rule} {f.path}:{f.line} {f.message}"
+        for f in fmod.gate(findings)]
+    # the audit really covered both registered q8 entries
+    for entry in ("serve_forward_q8", "serve_forward_q8_warm"):
+        assert report[entry]["eqns"] > 0
+        assert report[entry]["sites"], entry
+
+
+def test_quant_sites_certify_the_contract(repo_quant_audit):
+    """The measured site facts ARE the certificate: the fmap quantize
+    is calibrated at clip/127, the corr contraction accumulates in
+    int32, and every site the ledger certifies was measured."""
+    _, report = repo_quant_audit
+    measured = report["quant_ledger"]["measured"]
+    q = measured["serve_forward_q8/quantize.0"]
+    assert q["verdict"] in ("calibrated", "proven")
+    assert q["dtype"] == "int8"
+    d = measured["serve_forward_q8/int_dot.0"]
+    assert d["dtype"] == "int32"          # the narrow-accum contract
+    assert d["k"] > 0
+    assert "not_measured" not in report["quant_ledger"]
+
+
+# ---------------------------------------------------------------------------
+# calibration-ledger semantics (pure-dict lane: no tracing)
+# ---------------------------------------------------------------------------
+
+_M = {"serve_forward_q8/quantize.0": {
+    "kind": "quantize", "dtype": "int8", "scale": 0.125984252,
+    "lo": -127.0, "hi": 127.0, "verdict": "calibrated", "count": 5}}
+
+
+def _write_ledger(tmp_path, payload):
+    p = tmp_path / "budgets.json"
+    p.write_text(json.dumps(payload, indent=1, sort_keys=True))
+    return str(p)
+
+
+def test_quant_ledger_roundtrip_is_silent(tmp_path):
+    path = _write_ledger(tmp_path, {})
+    fs, rep = qa.compare_quant_budgets(dict(_M), budgets_path=path,
+                                       update=True, full_run=True)
+    assert [f for f in fs if f.severity != "note"] == []
+    assert rep["budgets_written"]["rows"] == sorted(_M)
+    fs, rep = qa.compare_quant_budgets(dict(_M), budgets_path=path)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_quant_ledger_drift_trips_stale_calibration(tmp_path):
+    path = _write_ledger(tmp_path, {})
+    qa.compare_quant_budgets(dict(_M), budgets_path=path, update=True)
+    drifted = {k: dict(v) for k, v in _M.items()}
+    drifted["serve_forward_q8/quantize.0"]["scale"] = 0.25
+    drifted["serve_forward_q8/quantize.0"]["count"] = 9
+    fs, _ = qa.compare_quant_budgets(drifted, budgets_path=path)
+    hits = [f for f in fs if f.rule == "stale-calibration"]
+    assert hits and hits[0].line > 0       # anchored at the ledger row
+    assert any("scale" in d for d in hits[0].data["drift"])
+    assert any("count" in d for d in hits[0].data["drift"])
+
+
+def test_quant_ledger_impossible_row_trips_range_overflow(tmp_path):
+    """A checked-in row whose recorded range exceeds its own dtype's
+    span sanctions an overflowing cast — the certifier rejects the
+    LEDGER, not just the graph."""
+    bad = {k: dict(v) for k, v in _M.items()}
+    bad["serve_forward_q8/quantize.0"]["hi"] = 300.0
+    path = _write_ledger(tmp_path, {"quant": bad})
+    fs, _ = qa.compare_quant_budgets(dict(_M), budgets_path=path)
+    assert any(f.rule == "range-overflow" for f in fs), [
+        f.render() for f in fs]
+
+
+def test_quant_ledger_full_update_prunes_orphans(tmp_path):
+    """Full-run --update-budgets drops rows whose entry left the
+    registry (noted), and a PARTIAL update merges: unrelated sections
+    and unmeasured quant rows survive byte-identical."""
+    other = {"entries": {"train_step": {"flops": 1.0}},
+             "quant": {"ghost_entry/quantize.0": dict(
+                 _M["serve_forward_q8/quantize.0"])}}
+    path = _write_ledger(tmp_path, dict(other))
+    # partial (non-full) update: the ghost row is NOT pruned
+    fs, rep = qa.compare_quant_budgets(dict(_M), budgets_path=path,
+                                       update=True, full_run=False)
+    after = json.load(open(path))
+    assert after["entries"] == other["entries"]
+    assert "ghost_entry/quantize.0" in after["quant"]
+    assert "serve_forward_q8/quantize.0" in after["quant"]
+    # full-run update: the ghost row prunes, with a note naming it
+    fs, rep = qa.compare_quant_budgets(dict(_M), budgets_path=path,
+                                       update=True, full_run=True)
+    notes = [f for f in fs if f.rule == "budget-pruned"]
+    assert notes and "ghost_entry" in notes[0].message
+    assert notes[0].severity == "note"
+    after = json.load(open(path))
+    assert "ghost_entry/quantize.0" not in after["quant"]
+    assert after["entries"] == other["entries"]
+    assert rep["budgets_written"]["pruned"] == ["ghost_entry/quantize.0"]
+
+
+def test_quant_ledger_orphan_row_trips_in_compare_mode(tmp_path):
+    path = _write_ledger(tmp_path, {"quant": {
+        "ghost_entry/quantize.0": dict(
+            _M["serve_forward_q8/quantize.0"])}})
+    fs, _ = qa.compare_quant_budgets(dict(_M), budgets_path=path)
+    hits = [f for f in fs if f.rule == "stale-calibration"
+            and "ghost_entry" in f.message]
+    assert hits, [f.render() for f in fs]
+
+
+def test_quant_unledgered_site_trips_budget_missing(tmp_path):
+    path = _write_ledger(tmp_path, {})
+    fs, _ = qa.compare_quant_budgets(dict(_M), budgets_path=path)
+    assert any(f.rule == "budget-missing" for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# the int8 path itself: QTensor round-trip, parity, EPE budget, tripwire
+# ---------------------------------------------------------------------------
+
+def test_qtensor_roundtrip_error_bounded(model_and_vars):
+    """quantize -> dequantize reconstructs every quantized kernel to
+    within half a code step (scale/2), quantizes ONLY the declared
+    scopes' kernels, and leaves everything else bit-identical."""
+    from raft_tpu.serve.quant import (QTensor, dequantize_variables,
+                                      quantize_variables)
+
+    _, variables = model_and_vars
+    qv = quantize_variables(variables)
+    qleaves = [x for x in jax.tree.leaves(
+        qv, is_leaf=lambda x: isinstance(x, QTensor))
+        if isinstance(x, QTensor)]
+    assert qleaves, "no kernel quantized — the scope match went dead"
+    for qt in qleaves:
+        assert qt.q.dtype == jnp.int8
+        assert int(jnp.max(jnp.abs(qt.q.astype(jnp.int32)))) <= 127
+    deq = dequantize_variables(qv)
+    flat_orig = jax.tree_util.tree_leaves_with_path(variables)
+    flat_deq = dict(jax.tree_util.tree_leaves_with_path(deq))
+    checked = 0
+    for path, leaf in flat_orig:
+        got = flat_deq[path]
+        from raft_tpu.serve.quant import _is_quant_path
+        if _is_quant_path(path):
+            scale = max(float(np.abs(np.asarray(leaf)).max()) / 127.0,
+                        1e-8)
+            err = float(np.abs(np.asarray(got) - np.asarray(leaf)).max())
+            assert err <= 0.5 * scale + 1e-7, path
+            checked += 1
+        else:
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(leaf))
+    assert checked == len(qleaves)
+
+
+def test_q8_config_composition_is_validated():
+    from raft_tpu.config import RAFTConfig
+
+    with pytest.raises(ValueError, match="quantized_serve"):
+        RAFTConfig(quantized_serve=True, alternate_corr=True)
+    with pytest.raises(ValueError, match="q8_clip"):
+        RAFTConfig(q8_clip=0.0)
+
+
+def test_q8_batched_matches_solo_forward(model_and_vars):
+    """Batched-padded vs solo parity on the INT8 path: the weight codes
+    and the static clip/127 fmap scale are batch-independent, so the
+    batcher adds nothing beyond the known cross-batch-size lowering
+    noise (the same atol floor the bf16 parity gate carries)."""
+    from raft_tpu.models import RAFT
+    from raft_tpu.serve.batcher import assemble_batch
+    from raft_tpu.serve.engine import serve_config
+    from raft_tpu.serve.quant import QuantServeEngine
+
+    _, variables = model_and_vars
+    model = RAFT(serve_config(small=True, overrides={
+        "compute_dtype": "float32", "corr_dtype": "float32"}))
+    batched = QuantServeEngine(model, variables, batch_size=B)
+    solo = QuantServeEngine(model, variables, batch_size=1)
+    rng = np.random.default_rng(11)
+    h, w = HW[0] - 6, HW[1] - 3            # exercise the padding
+    img1 = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    img2 = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    from raft_tpu.serve.batcher import Request
+    req = Request(rid=1, image1=img1, image2=img2, family="t",
+                  hw=(h, w), t_submit=0.0, deadline=None)
+    b1, b2, _, _ = assemble_batch([req], HW, B)
+    _, up_batched = batched.forward(HW, 2, b1, b2)
+    s1, s2, _, _ = assemble_batch([req], HW, 1)
+    _, up_solo = solo.forward(HW, 2, s1, s2)
+    assert batched.fallbacks == 0 and solo.fallbacks == 0
+    np.testing.assert_allclose(up_batched[0, :h, :w], up_solo[0, :h, :w],
+                               rtol=1e-6, atol=3e-3,
+                               err_msg="q8 batched vs solo parity broke")
+
+
+def test_q8_epe_budget_vs_bf16(model_and_vars):
+    """ACCEPTANCE: the quantization's quality price stays inside the
+    pinned budget.  Converged-regime emulation (the 12-vs-32 harness's
+    trick: flow head scaled toward zero so iterates refine around a
+    fixed point); the q8 twin's EPE must agree with the bf16 twin's
+    within 5% relative at BOTH serving iteration levels."""
+    from raft_tpu.data.datasets import SyntheticShift
+    from raft_tpu.serve.batcher import Request, assemble_batch
+    from raft_tpu.serve.quant import QuantServeEngine
+
+    model, variables = model_and_vars
+    converged = jax.tree.map(lambda x: x, variables)   # shallow copy
+    fh = converged["params"]["refine"]["update_block"]["flow_head"]
+    fh["conv2"] = {"kernel": fh["conv2"]["kernel"] * 1e-3,
+                   "bias": fh["conv2"]["bias"] * 1e-3}
+    eng = QuantServeEngine(model, converged, batch_size=1)
+    ds = SyntheticShift((HW[0] - 8, HW[1] - 8), length=2, seed=5)
+
+    def epe_at(iters, forward):
+        errs = []
+        for i in range(len(ds)):
+            s = ds[i]
+            img1 = s["image1"].astype(np.float32)
+            req = Request(rid=i, image1=img1,
+                          image2=s["image2"].astype(np.float32),
+                          family="t", hw=img1.shape[:2], t_submit=0.0,
+                          deadline=None)
+            b1, b2, _, _ = assemble_batch([req], HW, 1)
+            _, up = forward(HW, iters, b1, b2)
+            h, w = s["flow"].shape[:2]
+            err = np.sqrt(((up[0, :h, :w] - s["flow"]) ** 2).sum(-1))
+            errs.append(err[s["valid"] > 0.5])
+        return float(np.concatenate(errs).mean())
+
+    for iters in (12, 32):
+        e_q8 = epe_at(iters, eng.forward)
+        e_bf16 = epe_at(iters, eng.fallback.forward)
+        assert abs(e_q8 - e_bf16) <= 0.05 * max(e_bf16, 1e-6), \
+            f"{iters}-iter q8 EPE {e_q8:.4f} vs bf16 {e_bf16:.4f}: " \
+            f"quantization costs more than the 5% budget"
+    assert eng.fallbacks == 0, "in-range inputs must never trip"
+
+
+def test_q8_tripwire_falls_back_typed_and_still_serves(q8_engine):
+    """The fallback contract: pixels past IMG_PREMISE_MAX void the
+    range proof -> the engine emits a typed ``serve-quant-fallback``
+    incident and re-serves the SAME batch on the bf16 twin — degraded
+    typed, never silently-wrong flow, never a drop."""
+    incidents = []
+    q8_engine.on_incident = lambda kind, detail: incidents.append(kind)
+    rng = np.random.default_rng(3)
+    ok1 = rng.uniform(0, 255, (B, *HW, 3)).astype(np.float32)
+    ok2 = rng.uniform(0, 255, (B, *HW, 3)).astype(np.float32)
+    before = q8_engine.fallbacks
+    _, up = q8_engine.forward(HW, 2, ok1, ok2)
+    assert q8_engine.fallbacks == before          # in-range: no trip
+    assert incidents == []
+    _, up = q8_engine.forward(HW, 2, ok1 * 1e5, ok2 * 1e5)
+    assert q8_engine.fallbacks == before + 1
+    assert incidents == ["serve-quant-fallback"]
+    assert up.shape == (B, *HW, 2)
+    assert np.isfinite(np.asarray(up)).all()
+    q8_engine.on_incident = None

@@ -1,9 +1,10 @@
 """graftlint: one minimal failing fixture per lint rule, per jaxpr
 invariant, per HLO-audit rule, per numerics-audit rule and per
-registry-audit rule, plus the repo-wide clean-run gates (all five
-engines must pass over the tree as committed — this is the tier-1
-lint lane).  Engines 2-5 enumerate their entries from
-raft_tpu/entrypoints.py; the registry tests pin that derivation.
+registry-audit rule, plus the repo-wide clean-run gates (the engines
+must pass over the tree as committed — this is the tier-1 lint lane;
+engine 7's fixtures and gate live in tests/test_quant.py).  Engines
+2-5 and 7 enumerate their entries from raft_tpu/entrypoints.py; the
+registry tests pin that derivation.
 
 Everything here is CPU-only and fast-lane (no ``slow`` marker): the AST
 fixtures are string literals, the jaxpr/numerics fixtures are tiny
@@ -1266,11 +1267,11 @@ def _load_graftlint_script():
     return mod
 
 
-def test_graftlint_wrapper_fans_out_six_engines():
-    """The CI wrapper must run all six engines in parallel — the
+def test_graftlint_wrapper_fans_out_seven_engines():
+    """The CI wrapper must run all seven engines in parallel — the
     per-engine timing line is its contract with the tier-1 budget."""
     mod = _load_graftlint_script()
-    assert mod.ENGINES == ("lint", "jaxpr", "hlo", "numerics",
+    assert mod.ENGINES == ("lint", "jaxpr", "hlo", "numerics", "quant",
                            "registry", "concurrency")
     # the per-engine timeout exists and is generous vs the slowest
     # engine (hlo ~100 s) — tripping it means wedged, not slow
@@ -1299,19 +1300,23 @@ from raft_tpu.analysis import registry_audit as ra        # noqa: E402
 
 
 def test_engines_enumerate_from_registry():
-    """No hand-maintained entry lists remain in analysis/: all four
+    """No hand-maintained entry lists remain in analysis/: all the
     engines' tables derive from raft_tpu/entrypoints.py."""
+    from raft_tpu.analysis import quant_audit as qa
+
     assert list(ja.ENTRY_AUDITS) == ep.jaxpr_audit_names()
     assert list(ha.ENTRIES) == list(ep.hlo_entries())
     assert list(na.ENTRIES) == list(ep.numerics_entries())
+    assert list(qa.ENTRIES) == list(ep.quant_entries())
     # structural facts ride the registry into the engines
     assert ha.ENTRIES["corr_ring"].require == ("collective-permute",)
     assert ha.ENTRIES["train_step"].donated
     assert na.ENTRIES["corr_lookup_pallas"].pallas
     assert na.ENTRIES["train_step"].rules == na.DEEP_RULES
+    assert qa.ENTRIES["serve_forward_q8"].rules == qa.ALL_QUANT_RULES
     # every entry is audited by at least one engine
     for e in ep.ENTRYPOINTS.values():
-        assert e.jaxpr or e.hlo or e.numerics, e.name
+        assert e.jaxpr or e.hlo or e.numerics or e.quant, e.name
 
 
 def test_cache_key_recipe_single_definition():
@@ -1445,8 +1450,9 @@ def test_prune_budgets_dry_run_and_update_prune(orphaned_ledger, capsys):
     assert rc == 0
     assert "renamed_old_entry" in out and "ghost/_ghost_kernel" in out
     assert open(orphaned_ledger).read() == before
-    # the clean checked-in ledger previews zero prunes
-    assert ra.orphan_rows() == {"entries": [], "pallas_vmem": []}
+    # the clean checked-in ledger previews zero prunes in every section
+    assert all(v == [] for v in ra.orphan_rows().values())
+    assert set(ra.orphan_rows()) >= {"entries", "pallas_vmem", "quant"}
     # save_budgets prune semantics (the full --update-budgets path):
     # the orphan row is dropped, sanctioned rows survive
     bmod.save_budgets(orphaned_ledger, None,
@@ -1854,8 +1860,8 @@ def test_graftlint_json_merged_engine_summary(tmp_path, capsys):
     (status/findings/unwaived/seconds per engine) built by hand-merging
     each child's "engines" row — report.update alone would keep only
     the last child's.  Exercised with the two jax-free engines so the
-    real subprocess fan-out stays cheap; the six-tuple itself is
-    pinned by test_graftlint_wrapper_fans_out_six_engines."""
+    real subprocess fan-out stays cheap; the seven-tuple itself is
+    pinned by test_graftlint_wrapper_fans_out_seven_engines."""
     mod = _load_graftlint_script()
     mod.ENGINES = ("lint", "concurrency")
     rc = mod.parallel_gate(json_out=True, verbose=False)
