@@ -61,9 +61,28 @@ def _slo_gate(report, fail_on_slo: bool) -> int:
     return 0
 
 
+def run_trace(per_source, tid: str, as_json: bool) -> int:
+    """Render one request's end-to-end timeline: every ``trace``
+    record carrying ``tid`` across the given ledgers (a fleet request
+    contributes the front door's record plus one per replica crossed —
+    joined on the shared trace id through any reroute).  Exit 0 when
+    found, 1 when the id matches nothing."""
+    from raft_tpu.obs.events import sanitize_json
+    from raft_tpu.obs.report import find_trace, render_trace_timeline
+
+    found = find_trace(per_source, tid)
+    if as_json:
+        print(json.dumps(sanitize_json({"tid": tid, "records": found}),
+                         indent=2, default=str, allow_nan=False))
+    else:
+        print(render_trace_timeline(tid, found))
+    return 0 if found else 1
+
+
 def run_report(path: str, as_json: bool,
                fail_on_incident: Optional[str],
-               fail_on_slo: bool = False) -> int:
+               fail_on_slo: bool = False,
+               trace: Optional[str] = None) -> int:
     from raft_tpu.obs.events import read_ledger, sanitize_json
     from raft_tpu.obs.report import build_report, render_report
 
@@ -75,6 +94,8 @@ def run_report(path: str, as_json: bool,
     if not records:
         print(f"obs report: {path} holds no records", file=sys.stderr)
         return 2
+    if trace is not None:
+        return run_trace({"run": records}, trace, as_json)
     report = build_report(records)
     if as_json:
         # sanitize: _percentiles legitimately produce NaN on empty
@@ -89,7 +110,8 @@ def run_report(path: str, as_json: bool,
 
 def run_merged_report(path: str, as_json: bool,
                       fail_on_incident: Optional[str],
-                      fail_on_slo: bool = False) -> int:
+                      fail_on_slo: bool = False,
+                      trace: Optional[str] = None) -> int:
     """Pod report: merge the per-process suffixed ledgers
     (``<name>.jsonl.p<N>``) a multihost run writes into one view with
     per-process incident attribution; the severity gate spans ALL
@@ -119,6 +141,11 @@ def run_merged_report(path: str, as_json: bool,
             print(f"obs report --merge: cannot read {lpath}: {e}",
                   file=sys.stderr)
             return 2
+    if trace is not None:
+        from raft_tpu.obs.report import _plabel
+        return run_trace({_plabel(pid): recs
+                          for pid, recs in per_process.items()},
+                         trace, as_json)
     report = build_pod_report(per_process)
     if as_json:
         print(json.dumps(sanitize_json(report), indent=2, default=str,
@@ -258,6 +285,15 @@ def main(argv=None) -> int:
                          "--fail-on-slo gate across ALL processes")
     rp.add_argument("--json", action="store_true",
                     help="machine-readable report")
+    rp.add_argument("--trace", default=None, metavar="TID",
+                    help="render ONE request's end-to-end timeline by "
+                         "trace id (the serving summary's percentile "
+                         "exemplars name these) instead of the "
+                         "aggregate report; with --merge the timeline "
+                         "joins the front door's record and every "
+                         "replica the request crossed on the shared id "
+                         "— a rescued request shows both replicas.  "
+                         "Exit 1 when the id matches no record")
     rp.add_argument("--fail-on-incident", nargs="?", const="any",
                     default=None, choices=["any", "fatal"],
                     help="exit 1 when the ledger holds health incidents: "
@@ -281,9 +317,9 @@ def main(argv=None) -> int:
         if args.merge:
             return run_merged_report(args.ledger, args.json,
                                      args.fail_on_incident,
-                                     args.fail_on_slo)
+                                     args.fail_on_slo, args.trace)
         return run_report(args.ledger, args.json, args.fail_on_incident,
-                          args.fail_on_slo)
+                          args.fail_on_slo, args.trace)
     p.print_help()
     return 2
 

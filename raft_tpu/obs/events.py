@@ -25,6 +25,11 @@ kind            payload
                 peak_bytes_in_use, bytes_limit}}, ``host_rss_bytes``
 ``incident``    ``incident`` (the incident type), ``step``, ``detail``,
                 ``severity`` — health sentinel / resilience firings
+``trace``       ``tid``, ``rid``, ``outcome``, ``latency_ms``, ``phases``
+                {name: ms, summing to latency}, ``events``, ``hops``,
+                ``forced`` — one per retained serving request
+                (obs/trace.py; head-sampled, force-retained on
+                rejection / SLO violation / incident / exemplar)
 ``run_end``     ``summary`` — final counters (steps, incidents, ...)
 ==============  ===========================================================
 
@@ -205,7 +210,7 @@ from typing import Dict, List, Optional
 SCHEMA_VERSION = 1
 
 RECORD_KINDS = ("run_start", "metrics", "spans", "memory", "incident",
-                "run_end")
+                "trace", "run_end")
 
 # Default severity per incident type (see the taxonomy table above).
 # Writers may override per record (e.g. nonfinite-loss demotes to

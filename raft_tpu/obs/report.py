@@ -54,6 +54,7 @@ def build_report(records: List[Dict]) -> Dict:
     span_windows = []
     memory_records = []
     incidents = []
+    traces = []
     summary: Optional[Dict] = None
     for rec in records:
         kind = rec.get("kind")
@@ -67,6 +68,8 @@ def build_report(records: List[Dict]) -> Dict:
             memory_records.append(rec)
         elif kind == "incident":
             incidents.append(rec)
+        elif kind == "trace":
+            traces.append(rec)
         elif kind == "run_end":
             summary = rec.get("summary")
 
@@ -204,6 +207,7 @@ def build_report(records: List[Dict]) -> Dict:
     return {
         "meta": meta,
         "serving": serving,
+        "tracing": build_trace_section(traces),
         "runs": n_runs,
         "steps": steps,
         "windows": len(metrics_windows),
@@ -221,6 +225,149 @@ def build_report(records: List[Dict]) -> Dict:
         "last_window_means": last_means,
         "run_end_summary": summary,
     }
+
+
+def build_trace_section(traces: List[Dict]) -> Optional[Dict]:
+    """Tail-latency attribution from per-request ``trace`` records
+    (obs/trace.py) — the request-path twin of the training report's
+    ``stall_attribution_pct``.
+
+    ``None`` when the ledger carries no traces (a pre-trace ledger or
+    a tracing-off run reports exactly as before).  Otherwise:
+
+    - ``attribution_pct``: each phase's share of the served requests'
+      total latency, including the explicit ``other`` residue — the
+      shares sum to 100 by construction because every trace's phases
+      (plus its ``other``) sum to its measured latency.
+    - ``phase_ms``: per-phase p50/p95 milliseconds across served
+      traces (absent phases count as 0 — a phase a request never
+      crossed cost it nothing) and the p95−p50 delta.
+    - ``tail_driver``: the phase with the largest p95−p50 delta — the
+      single place the tail diverges from the median.
+    - ``hops``: placement/stream-move/rescue hop counts (fleet front
+      door traces), so a reroute storm is visible in aggregate.
+    - ``forced``: why non-sampled traces were retained (rejections,
+      SLO violators, incident flight-recorder windows, exemplars).
+    """
+    if not traces:
+        return None
+    served = [t for t in traces
+              if t.get("outcome") == "served"
+              and isinstance(t.get("latency_ms"), (int, float))
+              and isinstance(t.get("phases"), dict)]
+    forced: Dict[str, int] = {}
+    outcomes: Dict[str, int] = {}
+    hops = {"placements": 0, "stream_moves": 0, "rescues": 0}
+    for t in traces:
+        outcomes[t.get("outcome") or "unknown"] = \
+            outcomes.get(t.get("outcome") or "unknown", 0) + 1
+        for f in t.get("forced") or []:
+            key = f.split(":", 1)[0]
+            forced[key] = forced.get(key, 0) + 1
+        for h in t.get("hops") or []:
+            reason = h.get("reason")
+            if reason == "rescue":
+                hops["rescues"] += 1
+            elif reason == "stream-move":
+                hops["stream_moves"] += 1
+            else:
+                hops["placements"] += 1
+    out: Dict = {
+        "traces": len(traces),
+        "outcomes": outcomes,
+        "forced": forced,
+        "hops": hops,
+    }
+    if not served:
+        return out
+    total_ms = sum(t["latency_ms"] for t in served)
+    phase_names = sorted({p for t in served for p in t["phases"]})
+    attribution: Dict[str, float] = {}
+    phase_ms: Dict[str, Dict[str, float]] = {}
+    for name in phase_names:
+        # absent phase == 0 ms: a request that never crossed the phase
+        # spent nothing there, and dropping it would inflate the p50
+        vals = [float(t["phases"].get(name, 0.0)) for t in served]
+        attribution[name] = (100.0 * sum(vals) / total_ms
+                             if total_ms > 0 else 0.0)
+        # graftlint: disable=f64-literal -- host-side report math
+        arr = np.asarray(vals, dtype=np.float64)
+        p50 = float(np.percentile(arr, 50))
+        p95 = float(np.percentile(arr, 95))
+        phase_ms[name] = {"p50": round(p50, 3), "p95": round(p95, 3),
+                          "delta_p95_p50": round(p95 - p50, 3)}
+    tail_driver = max(phase_ms,
+                      key=lambda n: phase_ms[n]["delta_p95_p50"])
+    out.update({
+        "served_traced": len(served),
+        "attribution_pct": {k: round(v, 2)
+                            for k, v in attribution.items()},
+        "phase_ms": phase_ms,
+        "tail_driver": tail_driver,
+    })
+    return out
+
+
+def find_trace(per_source_records: Dict[str, List[Dict]],
+               tid: str) -> List[Dict]:
+    """All ``trace`` records carrying ``tid``, across sources.
+
+    ``per_source_records`` maps a source label ("run" for a single
+    ledger; "front"/"p0"/... for a merged fleet) to its parsed
+    records.  A fleet request contributes one record per ledger it
+    crossed — the front door's (hops, place/replica-wait phases) plus
+    one per replica that served or rejected it (a rescued request has
+    two replica-side records under the SAME tid: the join the flight
+    recorder needs).  Rows come back tagged with ``source``."""
+    found: List[Dict] = []
+    for source, records in per_source_records.items():
+        for rec in records:
+            if rec.get("kind") == "trace" and rec.get("tid") == tid:
+                found.append(dict(rec, source=source))
+    return found
+
+
+def render_trace_timeline(tid: str, found: List[Dict]) -> str:
+    """One request's end-to-end story: per ledger crossed, its phases
+    in charge order, hops and events — the ``--trace <id>`` view."""
+    lines: List[str] = []
+    if not found:
+        return (f"trace {tid}: not found (head-sampled out, or the id "
+                f"is from another ledger — rejections, SLO violators "
+                f"and incident windows are always retained)")
+    lines.append(f"== trace {tid}: {len(found)} record(s) ==")
+    # front-door record first (it owns placement), then replicas by t
+    found = sorted(found, key=lambda r: (r.get("source") != "front",
+                                         r.get("t") or 0.0))
+    for rec in found:
+        src = rec.get("source", "run")
+        lat = rec.get("latency_ms")
+        lat_s = (f"{lat:.1f} ms" if isinstance(lat, (int, float))
+                 else "n/a")
+        lines.append(
+            f"  [{src}] rid={rec.get('rid')} "
+            f"workload={rec.get('workload')} "
+            + (f"stream={rec['stream']} " if rec.get("stream") else "")
+            + f"outcome={rec.get('outcome')} latency={lat_s}")
+        phases = rec.get("phases") or {}
+        for name, ms in sorted(phases.items(), key=lambda kv: -kv[1]):
+            pct = (100.0 * ms / lat
+                   if isinstance(lat, (int, float)) and lat > 0 else 0.0)
+            lines.append(f"    {name:<14} {ms:9.3f} ms  {pct:5.1f} %")
+        for h in rec.get("hops") or []:
+            frm = (f" from {h['moved_from']}" if h.get("moved_from")
+                   else "")
+            why = f" ({h['reason']})" if h.get("reason") else ""
+            lines.append(f"    hop -> {h.get('replica')}{frm}{why}")
+        for ev in rec.get("events") or []:
+            extra = "  ".join(f"{k}={v}" for k, v in ev.items()
+                              if k not in ("name", "t_ms"))
+            lines.append(f"    @{ev.get('t_ms', 0):9.3f} ms  "
+                         f"{ev.get('name')}"
+                         + (f"  {extra}" if extra else ""))
+        if rec.get("forced"):
+            lines.append(f"    retained: {', '.join(rec['forced'])}")
+    return "\n".join(lines)
 
 
 def find_process_ledgers(path: str) -> Dict[int, str]:
@@ -469,6 +616,19 @@ def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
                 and isinstance(rec["summary"].get("serving"), dict)]
         if runs:
             per_serving[pid] = runs
+    # fleet tracing: the front door's traces carry placement/reroute
+    # phases, the replicas' carry the serve-path phases — pooling them
+    # into ONE attribution would mix two different latency measures of
+    # the same requests, so each side gets its own section
+    front_traces: List[Dict] = []
+    replica_traces: List[Dict] = []
+    for pid, recs in sorted(per_process_records.items()):
+        rows = [r for r in recs if r.get("kind") == "trace"]
+        (front_traces if pid < 0 else replica_traces).extend(rows)
+    tracing = None
+    if front_traces or replica_traces:
+        tracing = {"front": build_trace_section(front_traces),
+                   "replicas": build_trace_section(replica_traces)}
     return {
         "processes": processes,
         "process_count": len(processes),
@@ -476,6 +636,7 @@ def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
         "incidents": incidents,
         "serving": (merge_serving_sections(per_serving)
                     if per_serving else None),
+        "tracing": tracing,
         "resilience": {
             "faults_injected": faults,
             "incidents_by_severity": by_severity,
@@ -565,6 +726,16 @@ def render_pod_report(report: Dict) -> str:
                 f"  sdc canary (summed): {can.get('probes', 0)} "
                 f"probe(s)  {can.get('mismatches', 0)} mismatch(es)  "
                 f"{can.get('recompiles', 0)} recompile(s)")
+    tracing = report.get("tracing")
+    if tracing:
+        lines.append("")
+        lines.append("fleet request tracing:")
+        if tracing.get("front"):
+            lines.append("  front door (placement/reroute phases):")
+            lines.extend(_trace_lines(tracing["front"], indent="    "))
+        if tracing.get("replicas"):
+            lines.append("  replicas (serve-path phases, pooled):")
+            lines.extend(_trace_lines(tracing["replicas"], indent="    "))
     res = report["resilience"]
     lines.append("")
     lines.append("pod resilience:")
@@ -603,6 +774,45 @@ def _sdc_line(sdc: Dict) -> str:
     if quar:
         line += f"   quarantined: {', '.join(sorted(set(quar)))}"
     return line
+
+
+def _trace_lines(section: Dict, indent: str = "  ") -> List[str]:
+    """Render one tracing section (build_trace_section output)."""
+    lines: List[str] = []
+    out = "  ".join(f"{k}={v}" for k, v in
+                    sorted((section.get("outcomes") or {}).items()))
+    lines.append(f"{indent}{section.get('traces', 0)} trace(s) recorded"
+                 + (f"  ({out})" if out else ""))
+    forced = section.get("forced") or {}
+    if forced:
+        lines.append(f"{indent}retained beyond sampling: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(forced.items())))
+    hops = section.get("hops") or {}
+    if any(hops.values()):
+        lines.append(
+            f"{indent}hops: {hops.get('placements', 0)} placement(s)  "
+            f"{hops.get('stream_moves', 0)} stream move(s)  "
+            f"{hops.get('rescues', 0)} rescue(s)")
+    attr = section.get("attribution_pct")
+    if attr:
+        lines.append(f"{indent}tail attribution (% of served latency, "
+                     f"{section.get('served_traced', 0)} traced; "
+                     f"p95−p50 per phase):")
+        phase_ms = section.get("phase_ms") or {}
+        total = 0.0
+        for name, pct in sorted(attr.items(), key=lambda kv: -kv[1]):
+            pm = phase_ms.get(name, {})
+            lines.append(
+                f"{indent}  {name:<14} {pct:6.2f} %   "
+                f"p50 {pm.get('p50', 0.0):9.3f} ms   "
+                f"p95 {pm.get('p95', 0.0):9.3f} ms   "
+                f"Δ {pm.get('delta_p95_p50', 0.0):9.3f} ms")
+            total += pct
+        lines.append(f"{indent}  {'total':<14} {total:6.2f} %")
+        if section.get("tail_driver"):
+            lines.append(f"{indent}tail driver: {section['tail_driver']} "
+                         f"(largest p95−p50 phase delta)")
+    return lines
 
 
 def _fmt_bytes(n: int) -> str:
@@ -779,6 +989,17 @@ def render_report(report: Dict) -> str:
                 f"{canary.get('families', 0)} golden pair(s)  "
                 f"{canary.get('mismatches', 0)} mismatch(es)  "
                 f"{canary.get('recompiles', 0)} recompile-and-recheck(s)")
+
+    tracing = report.get("tracing")
+    if tracing:
+        lines.append("")
+        lines.append("request tracing:")
+        lines.extend(_trace_lines(tracing))
+        exemplars = ((serving or {}).get("trace") or {}).get("exemplars")
+        if exemplars:
+            lines.append("  percentile exemplars: " + "  ".join(
+                f"{name}={row.get('tid')}"
+                for name, row in sorted(exemplars.items())))
 
     means = report["last_window_means"]
     if means:

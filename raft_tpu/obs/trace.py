@@ -1,0 +1,315 @@
+"""Per-request tracing, tail-latency attribution, and the incident
+flight recorder for the serving path.
+
+The serving stack's aggregate telemetry (pooled p95 sketches,
+per-family served counts) can say *that* the tail moved but not *why*:
+RAFT's iterative refinement makes per-request cost intrinsically
+variable — the 32→8 degradation ladder, continuous batching at GRU
+iteration boundaries, warm-state adoption, tiled 4K fan-out and the
+q8→bf16 fallback twin all change where one request spends its time.
+This module records that evidence per request:
+
+- **Trace context** (:class:`Trace`): a trace id plus monotonic phase
+  watermarks.  The owning server stamps phase boundaries as the
+  request crosses them (``queue-wait`` → ``assembly`` → ``compile`` →
+  ``dispatch`` → …); a stamp charges the time since the previous
+  boundary to the named phase, so the phases partition the request's
+  measured latency by construction.  At terminal the residue goes to
+  an explicit ``other`` bucket — the same 100 %-attribution contract
+  the training report enforces for ``stall_attribution_pct``.  Hops
+  (``hop``) record fleet placement and rescue re-placement; events
+  (``event``) annotate non-attributable interleavings (q8 fallback,
+  canary probes, continuous-batching segments).
+- **Head sampling with forced retention** (:class:`Tracer`): every
+  request gets a context (a few ``monotonic()`` calls — the ≤ 2 %
+  overhead budget), but only 1-in-``sample`` are *recorded* by
+  default.  A trace is force-retained past the sampling decision when
+  it matters: typed rejections, SLO-violating latency, requests alive
+  when an incident fires, and the percentile exemplars the serving
+  summary names (so ``p50``/``p95``/``max`` each point at a concrete
+  trace id).
+- **Flight recorder**: a bounded in-memory ring of the most recent
+  *complete* traces.  When an incident fires the ring is flushed to
+  the ledger and every in-flight trace is force-retained — the
+  post-mortem gets exactly the window around the incident without
+  paying for always-on full tracing.  The ring is flushed once more at
+  close so the final window survives.
+
+Traces are written as a ``"trace"`` record kind on the SAME versioned
+ledger as everything else (``events.py`` schema v1; readers pass
+unknown kinds through, so pre-trace ledgers and old readers keep
+working).  Ledger writes are guarded (``OSError``/``ValueError``
+degrade the record, never the batcher thread) because ``finish`` runs
+on batcher/callback threads — the engine-6 thread-I/O contract.
+
+Tracing OFF is represented by the absence of a tracer (``None`` at the
+server), not a disabled object: the off path allocates nothing and
+stamps nothing per request.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+# The ledger record kind carrying one complete per-request trace.
+TRACE_KIND = "trace"
+
+# Head-sampling default: record 1-in-N traces when nothing forces
+# retention.  Bounded by the bench lane's trace_overhead_pct <= 2 gate.
+DEFAULT_SAMPLE = 16
+
+# Flight-recorder ring: how many recent complete traces survive in
+# memory for an incident flush.
+RING_SIZE = 64
+
+# Exemplar pool: completed traces kept addressable by id so the
+# serving summary can name a concrete trace per percentile bucket.
+RECENT_SIZE = 512
+
+
+def new_trace_id() -> str:
+    """A short, collision-safe trace id (12 hex chars)."""
+    return uuid.uuid4().hex[:12]
+
+
+class Trace:
+    """One request's phase watermarks, hops and events.
+
+    Ownership is sequential (submit thread → queue → batcher thread,
+    or fleet front door → replica callback under the fleet lock), so
+    the context itself is unlocked; the :class:`Tracer` guards its own
+    shared structures.
+    """
+
+    __slots__ = ("tid", "rid", "stream", "workload", "family",
+                 "sampled", "t0", "t_last", "phases", "events", "hops",
+                 "forced", "outcome", "latency_ms", "written", "_clock")
+
+    def __init__(self, tid: str, rid, stream: Optional[str],
+                 workload: str, family: Optional[str], sampled: bool,
+                 clock: Callable[[], float]):
+        self.tid = tid
+        self.rid = rid
+        self.stream = stream
+        self.workload = workload
+        self.family = family
+        self.sampled = sampled
+        self._clock = clock
+        self.t0 = clock()
+        self.t_last = self.t0
+        self.phases: Dict[str, float] = {}
+        self.events: List[List] = []
+        self.hops: List[Dict] = []
+        self.forced: List[str] = []
+        self.outcome: Optional[str] = None
+        self.latency_ms: Optional[float] = None
+        self.written = False
+
+    # .. phase watermarks ...................................................
+
+    def stamp(self, phase: str) -> float:
+        """Charge the time since the previous boundary to ``phase``
+        and advance the watermark.  Returns the charged milliseconds."""
+        now = self._clock()
+        ms = (now - self.t_last) * 1e3
+        self.t_last = now
+        self.phases[phase] = self.phases.get(phase, 0.0) + ms
+        return ms
+
+    def add_ms(self, phase: str, ms: float) -> None:
+        """Charge externally-measured milliseconds to ``phase``
+        WITHOUT moving the watermark (overlapping spans, e.g. a blend
+        measured on its own thread)."""
+        self.phases[phase] = self.phases.get(phase, 0.0) + ms
+
+    def skip(self) -> None:
+        """Advance the watermark without charging anyone (time that a
+        later ``add_ms`` accounts for, or that belongs to ``other``)."""
+        self.t_last = self._clock()
+
+    # .. annotations ........................................................
+
+    def event(self, name: str, **data) -> None:
+        """A point annotation at the current relative time (q8
+        fallback, canary interleave, a continuous-batching segment)."""
+        rec = {"name": name,
+               "t_ms": round((self._clock() - self.t0) * 1e3, 3)}
+        if data:
+            rec.update(data)
+        self.events.append(rec)
+
+    def hop(self, replica: str, moved_from: Optional[str] = None,
+            reason: Optional[str] = None) -> None:
+        """A placement hop (initial placement, stream move, rescue)."""
+        self.hops.append({"replica": replica, "moved_from": moved_from,
+                          "reason": reason})
+
+    def force(self, reason: str) -> None:
+        """Retain this trace past the sampling decision."""
+        if reason not in self.forced:
+            self.forced.append(reason)
+
+    # .. record .............................................................
+
+    def record(self) -> Dict:
+        """The ledger payload — the pinned ``"trace"`` record schema."""
+        return {
+            "tid": self.tid,
+            "rid": self.rid,
+            "stream": self.stream,
+            "workload": self.workload,
+            "family": self.family,
+            "outcome": self.outcome,
+            "latency_ms": self.latency_ms,
+            "phases": {k: round(v, 3) for k, v in self.phases.items()},
+            "events": list(self.events),
+            "hops": list(self.hops),
+            "forced": list(self.forced),
+            "sampled": self.sampled,
+        }
+
+
+class Tracer:
+    """The per-ledger trace recorder: sampling, forced retention, the
+    flight-recorder ring, and percentile exemplars.
+
+    One tracer per ledger (the fleet front door and each replica carry
+    their own; a request rerouted through the fleet keeps ONE trace id
+    across them, which is the merge join key)."""
+
+    def __init__(self, ledger, sample: int = DEFAULT_SAMPLE,
+                 slo_ms: Optional[float] = None, ring: int = RING_SIZE,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ledger = ledger
+        self.sample = max(0, int(sample))
+        self.slo_ms = slo_ms
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        # keyed by object identity, NOT tid: tiled fan-out opens many
+        # contexts under one shared tid (the fan-in join key)
+        self._live: Dict[int, Trace] = {}
+        self._ring: collections.deque = collections.deque(maxlen=ring)
+        self._recent: "collections.OrderedDict[int, Trace]" = \
+            collections.OrderedDict()
+        self.recorded = 0
+
+    # .. lifecycle ..........................................................
+
+    def begin(self, rid, stream: Optional[str] = None,
+              workload: str = "flow", family: Optional[str] = None,
+              tid: Optional[str] = None) -> Trace:
+        """Open a trace for one request.  ``tid`` is provided when the
+        fleet front door already minted one (the replica-side trace
+        joins on it)."""
+        with self._lock:
+            self._seq += 1
+            sampled = (self.sample > 0
+                       and self._seq % self.sample == 1 % self.sample)
+            tr = Trace(tid or new_trace_id(), rid, stream, workload,
+                       family, sampled, self.clock)
+            self._live[id(tr)] = tr
+        return tr
+
+    def finish(self, tr: Trace, outcome: str,
+               latency_ms: Optional[float] = None) -> None:
+        """Terminal: close the attribution books and decide retention.
+
+        ``outcome`` is ``"served"`` or ``"rejected:<kind>"``.  The
+        unattributed residue of the measured latency lands in the
+        ``other`` bucket, so the phases always sum to the latency the
+        latency tracker observed (the 100 %-attribution contract)."""
+        if tr.outcome is not None:
+            return  # already terminal — a racing second terminal is a no-op
+        if latency_ms is None:
+            latency_ms = (self.clock() - tr.t0) * 1e3
+        tr.outcome = outcome
+        tr.latency_ms = round(latency_ms, 3)
+        if outcome != "served":
+            tr.force("rejection")
+        if (self.slo_ms is not None and outcome == "served"
+                and latency_ms > self.slo_ms):
+            tr.force("slo")
+        other = latency_ms - sum(tr.phases.values())
+        tr.phases["other"] = max(0.0, other)
+        with self._lock:
+            self._live.pop(id(tr), None)
+            self._ring.append(tr)
+            self._recent[id(tr)] = tr
+            while len(self._recent) > RECENT_SIZE:
+                self._recent.popitem(last=False)
+        if tr.sampled or tr.forced:
+            self._write(tr)
+
+    def _write(self, tr: Trace) -> None:
+        with self._lock:
+            if tr.written:
+                return
+            tr.written = True
+            self.recorded += 1
+        try:
+            self.ledger.write(TRACE_KIND, **tr.record())
+        except (OSError, ValueError):
+            pass  # a full disk degrades the trace, never the thread
+
+    # .. flight recorder ....................................................
+
+    def on_incident(self, kind: str) -> None:
+        """An incident fired: flush the ring (the window of recent
+        complete traces) and force-retain every in-flight trace, so
+        each records at ITS terminal with the incident named."""
+        with self._lock:
+            ring = [tr for tr in self._ring if not tr.written]
+            self._ring.clear()
+            live = list(self._live.values())
+        for tr in live:
+            tr.force(f"incident:{kind}")
+        for tr in ring:
+            tr.force(f"flight-recorder:{kind}")
+            self._write(tr)
+
+    def close(self) -> None:
+        """Flush the final flight-recorder window so the last traces
+        before shutdown survive to the ledger."""
+        with self._lock:
+            ring = [tr for tr in self._ring if not tr.written]
+            self._ring.clear()
+        for tr in ring:
+            tr.force("flight-recorder:close")
+            self._write(tr)
+
+    # .. exemplars ..........................................................
+
+    def exemplars(self, targets: Dict[str, float]) -> Dict[str, Dict]:
+        """Name one concrete trace per latency-percentile bucket.
+
+        ``targets`` maps bucket name → target milliseconds (the
+        summary's measured p50/p95/max); for each, the completed
+        served trace closest in latency is force-retained and
+        returned as ``{"tid": ..., "latency_ms": ...}``."""
+        with self._lock:
+            pool = [tr for tr in self._recent.values()
+                    if tr.outcome == "served"
+                    and tr.latency_ms is not None]
+        out: Dict[str, Dict] = {}
+        for name, target in targets.items():
+            if not pool or target is None or target != target:
+                continue
+            best = min(pool, key=lambda tr: abs(tr.latency_ms - target))
+            best.force(f"exemplar:{name}")
+            self._write(best)
+            out[name] = {"tid": best.tid,
+                         "latency_ms": best.latency_ms}
+        return out
+
+    # .. summary ............................................................
+
+    def summary(self) -> Dict:
+        with self._lock:
+            return {"sample": self.sample,
+                    "recorded": self.recorded,
+                    "in_flight": len(self._live)}

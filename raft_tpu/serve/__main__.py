@@ -51,6 +51,15 @@ the dispatch watchdog declares a wedge; 2 usage.
                      ``serve-quant-fallback``), and conservation must
                      hold — quantization degrades typed, never wrong
 
+``--trace_sample N`` (needs ``--ledger``) threads a per-request trace
+context through the whole serve path — admission, queue wait, batch
+assembly, compile-vs-run, dispatch; under ``--fleet`` also the front
+door's place/reroute/replica-wait and every hop — head-sampled 1-in-N
+with forced retention of typed rejections, SLO violators and
+incident-adjacent requests (obs/trace.py; ``obs report`` renders tail
+attribution, ``--trace <tid>`` a single request's cross-ledger
+timeline).  0 disables tracing entirely.
+
 ``--quantize`` serves the flow workload on the int8 path
 (serve/quant.py QuantServeEngine): int8 weight codes + int8 corr
 contraction, certified by graftlint engine 7 against the ``quant``
@@ -280,6 +289,13 @@ def parse_args(argv=None):
                    help="AOT executable cache directory (warm restarts)")
     p.add_argument("--ledger", default=None,
                    help="obs run-ledger path (events.jsonl)")
+    p.add_argument("--trace_sample", type=int, default=16,
+                   help="per-request tracing: head-sample 1-in-N traces "
+                        "to the ledger (rejections, SLO violators, "
+                        "incident windows and percentile exemplars are "
+                        "always retained regardless).  Needs --ledger; "
+                        "0 disables tracing entirely (no per-request "
+                        "trace context is allocated)")
     p.add_argument("--watchdog_timeout", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--inject", default=None)
@@ -361,11 +377,17 @@ def fleet_main(args, inject, inject_arg) -> int:
         if make_stereo is not None:
             engines["stereo"] = make_stereo()
         rep_ledger = None
+        rep_tracer = None
         if args.ledger:
             rep_ledger = RunLedger(
                 f"{args.ledger}.p{rid[1:]}",
                 meta={"entry": "serve", "replica": rid,
                       "image_size": [H, W]})
+            if args.trace_sample > 0:
+                from raft_tpu.obs.trace import Tracer
+                rep_tracer = Tracer(rep_ledger,
+                                    sample=args.trace_sample,
+                                    slo_ms=args.slo_ms)
         return FlowServer(
             engines, buckets=buckets,
             queue_capacity=args.queue_capacity, iter_levels=levels,
@@ -374,11 +396,19 @@ def fleet_main(args, inject, inject_arg) -> int:
             watchdog_timeout_s=args.watchdog_timeout,
             spill_store=spill, continuous=args.continuous,
             segment_iters=args.segment_iters,
-            canary_every=args.canary_every)
+            canary_every=args.canary_every, tracer=rep_tracer)
 
+    tracer = None
+    if ledger is not None and args.trace_sample > 0:
+        from raft_tpu.obs.trace import Tracer
+        # the front door carries its OWN tracer on the front ledger;
+        # the replica tracers (factory above) join on the shared tid
+        tracer = Tracer(ledger, sample=args.trace_sample,
+                        slo_ms=args.slo_ms)
     fleet = FleetServer(factory, n_replicas=args.fleet,
                         spill_dir=os.path.join(workdir, "spill"),
-                        ledger=ledger, slo_ms=args.slo_ms)
+                        ledger=ledger, slo_ms=args.slo_ms,
+                        tracer=tracer)
     t0 = time.perf_counter()
     fleet.warmup()
     startup_s = time.perf_counter() - t0
@@ -608,6 +638,12 @@ def main(argv=None) -> int:
         engines["stereo"] = _stereo_engine_builder(
             init_img, args.seed, args.batch_size, aot)()
 
+    tracer = None
+    if ledger is not None and args.trace_sample > 0:
+        from raft_tpu.obs.trace import Tracer
+        tracer = Tracer(ledger, sample=args.trace_sample,
+                        slo_ms=args.slo_ms)
+
     buckets = {"session": (H, W)}
     server = FlowServer(
         engines, buckets=buckets, queue_capacity=args.queue_capacity,
@@ -615,7 +651,7 @@ def main(argv=None) -> int:
         degrade=not args.no_degrade, warm_iters=args.warm_iters,
         ledger=ledger, watchdog_timeout_s=args.watchdog_timeout,
         continuous=args.continuous, segment_iters=args.segment_iters,
-        canary_every=args.canary_every)
+        canary_every=args.canary_every, tracer=tracer)
 
     t0 = time.perf_counter()
     server.warmup(warm_too=args.video_streams > 0)

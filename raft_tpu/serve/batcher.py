@@ -83,6 +83,9 @@ class Request:
     # family) lane — a batch is one executable dispatch
     workload: str = "flow"
     future: Future = field(default_factory=Future)
+    # per-request trace context (obs/trace.py Trace) — None when the
+    # server runs with tracing off; the batcher never touches it
+    trace: Optional[object] = None
 
     @property
     def lane(self) -> Tuple[str, str]:

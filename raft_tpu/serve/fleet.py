@@ -238,6 +238,9 @@ class _Pending:
     rfut: Optional[Future] = None
     moved_from: Optional[str] = None
     attempts: int = 0
+    # front-door trace context (obs/trace.py Trace); the replica-side
+    # trace shares its id — the cross-ledger join key through a rescue
+    trace: Optional[object] = None
     # terminal-ownership flag, guarded by the fleet lock: exactly ONE
     # path (completion callback or typed rejection) may count and
     # resolve this request — close()'s leftover sweep racing a late
@@ -277,7 +280,8 @@ class FleetServer:
                  heartbeat_interval: float = 0.2,
                  kv=None,
                  max_place_attempts: int = 3,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 tracer=None):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
         self._factory = replica_factory
@@ -289,6 +293,11 @@ class FleetServer:
         self._kv = kv if kv is not None else LocalKVStore()
         self._hb_interval = float(heartbeat_interval)
         self._max_attempts = int(max_place_attempts)
+        # front-door tracing (obs/trace.py): None = OFF.  The front
+        # door mints the trace id; replicas join on it.
+        self.tracer = tracer
+        if tracer is not None and tracer.slo_ms is None:
+            tracer.slo_ms = slo_ms
         self.spill_store = (SpillStore(spill_dir,
                                        on_incident=self._incident)
                             if spill_dir else None)
@@ -321,6 +330,10 @@ class FleetServer:
         with self._lock:
             n = self._incident_counts.get(kind, 0) + 1
             self._incident_counts[kind] = n
+        if self.tracer is not None:
+            # flight recorder: the fleet-level incident force-retains
+            # every request in flight at the front door right now
+            self.tracer.on_incident(kind)
         if self.ledger is None:
             return
         if sample and n > 1 and (n % INCIDENT_SAMPLE) != 0:
@@ -405,7 +418,10 @@ class FleetServer:
             deadline_abs=(self._clock() + deadline_ms / 1000.0
                           if deadline_ms is not None else None),
             stream=stream, workload=workload,
-            t_submit=self._clock(), future=Future())
+            t_submit=self._clock(), future=Future(),
+            trace=(self.tracer.begin(rid=fid, stream=stream,
+                                     workload=workload)
+                   if self.tracer is not None else None))
         try:
             self._place(pend)
         except RequestError as e:
@@ -425,6 +441,10 @@ class FleetServer:
             pend.done = True
             self._pending.pop(pend.fid, None)
             self.counters[self._reject_counter(err)] += 1
+        if self.tracer is not None and pend.trace is not None:
+            # terminal before the incident write: a completed rejected
+            # trace sits in the flight-recorder ring when it flushes
+            self.tracer.finish(pend.trace, f"rejected:{err.kind}")
         self._incident(err.kind, f"request {pend.fid}: {err}")
         if not pend.future.done() \
                 and pend.future.set_running_or_notify_cancel():
@@ -445,7 +465,8 @@ class FleetServer:
                 left_ms = None
             try:
                 target, moved = self.router.route(
-                    pend.stream, self._depths(), pend.workload)
+                    pend.stream, self._depths(), pend.workload,
+                    trace=pend.trace)
             except NoReplicaError as e:
                 # admission-control shed: the fleet cannot place work
                 # anywhere right now — same contract as a full queue
@@ -471,10 +492,15 @@ class FleetServer:
                     f"{moved} -> {target} (consistent-hash ring over "
                     f"the live membership)")
             rep = self._replicas[target]
+            # trace_id only when tracing: the replica-side trace joins
+            # on the front door's id (kwarg omitted on the off path so
+            # reduced test doubles keep their submit signature)
+            tkw = ({"trace_id": pend.trace.tid}
+                   if pend.trace is not None else {})
             try:
                 rfut = rep.server.submit(
                     pend.image1, pend.image2, deadline_ms=left_ms,
-                    stream=pend.stream, workload=pend.workload)
+                    stream=pend.stream, workload=pend.workload, **tkw)
             except RequestError as e:
                 if self._replicas.get(target) is not rep:
                     # raced a rolling-restart swap: the handle read
@@ -489,6 +515,18 @@ class FleetServer:
                     exclude = exclude + (target,)
                     continue
                 raise
+            if pend.trace is not None:
+                # the placement hop: initial place, a ring-driven
+                # stream move, or a rescue off a dead replica —
+                # pend.replica still names the PREVIOUS one here
+                rescue = bool(exclude)
+                pend.trace.hop(
+                    target,
+                    moved_from=(pend.replica if rescue else moved),
+                    reason=("rescue" if rescue
+                            else ("stream-move" if moved is not None
+                                  else None)))
+                pend.trace.stamp("reroute" if rescue else "place")
             pend.replica = target
             with self._lock:
                 self._pending[pend.fid] = pend
@@ -521,6 +559,11 @@ class FleetServer:
                 # of re-placing on replicas that are being closed
                 self._finish_rejected(pend, exc)
                 return
+            if pend.trace is not None:
+                # close the dead replica's wait before re-placement:
+                # the reroute phase then measures ONLY the rescue
+                pend.trace.stamp("replica-wait")
+                pend.trace.event("rescue", replica=pend.replica)
             self._incident(
                 "fleet-reroute",
                 f"request {pend.fid} rescued from dead replica "
@@ -554,6 +597,9 @@ class FleetServer:
                     f"stream {pend.workload}/{pend.stream} re-routed "
                     f"from {pend.moved_from} with no adoptable warm "
                     f"state; typed re-cold-start (request served)")
+            if self.tracer is not None and pend.trace is not None:
+                pend.trace.stamp("replica-wait")
+                self.tracer.finish(pend.trace, "served")
             if pend.future.set_running_or_notify_cancel():
                 pend.future.set_result(res)
             return
@@ -715,6 +761,14 @@ class FleetServer:
             summary["restarts"] = restarts
         if self.spill_store is not None:
             summary["spill_store"] = dict(self.spill_store.stats)
+        if self.tracer is not None:
+            summary["trace"] = {
+                **self.tracer.summary(),
+                "exemplars": self.tracer.exemplars({
+                    "p50": summary.get("latency_p50_ms"),
+                    "p95": summary.get("latency_p95_ms"),
+                    "max": summary.get("latency_max_ms")}),
+            }
         return summary
 
     def close(self, timeout: float = 30.0) -> Dict:
@@ -755,6 +809,8 @@ class FleetServer:
                 f"{summary['unaccounted']} request(s) unaccounted for "
                 f"(submitted != served + typed rejects) — a silent "
                 f"drop crossed the fleet", sample=False)
+        if self.tracer is not None:
+            self.tracer.close()
         if self.ledger is not None:
             try:
                 self.ledger.close(summary={"serving": summary})

@@ -305,14 +305,21 @@ class FleetRouter:
 
     def route(self, stream: Optional[str],
               depths: Dict[str, int],
-              workload: str = "flow") -> Tuple[str, Optional[str]]:
+              workload: str = "flow",
+              trace=None) -> Tuple[str, Optional[str]]:
         """(target replica id, moved_from).  Raises
-        :class:`NoReplicaError` when no replica is live."""
+        :class:`NoReplicaError` when no replica is live.  ``trace``
+        (an obs/trace.py Trace, optional) records the routing decision
+        — which policy picked the target and over how many live
+        replicas — as a point annotation on the request's timeline."""
         live = self.membership.live()
         if not live:
             raise NoReplicaError("no live replica in the fleet")
         if stream is None:
             target = min(live, key=lambda r: (depths.get(r, 0), r))
+            if trace is not None:
+                trace.event("route", policy="least-depth",
+                            target=target, live=len(live))
             return target, None
         target = self._ring(live).assign(f"{workload}/{stream}")
         with self._lock:
@@ -323,6 +330,9 @@ class FleetRouter:
             while len(self._last) > self._max_streams:
                 self._last.popitem(last=False)
         moved_from = prev if prev is not None and prev != target else None
+        if trace is not None:
+            trace.event("route", policy="ring", target=target,
+                        live=len(live))
         return target, moved_from
 
 

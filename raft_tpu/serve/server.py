@@ -68,7 +68,8 @@ class FlowServer:
                  spill_store=None,
                  continuous: bool = False,
                  segment_iters: Optional[int] = None,
-                 canary_every: int = 0):
+                 canary_every: int = 0,
+                 tracer=None):
         from raft_tpu.obs.spans import NULL, SpanRecorder
         from raft_tpu.serve.engine import default_buckets
 
@@ -100,6 +101,17 @@ class FlowServer:
         self._flush_every = int(flush_every)
         self.spans = (SpanRecorder(ledger=ledger, annotate=False)
                       if ledger is not None else NULL)
+        # per-request tracing (obs/trace.py): None means OFF — the off
+        # path allocates no trace structures per request at all.  The
+        # tracer inherits this server's SLO so SLO-violating requests
+        # are force-retained past head sampling.
+        self.tracer = tracer
+        if tracer is not None and tracer.slo_ms is None:
+            tracer.slo_ms = slo_ms
+        # canary interleave annotation: the most recent probe's cost,
+        # attached as an event to the NEXT assembled batch's traces
+        # (batcher-thread-only state)
+        self._canary_ms_pending = 0.0
         for eng in self.engines.values():
             if getattr(eng, "spans", None) is NULL or \
                     getattr(eng, "spans", None) is None:
@@ -193,6 +205,11 @@ class FlowServer:
                   severity: Optional[str] = None) -> None:
         n = self._incident_counts.get(kind, 0) + 1
         self._incident_counts[kind] = n
+        if self.tracer is not None:
+            # flight recorder: flush the recent-trace ring and
+            # force-retain every request alive right now (each records
+            # at its own terminal with this incident named)
+            self.tracer.on_incident(kind)
         if self.ledger is None:
             return
         if sample and n > 1 and (n % INCIDENT_SAMPLE) != 0:
@@ -333,6 +350,7 @@ class FlowServer:
             return param_tree_digest([low, up])
 
         token = None
+        t_probe = self._clock()
         if self.watchdog is not None:
             # slow=True: a mismatch pays a recompile inside this bracket
             token = self.watchdog.begin(
@@ -381,20 +399,31 @@ class FlowServer:
         finally:
             if token is not None:
                 self.watchdog.done(token)
+            if self.tracer is not None:
+                # the probe delayed whatever dispatches next; the next
+                # assembled batch's traces carry it as an annotation
+                self._canary_ms_pending += \
+                    (self._clock() - t_probe) * 1e3
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                deadline_ms: Optional[float] = None,
                stream: Optional[str] = None,
-               workload: str = "flow"):
+               workload: str = "flow",
+               trace_id: Optional[str] = None):
         """Admit one request; returns its Future.  Raises the typed
         :class:`RequestError` subclasses on admission rejection (also
         counted + ledgered — the caller seeing the reason IS the typed
         shed).  ``workload`` routes to that workload's executables
         ("flow" by default; e.g. "stereo" on a server built with a
         stereo engine) — an unknown workload is a typed bad-request,
-        it could never be served."""
+        it could never be served.  ``trace_id`` joins this request to
+        a trace the fleet front door already opened (same id on both
+        ledgers is the merge join key)."""
         deadline = (self._clock() + deadline_ms / 1000.0
                     if deadline_ms is not None else None)
+        tr = (self.tracer.begin(rid=None, stream=stream,
+                                workload=workload, tid=trace_id)
+              if self.tracer is not None else None)
         # submitted and its admission outcome land under ONE lock hold
         # (queue.submit's own lock nests safely below): a close()-time
         # conservation snapshot must never observe a submit between the
@@ -419,8 +448,15 @@ class FlowServer:
             else:
                 rejected = None
         if rejected is not None:
+            if tr is not None:
+                self.tracer.finish(tr, f"rejected:{rejected.kind}")
             self._incident(rejected.kind, str(rejected))
             raise rejected
+        if tr is not None:
+            tr.rid = req.rid
+            tr.family = req.family
+            tr.stamp("admit")
+            req.trace = tr
         return req.future
 
     # -- probes --------------------------------------------------------------
@@ -452,6 +488,11 @@ class FlowServer:
     def _reject(self, req, err: RequestError, counter_key: str) -> None:
         with self._lock:
             self.counters[counter_key] += 1
+        if self.tracer is not None and req.trace is not None:
+            # terminal BEFORE the incident write: the rejected trace
+            # must sit completed in the flight-recorder ring when the
+            # incident's flush walks it
+            self.tracer.finish(req.trace, f"rejected:{err.kind}")
         self._incident(err.kind, str(err))
         if not req.future.set_running_or_notify_cancel():
             return
@@ -590,6 +631,17 @@ class FlowServer:
         family = reqs[0].family
         engine = self.engines[workload]
         hw = self.buckets[family]
+        if self.tracer is not None:
+            canary_ms, self._canary_ms_pending = \
+                self._canary_ms_pending, 0.0
+            for req in reqs:
+                if req.trace is not None:
+                    # the pop closes the queue-wait phase; a preceding
+                    # canary probe annotates the batch it delayed
+                    req.trace.stamp("queue-wait")
+                    if canary_ms:
+                        req.trace.event("canary-interleave",
+                                        ms=round(canary_ms, 3))
         with self.spans.span("batch"):
             img1, img2, kept, rejected = assemble_batch(
                 reqs, hw, B, clock=self._clock)
@@ -605,6 +657,10 @@ class FlowServer:
         iters = self.controller.observe(frac,
                                         self.latency.rolling_p95_ms())
         warm_init, warm_slots = self._warm_inits(kept, hw, engine)
+        if self.tracer is not None:
+            for req in kept:
+                if req is not None and req.trace is not None:
+                    req.trace.stamp("assembly")
         return {"workload": workload, "family": family,
                 "engine": engine, "hw": hw, "img1": img1, "img2": img2,
                 "kept": kept, "iters": iters, "warm_init": warm_init,
@@ -632,18 +688,29 @@ class FlowServer:
             iters = min(iters, self.warm_iters)
 
         token = None
+        traced = ([r for r in kept
+                   if r is not None and r.trace is not None]
+                  if self.tracer is not None else [])
+        lazy = not engine.is_compiled(
+            hw, iters, warm=flow_init is not None)
         if self.watchdog is not None:
             # a not-yet-memoized executable pays a lazy compile (or
             # cache load) inside this bracket: grant it the compile
             # bound, not the dispatch bound
-            lazy = not engine.is_compiled(
-                hw, iters, warm=flow_init is not None)
             token = self.watchdog.begin(
                 f"dispatch batch {self._batch_no} "
                 f"workload={workload} family={family} "
                 f"iters={iters} warm={flow_init is not None}"
                 + (" +compile" if lazy else ""), slow=lazy)
+        fb0 = getattr(engine, "fallbacks", None)
         try:
+            if traced and lazy:
+                # split compile from run for attribution: memoize the
+                # executable first (same work forward would trigger),
+                # then charge the build to its own phase
+                engine.executable(hw, iters, warm=flow_init is not None)
+                for req in traced:
+                    req.trace.stamp("compile")
             flow_low, flow_up = engine.forward(
                 hw, iters, img1, img2, flow_init=flow_init)
         except Exception as e:  # noqa: BLE001 — a dispatch failure
@@ -658,6 +725,12 @@ class FlowServer:
             return
         if token is not None:
             self.watchdog.done(token)
+        for req in traced:
+            req.trace.stamp("dispatch")
+            if fb0 is not None and engine.fallbacks > fb0:
+                # the q8 tripwire re-dispatched this batch on the bf16
+                # twin inside forward — the dispatch phase carries both
+                req.trace.event("q8-fallback")
 
         now = self._clock()
         fam_label = f"{workload}/{family}"
@@ -685,6 +758,8 @@ class FlowServer:
                      # per-SLOT truth: a cold stream batched next to a
                      # warm neighbor did NOT warm-start
                      "warm": i in warm_slots})
+            if self.tracer is not None and req.trace is not None:
+                self.tracer.finish(req.trace, "served")
         with self._lock:
             if fam_label in self._family_counts:
                 self._family_counts[fam_label]["batches"] += 1
@@ -749,6 +824,10 @@ class FlowServer:
         reqs = self.queue.pop_lane(state["lane"], len(free))
         if not reqs:
             return
+        if self.tracer is not None:
+            for req in reqs:
+                if req.trace is not None:
+                    req.trace.stamp("queue-wait")
         # the admission boundary is the continuous-mode analogue of the
         # FIFO path's batch assembly: under sustained traffic the
         # in-flight batch never empties, so without this observe() the
@@ -800,6 +879,9 @@ class FlowServer:
                 state["slots"][i] = req
                 state["remaining"][i] = t
                 state["segments"][i] = 0
+                if req.trace is not None:
+                    req.trace.stamp("assembly")
+                    req.trace.event("joined-inflight", slot=i)
             except Exception as e:  # noqa: BLE001 — a failed seat
                 # rejects THAT request typed and restores its slot to
                 # the empty-pad contract (zero images, zero flow); the
@@ -823,13 +905,21 @@ class FlowServer:
         hw = state["hw"]
         seg = self._segment
         token = None
+        traced = ([r for r in state["slots"]
+                   if r is not None and r.trace is not None]
+                  if self.tracer is not None else [])
+        lazy = not engine.is_compiled(hw, seg, warm=True)
         if self.watchdog is not None:
-            lazy = not engine.is_compiled(hw, seg, warm=True)
             token = self.watchdog.begin(
                 f"continuous segment batch {self._batch_no} "
                 f"lane={state['lane']} seg={seg}"
                 + (" +compile" if lazy else ""), slow=lazy)
+        fb0 = getattr(engine, "fallbacks", None)
         try:
+            if traced and lazy:
+                engine.executable(hw, seg, warm=True)
+                for req in traced:
+                    req.trace.stamp("compile")
             flow_low, flow_up = engine.forward(
                 hw, seg, state["img1"], state["img2"],
                 flow_init=state["flow"])
@@ -853,6 +943,14 @@ class FlowServer:
                 continue
             state["remaining"][i] -= seg
             state["segments"][i] += 1
+            if req.trace is not None and self.tracer is not None:
+                # per-segment iteration span: each boundary charges the
+                # segment's wall to the dispatch phase and annotates it
+                req.trace.stamp("dispatch")
+                req.trace.event("segment",
+                                n=state["segments"][i], iters=seg)
+                if fb0 is not None and engine.fallbacks > fb0:
+                    req.trace.event("q8-fallback")
             if state["remaining"][i] > 0:
                 continue
             # slot complete: deliver, remember the stream, free it
@@ -882,6 +980,8 @@ class FlowServer:
                      "iters": state["segments"][i] * seg,
                      "segments": state["segments"][i],
                      "warm": i in state["warm"]})
+            if self.tracer is not None and req.trace is not None:
+                self.tracer.finish(req.trace, "served")
             state["slots"][i] = None
             state["warm"].discard(i)
             # freed slot back to the empty-pad shape: zero images and
@@ -1008,6 +1108,17 @@ class FlowServer:
                 "families": len(self._canary)}
         if self.engine.aot is not None:
             summary["aot_cache"] = dict(self.engine.aot.stats)
+        if self.tracer is not None:
+            # the percentiles above become addressable: each bucket
+            # names one concrete (force-retained) trace id, so "p95
+            # moved" always has a request to open with --trace
+            summary["trace"] = {
+                **self.tracer.summary(),
+                "exemplars": self.tracer.exemplars({
+                    "p50": summary.get("latency_p50_ms"),
+                    "p95": summary.get("latency_p95_ms"),
+                    "max": summary.get("latency_max_ms")}),
+            }
         return summary
 
     def kill(self, timeout: float = 60.0):
@@ -1058,6 +1169,10 @@ class FlowServer:
                 f"{summary['unaccounted']} request(s) unaccounted for "
                 f"(submitted != served + rejected — a silent drop)",
                 sample=False)
+        if self.tracer is not None:
+            # final flight-recorder window: the last completed traces
+            # survive to the ledger even when nothing forced them
+            self.tracer.close()
         if self.ledger is not None:
             try:
                 self.spans.flush(self._batch_no)

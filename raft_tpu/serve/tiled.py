@@ -157,18 +157,32 @@ def submit_tiled(server, image1: np.ndarray, image2: np.ndarray,
     th, tw = tile_hw
     futures = []
     out: Future = Future()
+    # frame-level trace: the tile requests each carry their own trace
+    # context under the SAME id (the fan-in join key), and the frame
+    # context owns the phases no tile sees — fan-out, the wait for the
+    # slowest tile, and the feather blend
+    tracer = getattr(server, "tracer", None)
+    ftr = (tracer.begin(rid="frame", workload=workload, family="tiled")
+           if tracer is not None else None)
     for (y, x) in plan:
         t1 = np.ascontiguousarray(image1[y:y + th, x:x + tw])
         t2 = np.ascontiguousarray(image2[y:y + th, x:x + tw])
         try:
-            futures.append(server.submit(t1, t2, deadline_ms=deadline_ms,
-                                         workload=workload))
+            futures.append(server.submit(
+                t1, t2, deadline_ms=deadline_ms, workload=workload,
+                **({"trace_id": ftr.tid} if ftr is not None else {})))
         except Exception as e:  # typed admission rejection of a tile
             # rejects the frame with the SAME typed error
             for f in futures:
                 f.cancel()
+            if ftr is not None:
+                tracer.finish(
+                    ftr, f"rejected:{getattr(e, 'kind', 'bad-request')}")
             out.set_exception(e)
             return out
+    if ftr is not None:
+        ftr.stamp("fan-out")
+        ftr.event("tiles", n=len(plan))
     remaining = [len(futures)]
     lock = threading.Lock()
     results: List[Optional[Dict]] = [None] * len(futures)
@@ -179,13 +193,22 @@ def submit_tiled(server, image1: np.ndarray, image2: np.ndarray,
         # into InvalidStateError on this thread
         if not out.set_running_or_notify_cancel():
             return
+        if ftr is not None:
+            # everything since fan-out was waiting on the slowest tile
+            ftr.stamp("tile-wait")
         try:
             flows = [r["flow"] for r in results]
             blended = blend_tiles(hw, tile_hw, plan, overlap, flows)
+            if ftr is not None:
+                ftr.stamp("blend")
             out.set_result({"flow": blended, "tiles": len(plan),
                             "iters": results[0]["iters"]})
+            if ftr is not None:
+                tracer.finish(ftr, "served")
         except Exception as e:  # noqa: BLE001 — a blend failure
             # rejects the frame; it must never pass silently
+            if ftr is not None:
+                tracer.finish(ftr, "rejected:blend-failure")
             out.set_exception(e)
 
     def finish(i: int, f) -> None:
@@ -198,6 +221,9 @@ def submit_tiled(server, image1: np.ndarray, image2: np.ndarray,
                 # future's — a consumer cancel can still land between
                 # it and the terminal, so claim before resolving
                 if out.set_running_or_notify_cancel():
+                    if ftr is not None:
+                        tracer.finish(ftr, "rejected:" + getattr(
+                            exc, "kind", "tile-failure"))
                     out.set_exception(exc)
                 return
             results[i] = f.result()

@@ -458,6 +458,7 @@ def serve_main(args, env, workdir):
     all_names = ("serve-overload", "serve-deadline-storm", "serve-poison",
                  "serve-mixed-family", "serve-kill-restart-warm",
                  "serve-stall", "serve-kill-one-replica",
+                 "serve-trace-under-kill",
                  "serve-rolling-restart", "serve-sdc-canary",
                  "serve-quant-overflow")
     if args.only and args.only not in all_names:
@@ -638,6 +639,90 @@ def serve_main(args, env, workdir):
             fail = f"expected exactly one dead replica ({summary['replicas']})"
         elif not summary["stream_moves"]:
             fail = "no stream re-routed off the dead replica"
+        finish(name, {"fleet-replica-lost", "fleet-reroute",
+                      "fleet-warm-adopt"}, False, fail,
+               [ledger(name, "run")]
+               + [ledger(name, "run") + f".p{i}" for i in range(3)])
+
+    # -- tracing through a replica kill: SPARSE head sampling (so any
+    # extra trace on the ledger is there because retention FORCED it),
+    # the flight recorder captures the kill window, a re-routed
+    # request's trace shows the hop off the dead replica, the summary
+    # names percentile exemplar trace ids, and `obs report --merge
+    # --trace <id>` joins the front-door and replica records of one
+    # moved request across ledgers — all with conservation green
+    if want("serve-trace-under-kill"):
+        name, fail = "serve-trace-under-kill", None
+        rc, _, summary, tail = run_serve(
+            workdir, name,
+            ["--fleet", "3", "--requests", "24", "--batch_size", "2",
+             "--queue_capacity", "16", "--iter_levels", "4,2",
+             "--video_streams", "6", "--inject", "kill-replica@8",
+             "--trace_sample", "50"],
+            env)
+        trace_sum = (summary or {}).get("trace") or {}
+        exemplars = trace_sum.get("exemplars") or {}
+        front_traces = []
+        try:
+            with open(ledger(name, "run"), encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("kind") == "trace":
+                        front_traces.append(rec)
+        except OSError:
+            pass
+        moved = [t for t in front_traces
+                 if any(h.get("moved_from") for h in t.get("hops") or [])]
+        recorder = [t for t in front_traces
+                    if any(f.startswith(("flight-recorder:", "incident:"))
+                           for f in t.get("forced") or [])]
+        if rc != 0:
+            fail = f"exit {rc} != 0\n{tail}"
+        elif summary is None or summary["unaccounted"] != 0:
+            fail = (f"fleet silent drops: "
+                    f"{summary and summary['unaccounted']}")
+        elif summary["served"] + summary["rejected_total"] != 24:
+            fail = (f"conservation books wrong: served "
+                    f"{summary['served']} + rejected "
+                    f"{summary['rejected_total']} != 24")
+        elif not trace_sum.get("recorded"):
+            fail = f"no traces recorded ({trace_sum})"
+        elif not ({"p50", "p95"} <= set(exemplars)):
+            fail = (f"summary names no percentile exemplar trace ids "
+                    f"({exemplars})")
+        elif not moved:
+            fail = ("no trace shows a hop off the dead replica "
+                    "(reroute/stream-move invisible to tracing)")
+        elif not recorder:
+            fail = ("flight recorder captured nothing at the kill "
+                    "(no flight-recorder:/incident: forced trace)")
+        if fail is None:
+            # the cross-ledger join: ONE moved request's timeline must
+            # reconstruct from the front door + replica records
+            tid = moved[0]["tid"]
+            proc = subprocess.run(
+                [sys.executable, "-m", "raft_tpu.obs", "report",
+                 ledger(name, "run"), "--merge", "--trace", tid,
+                 "--json"],
+                cwd=ROOT, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, timeout=120)
+            try:
+                joined = json.loads(proc.stdout)
+            except json.JSONDecodeError:
+                joined = {}
+            sources = {r.get("source")
+                       for r in joined.get("records") or []}
+            if proc.returncode != 0:
+                fail = (f"--trace {tid} join exit {proc.returncode}\n"
+                        f"{proc.stdout[-2000:]}")
+            elif len(joined.get("records") or []) < 2 \
+                    or "front" not in sources:
+                got = sorted(s for s in sources if s)
+                fail = (f"--trace {tid} did not join the front + "
+                        f"replica records (sources {got})")
         finish(name, {"fleet-replica-lost", "fleet-reroute",
                       "fleet-warm-adopt"}, False, fail,
                [ledger(name, "run")]

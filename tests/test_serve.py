@@ -568,6 +568,86 @@ def test_server_end_to_end_with_ledger(engine, tmp_path):
         report["phase_seconds_excl"])
 
 
+def test_server_end_to_end_tracing_attribution(engine, tmp_path):
+    """Tracing at sample=1 through the REAL server: every request's
+    trace lands on the ledger, its phases (queue-wait/assembly/
+    dispatch/... + other) sum EXACTLY to its recorded latency (the
+    100%-attribution contract), the summary names percentile exemplar
+    trace ids, and a typed rejection's trace is retained with the
+    rejection outcome."""
+    from raft_tpu.obs.events import RunLedger, read_ledger
+    from raft_tpu.obs.report import build_report
+    from raft_tpu.obs.trace import Tracer
+    from raft_tpu.serve.server import FlowServer
+
+    ledger_path = str(tmp_path / "events.jsonl")
+    ledger = RunLedger(ledger_path, meta={"entry": "serve"})
+    tracer = Tracer(ledger, sample=1)
+    server = FlowServer(engine, buckets={"t": HW}, queue_capacity=8,
+                        iter_levels=(2, 1), slo_ms=5000.0,
+                        ledger=ledger, tracer=tracer)
+    server.warmup(warm_too=False)
+    rng = np.random.default_rng(0)
+
+    def frame():
+        return rng.uniform(0, 255, (24, 24, 3)).astype(np.float32)
+
+    futs = [server.submit(frame(), frame()) for _ in range(5)]
+    for f in futs:
+        f.result(timeout=120)
+    bad = frame()
+    bad[0, 0, 0] = np.nan                      # typed bad-request
+    with pytest.raises(Exception):
+        server.submit(bad, frame()).result(timeout=120)
+
+    summary = server.close()
+    tsum = summary["trace"]
+    assert tsum["recorded"] >= 6 and tsum["in_flight"] == 0
+    assert {"p50", "p95", "max"} <= set(tsum["exemplars"])
+    served_tids = set()
+    records = [r for r in read_ledger(ledger_path)
+               if r.get("kind") == "trace"]
+    assert len(records) >= 6
+    for rec in records:
+        assert sum(rec["phases"].values()) == pytest.approx(
+            rec["latency_ms"], abs=2e-3)       # record rounding only
+        if rec["outcome"] == "served":
+            served_tids.add(rec["tid"])
+            assert {"admit", "queue-wait", "assembly", "dispatch",
+                    "other"} <= set(rec["phases"])
+    assert any(r["outcome"] == "rejected:bad-request" and
+               "rejection" in r["forced"] for r in records)
+    assert {row["tid"] for row in tsum["exemplars"].values()} \
+        <= served_tids
+    report = build_report(read_ledger(ledger_path))
+    sec = report["tracing"]
+    assert sum(sec["attribution_pct"].values()) == pytest.approx(
+        100.0, abs=0.1)
+
+
+def test_server_tracing_off_writes_no_trace_records(engine, tmp_path):
+    """tracer=None is the OFF path: no trace records, no per-request
+    trace context (Request.trace stays None), summary has no trace
+    section — byte-identical serving behavior."""
+    from raft_tpu.obs.events import RunLedger, read_ledger
+    from raft_tpu.serve.server import FlowServer
+
+    ledger_path = str(tmp_path / "events.jsonl")
+    ledger = RunLedger(ledger_path, meta={"entry": "serve"})
+    server = FlowServer(engine, buckets={"t": HW}, queue_capacity=8,
+                        iter_levels=(2,), degrade=False, ledger=ledger)
+    server.warmup(warm_too=False)
+    rng = np.random.default_rng(0)
+    f = server.submit(
+        rng.uniform(0, 255, HW + (3,)).astype(np.float32),
+        rng.uniform(0, 255, HW + (3,)).astype(np.float32))
+    f.result(timeout=120)
+    summary = server.close()
+    assert "trace" not in summary
+    assert not any(r.get("kind") == "trace"
+                   for r in read_ledger(ledger_path))
+
+
 def test_server_video_stream_warm_start(engine):
     """flow_init chaining: the second frame of a stream dispatches warm
     (forward-splatted previous flow_low) and says so in its result."""

@@ -1,0 +1,426 @@
+"""Per-request tracing tests (raft_tpu/obs/trace.py + the report side).
+
+The tentpole contracts pinned here:
+
+- **Record schema**: the ``"trace"`` ledger record's key set is pinned
+  (a reader join key or a phase bucket silently renamed would orphan
+  every stored ledger).
+- **100 %-attribution**: a finished trace's phases (including the
+  explicit ``other`` residue) sum EXACTLY to its recorded latency, so
+  the report's tail attribution sums to 100 by construction — the
+  serving twin of the training report's ``stall_attribution_pct``
+  contract.
+- **Head sampling with forced retention**: 1-in-N by default; typed
+  rejections, SLO violators, incident flight-recorder windows and
+  percentile exemplars are retained regardless.
+- **Flight recorder**: an incident flushes the ring of recent complete
+  traces and force-retains every in-flight trace.
+- **Forward/backward ledger compatibility**: pre-trace ledgers (no
+  ``"trace"`` records) build and render exactly as before, and trace
+  records ride schema v1 through ``read_ledger`` unchanged.
+- **Cross-ledger join**: ``obs report --merge --trace <id>`` joins a
+  fleet request's front-door and replica records on the shared id.
+"""
+
+import json
+
+import pytest
+
+from raft_tpu.obs.events import SCHEMA_VERSION, RunLedger, read_ledger
+from raft_tpu.obs.report import (build_report, build_trace_section,
+                                 find_trace, render_report,
+                                 render_trace_timeline)
+from raft_tpu.obs.trace import TRACE_KIND, Trace, Tracer, new_trace_id
+
+
+class FakeClock:
+    def __init__(self, t0: float = 0.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _tracer(tmp_path, name="events.jsonl", clock=None, **kw):
+    clock = clock or FakeClock(100.0)
+    ledger = RunLedger(str(tmp_path / name), meta={"entry": "serve"},
+                       clock=clock)
+    return Tracer(ledger, clock=clock, **kw), ledger, clock
+
+
+def _traces_on(path):
+    return [r for r in read_ledger(str(path))
+            if r.get("kind") == TRACE_KIND]
+
+
+# ---------------------------------------------------------------------------
+# record schema + the 100%-attribution contract
+# ---------------------------------------------------------------------------
+
+def test_trace_record_schema_pinned(tmp_path):
+    """The stored record's key set is the join/report contract."""
+    tracer, ledger, clock = _tracer(tmp_path, sample=1)
+    tr = tracer.begin(rid=7, stream="s1", workload="flow",
+                      family="session")
+    clock.advance(0.010)
+    tr.stamp("queue-wait")
+    clock.advance(0.030)
+    tr.stamp("dispatch")
+    tr.event("q8-fallback")
+    tr.hop("r1", moved_from="r0", reason="rescue")
+    clock.advance(0.002)
+    tracer.finish(tr, "served")
+    ledger.close()
+
+    (rec,) = _traces_on(tmp_path / "events.jsonl")
+    assert rec["v"] == SCHEMA_VERSION
+    payload_keys = {"tid", "rid", "stream", "workload", "family",
+                    "outcome", "latency_ms", "phases", "events", "hops",
+                    "forced", "sampled"}
+    # envelope keys come from the ledger (kind/run/t/v)
+    assert payload_keys <= set(rec)
+    assert rec["tid"] == tr.tid and rec["rid"] == 7
+    assert rec["outcome"] == "served"
+    assert rec["hops"] == [{"replica": "r1", "moved_from": "r0",
+                            "reason": "rescue"}]
+    assert rec["events"][0]["name"] == "q8-fallback"
+    # attribution contract: phases + other == latency, exactly
+    assert rec["latency_ms"] == pytest.approx(42.0, abs=1e-6)
+    assert sum(rec["phases"].values()) == pytest.approx(
+        rec["latency_ms"], abs=1e-6)
+    assert rec["phases"]["other"] == pytest.approx(2.0, abs=1e-6)
+
+
+def test_stamp_watermark_and_add_ms(tmp_path):
+    clock = FakeClock(0.0)
+    tr = Trace(new_trace_id(), 0, None, "flow", None, True, clock)
+    clock.advance(0.005)
+    assert tr.stamp("a") == pytest.approx(5.0)
+    clock.advance(0.003)
+    tr.skip()                       # uncharged; lands in other at finish
+    clock.advance(0.004)
+    tr.stamp("a")                   # accumulates
+    tr.add_ms("blend", 1.5)         # watermark NOT moved
+    assert tr.phases == pytest.approx({"a": 9.0, "blend": 1.5})
+
+
+def test_double_finish_is_noop(tmp_path):
+    tracer, ledger, clock = _tracer(tmp_path, sample=1)
+    tr = tracer.begin(rid=0)
+    clock.advance(0.01)
+    tracer.finish(tr, "served")
+    tracer.finish(tr, "rejected:queue-full")   # racing second terminal
+    ledger.close()
+    (rec,) = _traces_on(tmp_path / "events.jsonl")
+    assert rec["outcome"] == "served"
+
+
+# ---------------------------------------------------------------------------
+# head sampling + forced retention
+# ---------------------------------------------------------------------------
+
+def test_head_sampling_records_one_in_n(tmp_path):
+    tracer, ledger, clock = _tracer(tmp_path, sample=4)
+    for i in range(8):
+        tr = tracer.begin(rid=i)
+        clock.advance(0.001)
+        tracer.finish(tr, "served")
+    ledger.close()
+    recs = _traces_on(tmp_path / "events.jsonl")
+    assert len(recs) == 2 and all(r["sampled"] for r in recs)
+    assert [r["rid"] for r in recs] == [0, 4]
+
+
+def test_rejection_and_slo_always_retained(tmp_path):
+    tracer, ledger, clock = _tracer(tmp_path, sample=1000, slo_ms=50.0)
+    tr = tracer.begin(rid=0)            # seq 1: head-sampled
+    tracer.finish(tr, "served")
+    tr = tracer.begin(rid=1)            # fast, unsampled -> dropped
+    clock.advance(0.001)
+    tracer.finish(tr, "served")
+    tr = tracer.begin(rid=2)            # typed rejection -> retained
+    tracer.finish(tr, "rejected:queue-full")
+    tr = tracer.begin(rid=3)            # SLO violator -> retained
+    clock.advance(0.100)
+    tracer.finish(tr, "served")
+    recs = {r["rid"]: r for r in _traces_on(tmp_path / "events.jsonl")}
+    assert set(recs) == {0, 2, 3}
+    assert recs[2]["forced"] == ["rejection"]
+    assert recs[3]["forced"] == ["slo"]
+
+
+def test_tracing_off_sample_zero_records_nothing(tmp_path):
+    tracer, ledger, clock = _tracer(tmp_path, sample=0)
+    tr = tracer.begin(rid=0)
+    tracer.finish(tr, "served")
+    assert not tracer.recorded
+    assert _traces_on(tmp_path / "events.jsonl") == []
+
+
+def test_write_failure_degrades_never_raises(tmp_path):
+    class TornLedger:
+        def write(self, kind, **payload):
+            raise OSError("disk full")
+
+    tracer = Tracer(TornLedger(), sample=1, clock=FakeClock())
+    tr = tracer.begin(rid=0)
+    tracer.finish(tr, "served")         # must not raise
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_incident_flushes_ring_and_forces_in_flight(tmp_path):
+    tracer, ledger, clock = _tracer(tmp_path, sample=1000)
+    done = []
+    for i in range(1, 4):               # rid 1..3 complete, unsampled
+        tr = tracer.begin(rid=i)
+        clock.advance(0.001)
+        tracer.finish(tr, "served")
+    done_tids = set()
+    live = tracer.begin(rid=99)         # in flight when it fires
+    tracer.on_incident("fleet-replica-lost")
+    # ring flushed NOW (complete window), live trace forced for later
+    recs = {r["rid"]: r for r in _traces_on(tmp_path / "events.jsonl")}
+    assert {1, 2, 3} <= set(recs)
+    assert all("flight-recorder:fleet-replica-lost" in recs[i]["forced"]
+               for i in (2, 3))         # rid 1 was head-sampled anyway
+    assert 99 not in recs
+    clock.advance(0.002)
+    tracer.finish(live, "served")       # terminal writes it, incident named
+    recs = {r["rid"]: r for r in _traces_on(tmp_path / "events.jsonl")}
+    assert "incident:fleet-replica-lost" in recs[99]["forced"]
+    del done, done_tids
+
+
+def test_close_flushes_final_window_once(tmp_path):
+    tracer, ledger, clock = _tracer(tmp_path, sample=1000)
+    for i in range(1, 4):
+        tr = tracer.begin(rid=i)
+        clock.advance(0.001)
+        tracer.finish(tr, "served")
+    tracer.close()
+    tracer.close()                      # idempotent: ring already drained
+    recs = _traces_on(tmp_path / "events.jsonl")
+    assert len(recs) == 3
+    assert sum("flight-recorder:close" in r["forced"] for r in recs) == 2
+
+
+def test_exemplars_name_closest_served_trace(tmp_path):
+    tracer, ledger, clock = _tracer(tmp_path, sample=1000)
+    tids = {}
+    for i, ms in enumerate((10, 20, 200)):
+        tr = tracer.begin(rid=i)
+        clock.advance(ms / 1e3)
+        tids[ms] = tr.tid
+        tracer.finish(tr, "served")
+    out = tracer.exemplars({"p50": 19.0, "max": 210.0, "skip": None,
+                            "nan": float("nan")})
+    assert out["p50"]["tid"] == tids[20]
+    assert out["max"]["tid"] == tids[200]
+    assert set(out) == {"p50", "max"}   # None/NaN targets skipped
+    recs = {r["rid"]: r for r in _traces_on(tmp_path / "events.jsonl")}
+    assert "exemplar:p50" in recs[1]["forced"]
+    assert "exemplar:max" in recs[2]["forced"]
+
+
+# ---------------------------------------------------------------------------
+# report: tail attribution, schema, pre-trace compatibility
+# ---------------------------------------------------------------------------
+
+def _serve_ledger_with_traces(path):
+    clock = FakeClock(1000.0)
+    ledger = RunLedger(str(path), meta={"entry": "serve"}, clock=clock)
+    tracer = Tracer(ledger, sample=1, clock=clock)
+    for i, (wait_ms, disp_ms) in enumerate(
+            [(1, 30), (1, 32), (2, 31), (40, 90)]):
+        tr = tracer.begin(rid=i, stream=f"s{i}", family="session")
+        clock.advance(wait_ms / 1e3)
+        tr.stamp("queue-wait")
+        clock.advance(disp_ms / 1e3)
+        tr.stamp("dispatch")
+        clock.advance(0.001)
+        tracer.finish(tr, "served")
+    tr = tracer.begin(rid=4)
+    tracer.finish(tr, "rejected:queue-full")
+    ledger.close(summary={"serving": {"served": 4, "submitted": 5}})
+    return tracer
+
+
+def test_report_tail_attribution_schema(tmp_path):
+    """The --json report's tracing section: the pinned key set, a
+    100 % sum, per-phase p50/p95 and the tail driver."""
+    path = tmp_path / "events.jsonl"
+    _serve_ledger_with_traces(path)
+    report = build_report(read_ledger(str(path)))
+    sec = report["tracing"]
+    assert {"traces", "outcomes", "forced", "hops", "served_traced",
+            "attribution_pct", "phase_ms", "tail_driver"} <= set(sec)
+    assert sec["traces"] == 5 and sec["served_traced"] == 4
+    assert sec["outcomes"] == {"served": 4, "rejected:queue-full": 1}
+    attr = sec["attribution_pct"]
+    assert set(attr) == {"queue-wait", "dispatch", "other"}
+    assert sum(attr.values()) == pytest.approx(100.0, abs=0.05)
+    # the tail request's 90ms dispatch dominates the p95-p50 delta
+    assert sec["tail_driver"] == "dispatch"
+    pm = sec["phase_ms"]["dispatch"]
+    assert pm["p95"] > pm["p50"]
+    assert pm["delta_p95_p50"] == pytest.approx(pm["p95"] - pm["p50"],
+                                                abs=1e-6)
+    text = render_report(report)
+    assert "request tracing:" in text and "tail driver: dispatch" in text
+
+
+def test_report_cli_json_carries_tracing(tmp_path, capsys):
+    from raft_tpu.obs.__main__ import main as obs_main
+
+    path = tmp_path / "events.jsonl"
+    _serve_ledger_with_traces(path)
+    assert obs_main(["report", str(path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["tracing"]["served_traced"] == 4
+    assert sum(payload["tracing"]["attribution_pct"].values()) \
+        == pytest.approx(100.0, abs=0.05)
+
+
+def test_pre_trace_ledger_reports_cleanly(tmp_path, capsys):
+    """Backward compat: a ledger written before tracing existed (no
+    ``trace`` records) builds, renders, and carries NO tracing section
+    — and the v1 schema needs no bump for the new kind."""
+    from raft_tpu.obs.__main__ import main as obs_main
+
+    path = tmp_path / "old.jsonl"
+    clock = FakeClock(1000.0)
+    ledger = RunLedger(str(path), meta={"entry": "serve"}, clock=clock)
+    ledger.incident("queue-full", step=0, detail="shed")
+    ledger.close(summary={"serving": {"served": 1, "submitted": 2}})
+    records = read_ledger(str(path))
+    assert all(r["v"] == SCHEMA_VERSION for r in records)
+    report = build_report(records)
+    assert report["tracing"] is None
+    assert "request tracing:" not in render_report(report)
+    assert obs_main(["report", str(path), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["tracing"] is None
+
+
+def test_trace_kind_rides_schema_v1_through_read_ledger(tmp_path):
+    """Forward compat the other way: readers pass the ``trace`` kind
+    through without a schema bump (unknown kinds tolerated by design),
+    so OLD readers keep reading NEW ledgers."""
+    path = tmp_path / "events.jsonl"
+    tracer = _serve_ledger_with_traces(path)
+    records = read_ledger(str(path))
+    assert {r["kind"] for r in records} >= {"run_start", "trace",
+                                            "run_end"}
+    assert all(r["v"] == SCHEMA_VERSION for r in records)
+    del tracer
+
+
+def test_build_trace_section_counts_hops_and_forced():
+    traces = [
+        {"outcome": "served", "latency_ms": 10.0,
+         "phases": {"dispatch": 9.0, "other": 1.0},
+         "forced": ["slo", "exemplar:p95"],
+         "hops": [{"replica": "r0", "moved_from": None, "reason": None},
+                  {"replica": "r1", "moved_from": "r0",
+                   "reason": "rescue"}]},
+        {"outcome": "rejected:queue-full", "latency_ms": 1.0,
+         "phases": {"other": 1.0}, "forced": ["rejection"],
+         "hops": [{"replica": "r2", "moved_from": "r0",
+                   "reason": "stream-move"}]},
+    ]
+    sec = build_trace_section(traces)
+    assert sec["hops"] == {"placements": 1, "stream_moves": 1,
+                           "rescues": 1}
+    assert sec["forced"] == {"slo": 1, "exemplar": 1, "rejection": 1}
+    assert sec["served_traced"] == 1
+    assert build_trace_section([]) is None
+
+
+# ---------------------------------------------------------------------------
+# --trace <id>: the cross-ledger fleet join
+# ---------------------------------------------------------------------------
+
+def _fleet_ledgers(tmp_path, tid):
+    """Front + two replica ledgers telling one rescued request's story
+    under a shared trace id (the reroute join the flight recorder
+    promises): placed on r0 (died), rescued to r1 (served)."""
+    clock = FakeClock(1000.0)
+    front = RunLedger(str(tmp_path / "events.jsonl"),
+                      meta={"entry": "serve-fleet"}, clock=clock)
+    ft = Tracer(front, sample=1, clock=clock)
+    tr = ft.begin(rid="f0", stream="s0", tid=tid)
+    tr.hop("r0")
+    tr.stamp("place")
+    clock.advance(0.020)
+    tr.hop("r1", moved_from="r0", reason="rescue")
+    tr.stamp("reroute")
+    clock.advance(0.040)
+    tr.stamp("replica-wait")
+    ft.finish(tr, "served")
+    front.close()
+
+    for i, (outcome, phase_ms) in enumerate(
+            [("rejected:shutdown", 5.0), ("served", 35.0)]):
+        rep = RunLedger(str(tmp_path / f"events.jsonl.p{i}"),
+                        meta={"entry": "serve", "replica": f"r{i}"},
+                        clock=clock)
+        rt = Tracer(rep, sample=1, clock=clock)
+        tr = rt.begin(rid=0, tid=tid)
+        clock.advance(phase_ms / 1e3)
+        tr.stamp("dispatch")
+        rt.finish(tr, outcome)
+        rep.close()
+
+
+def test_trace_timeline_joins_across_fleet_ledgers(tmp_path, capsys):
+    from raft_tpu.obs.__main__ import main as obs_main
+
+    tid = "deadbeef0123"
+    _fleet_ledgers(tmp_path, tid)
+    rc = obs_main(["report", str(tmp_path / "events.jsonl"), "--merge",
+                   "--trace", tid, "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["tid"] == tid
+    by_source = {r["source"]: r for r in payload["records"]}
+    assert set(by_source) == {"front", "p0", "p1"}
+    assert any(h["reason"] == "rescue"
+               for h in by_source["front"]["hops"])
+    assert by_source["p0"]["outcome"] == "rejected:shutdown"
+    assert by_source["p1"]["outcome"] == "served"
+    # human rendering joins the same story
+    rc = obs_main(["report", str(tmp_path / "events.jsonl"), "--merge",
+                   "--trace", tid])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "hop -> r1 from r0 (rescue)" in text
+    assert "[front]" in text and "[p0]" in text and "[p1]" in text
+
+
+def test_trace_timeline_missing_id_exits_one(tmp_path, capsys):
+    from raft_tpu.obs.__main__ import main as obs_main
+
+    _fleet_ledgers(tmp_path, "deadbeef0123")
+    rc = obs_main(["report", str(tmp_path / "events.jsonl"), "--merge",
+                   "--trace", "000000000000"])
+    assert rc == 1
+    assert "not found" in capsys.readouterr().out
+
+
+def test_render_trace_timeline_direct():
+    found = find_trace(
+        {"run": [{"kind": "trace", "tid": "abc", "rid": 1,
+                  "workload": "flow", "outcome": "served",
+                  "latency_ms": 12.0, "phases": {"dispatch": 12.0},
+                  "events": [{"name": "segment", "t_ms": 3.0, "n": 2}],
+                  "hops": [], "forced": []},
+                 {"kind": "trace", "tid": "zzz"},
+                 {"kind": "incident", "tid": "abc"}]}, "abc")
+    assert len(found) == 1 and found[0]["source"] == "run"
+    text = render_trace_timeline("abc", found)
+    assert "segment" in text and "dispatch" in text
