@@ -902,6 +902,15 @@ def main():
     # graphs as the single-server serving lane (the fleet is a routing
     # layer, not a new lowerable graph)
     lane_entries["fleet"] = "serve_forward"
+    # per-lane predicted peak HBM from graftlint engine 8's committed
+    # memory model (budgets.json "memory" section, keyed through the
+    # same lane -> entry map) — lands next to the measured watermark so
+    # the obs report can print predicted-vs-measured side by side;
+    # lanes whose entry carries no memory row are omitted
+    from raft_tpu.analysis.shard_audit import predicted_peak_map
+    predicted_peak = {lane: peak for lane, peak
+                      in predicted_peak_map(lane_entries).items()
+                      if peak is not None}
 
     if ledger is not None:
         ledger.close(summary=health.summary()
@@ -910,7 +919,8 @@ def main():
                         "fed_pairs_per_s_device": round(fed_dev, 3),
                         "fed_pairs_per_s_host":
                             round(fed_pairs_per_s_host, 3),
-                        "fed_lane": fed_lane}
+                        "fed_lane": fed_lane,
+                        "predicted_peak_hbm_bytes": predicted_peak}
                      | serve_metrics | q8_metrics
                      | fleet_metrics | stereo_metrics
                      | sdc_metrics
@@ -953,6 +963,10 @@ def main():
         **sdc_metrics,
         # which registered entry point each lane exercises
         "lane_entrypoints": lane_entries,
+        # engine 8's predicted peak bytes per lane (committed memory
+        # model; advisory next to the measured watermark — CPU hosts
+        # measure host RSS, not HBM)
+        "predicted_peak_hbm_bytes": predicted_peak,
         "host_cores": os.cpu_count(),
         "deferred_corr_grad": deferred,
         # which update-block implementation the headline (and the serve

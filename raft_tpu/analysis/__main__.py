@@ -38,6 +38,13 @@ Engine-specific extras:
   locks,incidents,exitcodes,terminals,threadio`` selects rule
   families).  Pure stdlib AST — never imports jax, so it needs no
   CPU-device forcing and finishes in seconds.
+- ``--engine shard`` runs the sharding & memory scale-readiness
+  auditor over the registered shard entries: sharding propagation
+  (``implicit-replication``, ``sharding-drop``), peak-HBM liveness vs
+  the ``memory`` section of ``budgets.json`` (with the ZeRO-headroom
+  report), collective/compute overlap on the ring entry's scheduled
+  HLO (``serialized-collective``), and ``missed-donation``;
+  ``--update-budgets`` re-baselines the memory ledger.
 - ``--prune-budgets`` previews the ledger rows a full
   ``--update-budgets`` run would drop (entries that no longer exist in
   the registry), then exits 0.
@@ -126,12 +133,13 @@ def collect_waivers(paths) -> list:
                 "scalar_only": w.scalar_only, "reason": w.reason})
 
     from raft_tpu.analysis import (hlo_audit, jaxpr_audit, numerics_audit,
-                                   quant_audit)
+                                   quant_audit, shard_audit)
 
     data_waivers("jaxpr", jaxpr_audit)
     data_waivers("hlo", hlo_audit)
     data_waivers("numerics", numerics_audit)
     data_waivers("quant", quant_audit)
+    data_waivers("shard", shard_audit)
     return out
 
 
@@ -150,12 +158,14 @@ def render_waivers(waivers) -> str:
             lines.append(f"{w['path']}:{w['line']}: {w['engine']} "
                          f"{w['invariant']} @ {w['provenance']}{scope} "
                          f"-- {w['reason']}")
-    n = {"lint": 0, "jaxpr": 0, "hlo": 0, "numerics": 0, "quant": 0}
+    n = {"lint": 0, "jaxpr": 0, "hlo": 0, "numerics": 0, "quant": 0,
+         "shard": 0}
     for w in waivers:
         n[w["engine"]] += 1
     lines.append(f"graftlint waivers: {n['lint']} lint ({stale} stale), "
                  f"{n['jaxpr']} jaxpr, {n['hlo']} hlo, "
-                 f"{n['numerics']} numerics, {n['quant']} quant")
+                 f"{n['numerics']} numerics, {n['quant']} quant, "
+                 f"{n['shard']} shard")
     return "\n".join(lines)
 
 
@@ -165,14 +175,14 @@ def main(argv=None) -> int:
         description="graftlint: AST lint + jaxpr audit + HLO "
                     "collective/cost audit + numerics/Pallas audit + "
                     "registry coverage audit + concurrency/incident "
-                    "audit for raft_tpu")
+                    "audit + sharding/memory audit for raft_tpu")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories for the AST engine "
                         "(default: raft_tpu/, scripts/, bench.py, "
                         "__graft_entry__.py)")
     p.add_argument("--engine",
                    choices=["lint", "jaxpr", "hlo", "numerics", "quant",
-                            "registry", "concurrency", "all"],
+                            "registry", "concurrency", "shard", "all"],
                    default="all")
     p.add_argument("--rules", default=None,
                    help="comma-separated lint rule ids to run "
@@ -205,12 +215,13 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.update_budgets and args.engine not in ("hlo", "numerics",
-                                                   "quant", "all"):
-        p.error("--update-budgets requires --engine hlo, numerics or "
-                "quant (or all)")
+                                                   "quant", "shard",
+                                                   "all"):
+        p.error("--update-budgets requires --engine hlo, numerics, "
+                "quant or shard (or all)")
 
     if args.engine in ("jaxpr", "hlo", "numerics", "quant", "registry",
-                       "all"):
+                       "shard", "all"):
         _force_cpu_with_virtual_devices()
 
     from raft_tpu.analysis import findings as fmod
@@ -279,6 +290,11 @@ def main(argv=None) -> int:
                 CHECKS as CONC_CHECKS
 
             known |= set(CONC_CHECKS)
+        if args.engine in ("shard", "all"):
+            from raft_tpu.analysis.shard_audit import \
+                ENTRIES as _SE, FIXTURE_ENTRIES as _SF
+
+            known |= set(_SE) | set(_SF)
         unknown = sorted(set(audits) - known)
         if unknown:
             p.error(f"unknown audit(s) {unknown}; known: {sorted(known)}")
@@ -301,12 +317,16 @@ def main(argv=None) -> int:
                 from raft_tpu.analysis.quant_audit import ENTRIES as _Q
 
                 budgetable |= {n for n, e in _Q.items() if e.budgeted}
+            if args.engine in ("shard", "all"):
+                from raft_tpu.analysis.shard_audit import ENTRIES as _S
+
+                budgetable |= {n for n, e in _S.items() if e.budgeted}
             if not any(a in budgetable for a in audits):
                 p.error("--update-budgets needs --audits to name at "
                         "least one hlo audit, pallas-carrying numerics "
-                        "audit or quant audit (or drop --audits to "
-                        "re-baseline everything) — nothing would be "
-                        "written")
+                        "audit, quant audit or shard audit (or drop "
+                        "--audits to re-baseline everything) — nothing "
+                        "would be written")
     all_findings = []
     report = {}
     timings = {}
@@ -425,11 +445,30 @@ def main(argv=None) -> int:
             all_findings += cfs
             report["concurrency"] = creport
         timings["concurrency"] = round(time.monotonic() - t0, 2)
+    if args.engine in ("shard", "all"):
+        from raft_tpu.utils.platform import ensure_platform
+
+        ensure_platform(strict=True)
+        t0 = time.monotonic()
+        from raft_tpu.analysis.shard_audit import ENTRIES as SENT, \
+            FIXTURE_ENTRIES as SFIX, run_shard_audit
+
+        shard_names = audits
+        if audits is not None:
+            shard_names = [a for a in audits
+                           if a in SENT or a in SFIX]
+        if shard_names != []:
+            sfs, sreport = run_shard_audit(
+                shard_names, budgets_path=args.budgets,
+                update=args.update_budgets)
+            all_findings += sfs
+            report["shard"] = sreport
+        timings["shard"] = round(time.monotonic() - t0, 2)
 
     report["engine_timings"] = timings
     # the merged per-engine summary scripts/graftlint.py --json
-    # aggregates across its seven subprocesses (satellite: one
-    # machine-readable verdict per engine, not six interleaved blobs)
+    # aggregates across its eight subprocesses (satellite: one
+    # machine-readable verdict per engine, not eight interleaved blobs)
     by_engine = {}
     for f in all_findings:
         by_engine.setdefault(f.engine, []).append(f)
@@ -446,6 +485,12 @@ def main(argv=None) -> int:
            else fmod.render_text(all_findings, report,
                                  verbose=args.verbose))
     print(out)
+    if not args.json and isinstance(report.get("shard"), dict):
+        from raft_tpu.analysis.shard_audit import render_zero_headroom
+
+        zh = render_zero_headroom(report["shard"])
+        if zh:
+            print(zh)
     if not args.json and timings:
         print("graftlint timings: " + " | ".join(
             f"{k}={v:.1f}s" for k, v in timings.items()))

@@ -333,6 +333,26 @@ def check_budgets(budgets_path: Optional[str] = None) -> List[Finding]:
                     f"raft_tpu.analysis --engine quant "
                     f"--update-budgets` and commit the diff",
             data={"section": "quant", "row": name}))
+
+    mem_sanctioned = set(registry.expected_budget_rows("memory"))
+    mem_rows = set(ledger.get("memory", {}))
+    for row in sorted(mem_rows - mem_sanctioned):
+        findings.append(Finding(
+            engine="registry", rule="orphan-budget", path=disp,
+            line=budgets_mod.budget_line(ledger_path, row),
+            message=f"memory row '{row}' maps to no registered shard "
+                    f"entry — prune it with a full `--engine shard "
+                    f"--update-budgets` run (or preview with "
+                    f"--prune-budgets)",
+            data={"section": "memory", "row": row}))
+    for name in sorted(mem_sanctioned - mem_rows):
+        findings.append(Finding(
+            engine="registry", rule="missing-budget", path=disp, line=0,
+            message=f"registered shard entry '{name}' has no memory "
+                    f"ledger row — run `python -m raft_tpu.analysis "
+                    f"--engine shard --update-budgets` and commit the "
+                    f"diff",
+            data={"section": "memory", "row": name}))
     return findings
 
 
@@ -343,6 +363,7 @@ def orphan_rows(budgets_path: Optional[str] = None) -> Dict[str, List[str]]:
     entries = set(registry.expected_budget_rows("entries"))
     pallas = set(registry.expected_budget_rows("pallas_vmem"))
     quant = set(registry.expected_budget_rows("quant"))
+    memory = set(registry.expected_budget_rows("memory"))
     return {
         "entries": sorted(r for r in ledger.get("entries", {})
                           if r not in entries),
@@ -350,6 +371,8 @@ def orphan_rows(budgets_path: Optional[str] = None) -> Dict[str, List[str]]:
                               if r.split("/", 1)[0] not in pallas),
         "quant": sorted(r for r in ledger.get("quant", {})
                         if r.split("/", 1)[0] not in quant),
+        "memory": sorted(r for r in ledger.get("memory", {})
+                         if r not in memory),
     }
 
 
@@ -428,6 +451,7 @@ def check_participation() -> List[Finding]:
         from raft_tpu.analysis.jaxpr_audit import ENTRY_AUDITS
         from raft_tpu.analysis.numerics_audit import ENTRIES as NUM
         from raft_tpu.analysis.quant_audit import ENTRIES as QUANT
+        from raft_tpu.analysis.shard_audit import ENTRIES as SHARD
     except Exception as e:
         # an engine module that no longer imports (e.g. a registry
         # audit kind without an implementation) is itself the finding
@@ -440,11 +464,12 @@ def check_participation() -> List[Finding]:
     mismatch("hlo", set(registry.hlo_entries()), set(HLO))
     mismatch("numerics", set(registry.numerics_entries()), set(NUM))
     mismatch("quant", set(registry.quant_entries()), set(QUANT))
+    mismatch("shard", set(registry.shard_entries()), set(SHARD))
     mismatch("jaxpr", set(registry.jaxpr_audit_names()),
              set(ENTRY_AUDITS))
     for name, entry in registry.ENTRYPOINTS.items():
         if not (entry.jaxpr or entry.hlo or entry.numerics
-                or entry.quant):
+                or entry.quant or entry.shard):
             findings.append(Finding(
                 engine="registry", rule="engine-participation",
                 path="raft_tpu/entrypoints.py", line=0,
@@ -496,13 +521,22 @@ def active_waiver_keys(paths: Sequence[str],
         from raft_tpu.analysis.quant_audit import run_quant_audit
 
         quant_findings, _ = run_quant_audit()
-    # engine-5/6/7 findings carry repo-relative display paths (absolute
-    # when outside the repo): resolve against the repo root
+    # engine 8 too: the reasoned baseline waivers it demands (the
+    # serialized ring collective, the data-parallel replicated
+    # optimizer state) must count as alive or this gate would order
+    # them deleted while engine 8 still fires at those lines.
+    shard_findings = []
+    if registry.shard_entries():
+        from raft_tpu.analysis.shard_audit import run_shard_audit
+
+        shard_findings, _ = run_shard_audit()
+    # engine-5/6/7/8 findings carry repo-relative display paths
+    # (absolute when outside the repo): resolve against the repo root
     root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     active |= {(os.path.abspath(os.path.join(root, f.path)), f.line)
                for f in list(extra_findings) + conc_findings
-               + quant_findings if f.waived}
+               + quant_findings + shard_findings if f.waived}
     return active
 
 

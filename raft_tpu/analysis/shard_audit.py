@@ -1,0 +1,1181 @@
+"""graftlint engine 8: the sharding & memory scale-readiness auditor.
+
+ROADMAP item 2 (pod-scale throughput: ZeRO-style optimizer-state
+sharding, ring collective/compute overlap) promises "engine gates keep
+the rewrite honest" — this engine is those gates, built BEFORE the
+rewrite so the baseline's waste is proven and pinned, not guessed.  It
+walks each registered shard entry (``registry.shard_entries()``) and
+asks four questions engines 2-7 cannot:
+
+- ``implicit-replication`` — which tensors at or above
+  :data:`REPLICATION_THRESHOLD_BYTES` are materialized fully
+  replicated along the data axis?  The propagation is a
+  dimension-witness abstract interpretation of the entry's jaxpr:
+  every input leaf is seeded from the entry's declared placement
+  recipe (``shard_placement``), and data-sharding survives an
+  equation only while a batch-sized dimension does (transpose /
+  broadcast_in_dim carry the dimension through their permutation
+  maps; a reduction that consumes it loses it — exactly what GSPMD
+  does to per-example gradients at the first contraction over batch).
+  Optimizer moments and gradients are the known offenders; the ONE
+  aggregated finding per entry (top offenders + total replicated
+  bytes) is the quantified ZeRO case (Rajbhandari et al. 2020), and
+  today's deliberate data-parallel baseline carries a reasoned inline
+  waiver at the entry anchor that the item-2 rewrite must retire.
+- ``sharding-drop`` — a ``with_sharding_constraint`` that discards a
+  live data-axis sharding (constrains a sharded tensor at or above
+  the threshold back to fully replicated) on a hot path.  Anchored at
+  the constraint's own provenance line.
+- ``serialized-collective`` — on the ring entry's scheduled HLO
+  (compiled under engine 3's pinned ``COMPILER_OPTIONS``), a
+  collective-permute with ZERO compute scheduled between its start
+  and done (a synchronous ``collective-permute`` instruction is
+  serialized by construction).  Today's CPU baseline schedules the
+  ring transfer synchronously — parallel/ring.py carries the one
+  reasoned waiver; the item-2 overlap rewrite must retire it.
+- ``missed-donation`` — an entry argument that dies after its first
+  use, matches an output's shape/dtype, and is not donated: a whole
+  buffer of HBM the executable holds for no reason.  Anchored at the
+  entry anchor (the production builder's def line).
+
+The same walk yields the **peak-HBM memory model**: a linear-scan
+live-range analysis over the flattened equation list (control flow
+inlined: one scan/while iteration models the steady state; stacked
+``ys`` and carries keep their full avals), per-process bytes (a
+data-sharded buffer counts ``ceil(dim/data)`` of its sharded
+dimension), predicted peak with top-k live-buffer attribution, and
+the **ZeRO-headroom report** — per-process bytes reclaimable were the
+optimizer state (the ``mu``/``nu`` moment leaves) sharded over the
+data axis.  Each entry's model lands in the ``memory`` section of
+``analysis/budgets.json`` (exact-integer rows; same merge/prune/drift
+semantics as the ``quant`` ledger, engine-5 orphan/missing
+cross-check included), and bench.py republishes
+``predicted_peak_hbm_bytes`` per lane from the committed rows via
+:func:`predicted_peak_map`.
+
+``FIXTURE_ENTRIES`` are deliberately-broken programs (a 2 MiB
+replicated weight, a constraint that drops a live sharding, a ring
+permute with nothing to overlap, an undonated dying argument); they
+never run by default — tests select them with ``--audits`` to prove
+each rule trips with exit 1 and file:line attribution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import json
+import os
+import re
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu import entrypoints as registry
+from raft_tpu.analysis import budgets as budgets_mod
+from raft_tpu.analysis.findings import Finding
+from raft_tpu.analysis.jaxpr_audit import (JaxprWaiver, apply_data_waivers,
+                                           provenance)
+from raft_tpu.analysis.numerics_audit import _dtype_str, finding_anchor
+
+ALL_SHARD_RULES = frozenset({"implicit-replication", "sharding-drop",
+                             "serialized-collective", "missed-donation"})
+
+# A replicated buffer smaller than this is noise (biases, scalars,
+# norm stats); at or above it, replication along the data axis is a
+# scale-readiness finding.  1 MiB: every moment/grad/param tensor of
+# the production model clears it, every LayerNorm scale does not.
+REPLICATION_THRESHOLD_BYTES = 1 << 20
+
+# Donating a tiny buffer buys nothing and the finding would be noise.
+DONATION_MIN_BYTES = 1 << 10
+
+# Live buffers reported in the peak attribution.
+TOP_K = 5
+
+# The data-axis size every model in this engine divides by — the
+# registry's AUDIT_MESH data axis (single source: entrypoints.py).
+DATA_AXIS_SIZE = dict(registry.AUDIT_MESH)["data"]
+
+# HLO opcodes that are bookkeeping, not compute — they do not count as
+# "overlapping work" between a collective's start and done.
+_NON_COMPUTE_OPS = frozenset({
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "copy", "after-all", "add-dependency", "partition-id", "replica-id",
+    "collective-permute-start", "collective-permute-done"})
+
+# Optimizer-moment leaf detector, shared with the ZeRO-headroom
+# arithmetic: AdamW's mu/nu trees (keystr yields ".mu"/"['nu']"
+# segments depending on container type; \b keeps mu_conv etc. out).
+_OPT_STATE_RE = re.compile(r"\b(mu|nu)\b")
+
+# No data waivers at HEAD: the deliberate-baseline findings
+# (parallel_step's replicated optimizer state, corr_ring's serialized
+# permute) are waived INLINE at their anchors — the shared
+# ``# graftlint: disable=`` syntax engine 5's staleness gate tracks —
+# so retiring them in the item-2 rewrite deletes a comment next to
+# the code that changes, not a row in this file.
+WAIVERS: Tuple[JaxprWaiver, ...] = ()
+
+
+def _aval_bytes(aval) -> int:
+    """Global (unsharded) byte size of an abstract value; 0 when the
+    aval has no array shape (tokens, opaque extended dtypes)."""
+    import numpy as np
+
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    try:
+        item = int(np.dtype(aval.dtype).itemsize)
+    except (TypeError, ValueError):
+        item = int(getattr(getattr(aval, "dtype", None), "itemsize", 0)
+                   or 4)
+    n = item
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _human(n: int) -> str:
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024 or unit == "GiB":
+            return f"{int(v)}B" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024
+    return f"{n}B"
+
+
+def zero_headroom(args, data_size: int = DATA_AXIS_SIZE
+                  ) -> Tuple[int, int]:
+    """(optimizer-state bytes, per-process bytes reclaimable were that
+    state sharded over the data axis) for an entry's argument tree.
+
+    The moments are found structurally (``mu``/``nu`` path segments —
+    AdamW's trees); reclaimable = ``opt * (data-1)/data`` exactly, in
+    integer bytes.  This IS the arithmetic the ZeRO-headroom report
+    prints and the toy-entry test pins.
+    """
+    import jax
+
+    opt = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(args)[0]:
+        if _OPT_STATE_RE.search(jax.tree_util.keystr(path)):
+            opt += _aval_bytes(leaf)
+    return opt, opt * (data_size - 1) // data_size
+
+
+# --------------------------------------------------------------------------
+# placement recipes (how an entry's inputs arrive on the mesh)
+# --------------------------------------------------------------------------
+
+def _leaf_count(tree) -> int:
+    import jax
+
+    return len(jax.tree_util.tree_leaves(tree))
+
+
+def _placements_state_batch(args) -> List[Optional[int]]:
+    """``(state, batch)`` calling convention (parallel_step): the train
+    state (params + AdamW moments + step count) arrives replicated,
+    every batch leaf sharded on its leading (batch) dimension — the
+    pure data-parallel baseline this engine exists to quantify."""
+    out: List[Optional[int]] = []
+    for i, a in enumerate(args):
+        out.extend([None if i == 0 else 0] * _leaf_count(a))
+    return out
+
+
+def _placements_batch(args) -> List[Optional[int]]:
+    """Every leaf batch-sharded on dim 0."""
+    return [0] * sum(_leaf_count(a) for a in args)
+
+
+def _placements_first_replicated(args) -> List[Optional[int]]:
+    """Fixture recipe: arg 0 replicated, the rest sharded on dim 0."""
+    out: List[Optional[int]] = []
+    for i, a in enumerate(args):
+        out.extend([None if i == 0 else 0] * _leaf_count(a))
+    return out
+
+
+PLACEMENT_RECIPES: Dict[str, Callable] = {
+    "state_batch": _placements_state_batch,
+    "batch": _placements_batch,
+    "first_replicated": _placements_first_replicated,
+}
+
+
+# --------------------------------------------------------------------------
+# the graph model: one walk yields sharding, liveness and donation facts
+# --------------------------------------------------------------------------
+
+_CALL_PRIMS = ("pjit", "closed_call", "core_call", "remat", "remat2",
+               "checkpoint", "custom_jvp_call", "custom_vjp_call",
+               "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
+               "shard_map", "custom_partitioning")
+
+
+class _GraphModel:
+    """Flattens a closed jaxpr (control flow inlined once) into a
+    linear op sequence over buffer cells, tracking per-cell sharded
+    dimension, live range, byte size and use count — the single walk
+    behind the implicit-replication, sharding-drop, missed-donation
+    rules AND the peak-HBM liveness model."""
+
+    def __init__(self, data_size: int = DATA_AXIS_SIZE):
+        self.data_size = data_size
+        self.avals: List = []
+        self.sdim: List[Optional[int]] = []
+        self.label: List[str] = []
+        self.born: List[int] = []
+        self.last: List[int] = []
+        self.uses: List[int] = []
+        self.is_input: List[bool] = []
+        self.idx = 1                      # 0 is reserved for inputs
+        self.eqn_count = 0
+        # (eqn, size) of constraints that dropped a live data sharding
+        self.drops: List[Tuple[object, int]] = []
+
+    # -- cells -------------------------------------------------------------
+
+    def _new_cell(self, aval, sdim: Optional[int], label: str,
+                  born: Optional[int] = None,
+                  is_input: bool = False) -> int:
+        cid = len(self.avals)
+        self.avals.append(aval)
+        self.sdim.append(sdim)
+        self.label.append(label)
+        b = self.idx if born is None else born
+        self.born.append(b)
+        self.last.append(b)
+        self.uses.append(0)
+        self.is_input.append(is_input)
+        return cid
+
+    def cell_bytes(self, cid: int) -> int:
+        """Per-process bytes: a data-sharded buffer holds
+        ceil(dim/data) of its sharded dimension."""
+        aval = self.avals[cid]
+        total = _aval_bytes(aval)
+        d = self.sdim[cid]
+        shape = getattr(aval, "shape", None)
+        if d is None or not shape or not (0 <= d < len(shape)):
+            return total
+        dim = int(shape[d])
+        if dim <= 0:
+            return total
+        return total // dim * (-(-dim // self.data_size))
+
+    # -- var resolution ----------------------------------------------------
+
+    @staticmethod
+    def _is_literal(v) -> bool:
+        return hasattr(v, "val") and not hasattr(v, "count")
+
+    @staticmethod
+    def _is_drop(v) -> bool:
+        return type(v).__name__ == "DropVar"
+
+    def _cell_of(self, env: Dict, v) -> Optional[int]:
+        if self._is_literal(v):
+            return None
+        return env.get(v)
+
+    def _use(self, cid: Optional[int]) -> None:
+        if cid is None:
+            return
+        self.uses[cid] += 1
+        if self.idx > self.last[cid]:
+            self.last[cid] = self.idx
+
+    # -- sharding transfer -------------------------------------------------
+
+    def _out_sdim(self, eqn, in_avals, in_sdims, out_aval
+                  ) -> Optional[int]:
+        """Dimension-witness propagation: the output stays data-sharded
+        only while the sharded dimension survives, carried through the
+        few primitives that move dimensions explicitly."""
+        p = eqn.primitive.name
+        src = None
+        for aval, d in zip(in_avals, in_sdims):
+            if d is not None and getattr(aval, "shape", None):
+                src = (aval, d)
+                break
+        if src is None:
+            return None
+        aval, d = src
+        size = int(aval.shape[d])
+        out_shape = getattr(out_aval, "shape", None)
+        if not out_shape:
+            return None
+        if p == "transpose":
+            perm = list(eqn.params.get("permutation", ()))
+            if d in perm:
+                nd = perm.index(d)
+                if nd < len(out_shape) and int(out_shape[nd]) == size:
+                    return nd
+            return None
+        if p == "broadcast_in_dim":
+            bd = list(eqn.params.get("broadcast_dimensions", ()))
+            if d < len(bd):
+                nd = int(bd[d])
+                if nd < len(out_shape) and int(out_shape[nd]) == size:
+                    return nd
+            return None
+        if d < len(out_shape) and int(out_shape[d]) == size \
+                and tuple(aval.shape[:d]) == tuple(out_shape[:d]):
+            return d
+        return None
+
+    @staticmethod
+    def _constraint_axes(sharding) -> Optional[frozenset]:
+        """Mesh axes a with_sharding_constraint pins, or None when the
+        sharding object carries no recoverable spec (legacy GSPMD
+        blobs) — in which case the check abstains."""
+        spec = getattr(sharding, "spec", None)
+        if spec is None:
+            return None
+        axes = set()
+        for entry in tuple(spec):
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                axes.update(a for a in entry if a)
+            else:
+                axes.add(entry)
+        return frozenset(axes)
+
+    # -- the walk ----------------------------------------------------------
+
+    def _bind_out(self, env: Dict, ov, cid: int) -> None:
+        if not self._is_drop(ov):
+            env[ov] = cid
+
+    def _leaf_eqn(self, eqn, env: Dict) -> None:
+        self.eqn_count += 1
+        in_cells = [self._cell_of(env, v) for v in eqn.invars]
+        for cid in in_cells:
+            self._use(cid)
+        in_avals = [getattr(v, "aval", None) for v in eqn.invars]
+        in_sdims = [None if c is None else self.sdim[c]
+                    for c in in_cells]
+        p = eqn.primitive.name
+        constraint_axes = None
+        if p == "sharding_constraint":
+            constraint_axes = self._constraint_axes(
+                eqn.params.get("sharding"))
+            src = in_cells[0] if in_cells else None
+            if (constraint_axes is not None and not constraint_axes
+                    and src is not None and self.sdim[src] is not None
+                    and _aval_bytes(self.avals[src])
+                    >= REPLICATION_THRESHOLD_BYTES):
+                self.drops.append((eqn, _aval_bytes(self.avals[src])))
+        for ov in eqn.outvars:
+            aval = getattr(ov, "aval", None)
+            d = self._out_sdim(eqn, in_avals, in_sdims, aval)
+            if constraint_axes is not None and "data" not in \
+                    constraint_axes:
+                d = None
+            cid = self._new_cell(aval, d,
+                                 f"{_dtype_str(aval)}"
+                                 f"{list(getattr(aval, 'shape', ()))} "
+                                 f"{p}")
+            self._bind_out(env, ov, cid)
+        self.idx += 1
+
+    def _inline(self, closed, outer_in: List[Optional[int]],
+                env_out: Dict, eqn_outvars, label: str) -> Dict:
+        """Generic call inlining: sub invars alias the caller's cells
+        (tail-aligned — hoisted consts get fresh cells), sub outvars
+        alias back to the caller's outvars."""
+        import jax._src.core as jcore
+
+        if not isinstance(closed, jcore.ClosedJaxpr):
+            closed = jcore.ClosedJaxpr(closed, ())
+        j = closed.jaxpr
+        env2: Dict = {}
+        for cv in j.constvars:
+            env2[cv] = self._new_cell(getattr(cv, "aval", None), None,
+                                      f"const ({label})")
+        n = min(len(j.invars), len(outer_in))
+        for sv, cid in zip(j.invars[-n:], outer_in[-n:]):
+            env2[sv] = cid if cid is not None else self._new_cell(
+                getattr(sv, "aval", None), None, f"arg ({label})")
+        for sv in j.invars[:len(j.invars) - n]:
+            env2[sv] = self._new_cell(getattr(sv, "aval", None), None,
+                                      f"const ({label})")
+        self._walk(j, env2)
+        if eqn_outvars is not None:
+            for ov, sv in zip(eqn_outvars, j.outvars):
+                cid = self._cell_of(env2, sv)
+                if cid is None:
+                    cid = self._new_cell(getattr(sv, "aval", None),
+                                         None, f"out ({label})")
+                self._bind_out(env_out, ov, cid)
+        return env2
+
+    def _scan_eqn(self, eqn, env: Dict) -> None:
+        closed = eqn.params["jaxpr"]
+        nc = int(eqn.params.get("num_consts", 0))
+        ncar = int(eqn.params.get("num_carry", 0))
+        j = closed.jaxpr
+        # consts and carry alias straight through (their real uses are
+        # the leaf eqns inside the body); only the STACKED xs buffers
+        # get a call-site use, below, because the scan streams them
+        # until its end
+        in_cells = [self._cell_of(env, v) for v in eqn.invars]
+        env2: Dict = {}
+        for cv in j.constvars:
+            env2[cv] = self._new_cell(getattr(cv, "aval", None), None,
+                                      "const (scan)")
+        for sv, cid in zip(j.invars[:nc + ncar], in_cells[:nc + ncar]):
+            env2[sv] = cid if cid is not None else self._new_cell(
+                getattr(sv, "aval", None), None, "arg (scan)")
+        # xs slices: fresh per-iteration cells; the STACKED buffer stays
+        # live through the scan via the outer cell's use above
+        for sv, cid in zip(j.invars[nc + ncar:], in_cells[nc + ncar:]):
+            xs_d = None if cid is None else self.sdim[cid]
+            d = None if xs_d in (None, 0) else xs_d - 1
+            env2[sv] = self._new_cell(getattr(sv, "aval", None), d,
+                                      "slice (scan)")
+        self._walk(j, env2)
+        for cid in in_cells[nc + ncar:]:
+            self._use(cid)
+        for ov, sv in zip(eqn.outvars[:ncar], j.outvars[:ncar]):
+            cid = self._cell_of(env2, sv)
+            if cid is None:
+                cid = self._new_cell(getattr(sv, "aval", None), None,
+                                     "carry (scan)")
+            self._bind_out(env, ov, cid)
+        for ov, sv in zip(eqn.outvars[ncar:], j.outvars[ncar:]):
+            y_cid = self._cell_of(env2, sv)
+            y_d = None if y_cid is None else self.sdim[y_cid]
+            d = None if y_d is None else y_d + 1
+            cid = self._new_cell(getattr(ov, "aval", None), d,
+                                 f"{_dtype_str(getattr(ov, 'aval', None))}"
+                                 f"{list(getattr(ov.aval, 'shape', ()))} "
+                                 f"scan-ys")
+            self._bind_out(env, ov, cid)
+
+    def _while_eqn(self, eqn, env: Dict) -> None:
+        bj = eqn.params["body_jaxpr"]
+        cn = int(eqn.params.get("cond_nconsts", 0))
+        bn = int(eqn.params.get("body_nconsts", 0))
+        in_cells = [self._cell_of(env, v) for v in eqn.invars]
+        body_in = in_cells[cn:cn + bn] + in_cells[cn + bn:]
+        env2 = self._inline(bj, body_in, env, None, "while")
+        for ov, sv in zip(eqn.outvars, bj.jaxpr.outvars):
+            cid = self._cell_of(env2, sv)
+            if cid is None:
+                cid = self._new_cell(getattr(ov, "aval", None), None,
+                                     "carry (while)")
+            self._bind_out(env, ov, cid)
+
+    def _cond_eqn(self, eqn, env: Dict) -> None:
+        branches = eqn.params["branches"]
+        in_cells = [self._cell_of(env, v) for v in eqn.invars]
+        if in_cells:
+            self._use(in_cells[0])    # the predicate IS consumed here
+        self._inline(branches[0], in_cells[1:], env, eqn.outvars,
+                     "cond")
+
+    def _walk(self, jaxpr, env: Dict) -> None:
+        for eqn in jaxpr.eqns:
+            p = eqn.primitive.name
+            if p == "scan":
+                self._scan_eqn(eqn, env)
+            elif p == "while":
+                self._while_eqn(eqn, env)
+            elif p == "cond":
+                self._cond_eqn(eqn, env)
+            elif p in _CALL_PRIMS:
+                sub = (eqn.params.get("jaxpr")
+                       or eqn.params.get("call_jaxpr")
+                       or eqn.params.get("fun_jaxpr"))
+                if sub is None:
+                    self._leaf_eqn(eqn, env)
+                    continue
+                # no call-site use: aliasing through a call boundary is
+                # transparent — the real uses (and last-use times) are
+                # the leaf eqns inside the inlined body, which is what
+                # makes "dies after first use" mean the same thing at
+                # every nesting depth
+                in_cells = [self._cell_of(env, v) for v in eqn.invars]
+                self._inline(sub, in_cells, env, eqn.outvars, p)
+            else:
+                self._leaf_eqn(eqn, env)
+
+    def run(self, closed, arg_labels: Sequence[str],
+            placements: Optional[Sequence[Optional[int]]]) -> None:
+        j = closed.jaxpr
+        env: Dict = {}
+        self.input_cells: List[int] = []
+        pl = list(placements or [])
+        if len(pl) != len(j.invars):
+            pl = [None] * len(j.invars)
+        labels = list(arg_labels)
+        if len(labels) != len(j.invars):
+            labels = [f"arg{i}" for i in range(len(j.invars))]
+        for cv in j.constvars:
+            self._new_cell(getattr(cv, "aval", None), None, "const",
+                           born=0)
+        for v, d, lab in zip(j.invars, pl, labels):
+            cid = self._new_cell(getattr(v, "aval", None), d, lab,
+                                 born=0, is_input=True)
+            env[v] = cid
+            self.input_cells.append(cid)
+        self._walk(j, env)
+        self.output_cells: List[int] = []
+        for ov in j.outvars:
+            cid = self._cell_of(env, ov)
+            if cid is not None:
+                self.last[cid] = self.idx
+                self.output_cells.append(cid)
+
+    # -- derived facts -----------------------------------------------------
+
+    def peak(self) -> Tuple[int, int, List[Tuple[int, int]]]:
+        """(peak bytes, peak index, [(cell, bytes)] live at the peak,
+        largest first)."""
+        n = self.idx + 2
+        delta = [0] * n
+        for cid in range(len(self.avals)):
+            b = self.cell_bytes(cid)
+            if not b:
+                continue
+            delta[self.born[cid]] += b
+            delta[min(self.last[cid] + 1, n - 1)] -= b
+        peak, peak_idx, cur = 0, 0, 0
+        for i in range(n):
+            cur += delta[i]
+            if cur > peak:
+                peak, peak_idx = cur, i
+        live = [(cid, self.cell_bytes(cid))
+                for cid in range(len(self.avals))
+                if self.born[cid] <= peak_idx <= self.last[cid]
+                and self.cell_bytes(cid)]
+        live.sort(key=lambda t: (-t[1], t[0]))
+        return peak, peak_idx, live
+
+    def replicated(self) -> List[Tuple[int, int]]:
+        """[(cell, global bytes)] at/above the threshold NOT sharded
+        over the data axis, largest first."""
+        out = [(cid, _aval_bytes(self.avals[cid]))
+               for cid in range(len(self.avals))
+               if self.sdim[cid] is None
+               and _aval_bytes(self.avals[cid])
+               >= REPLICATION_THRESHOLD_BYTES]
+        out.sort(key=lambda t: (-t[1], t[0]))
+        return out
+
+
+# --------------------------------------------------------------------------
+# overlap audit (scheduled-HLO side)
+# --------------------------------------------------------------------------
+
+def overlap_from_hlo(text: str) -> Dict:
+    """Schedule distance between each collective-permute start/done
+    pair in an optimized HLO module text.  A synchronous
+    ``collective-permute`` instruction (what a backend emits when it
+    does not split the collective) is a zero-overlap pair by
+    construction.  Returns ``{"pairs": n, "serialized": k,
+    "gaps": [...]}}`` — ``gaps`` is compute-ops-between per pair."""
+    from raft_tpu.analysis.hlo_audit import _INSTR_RE
+
+    gaps: List[int] = []
+    open_counts: List[int] = []
+    for line in text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        op = m.group(1)
+        if op == "collective-permute-start":
+            open_counts.append(0)
+        elif op == "collective-permute-done":
+            if open_counts:
+                gaps.append(open_counts.pop(0))
+        elif op == "collective-permute":
+            gaps.append(0)
+        elif op not in _NON_COMPUTE_OPS and open_counts:
+            open_counts = [c + 1 for c in open_counts]
+    return {"pairs": len(gaps),
+            "serialized": sum(1 for g in gaps if g == 0),
+            "gaps": gaps}
+
+
+# --------------------------------------------------------------------------
+# the memory ledger
+# --------------------------------------------------------------------------
+
+_ROW_FIELDS = ("peak_bytes", "args_bytes", "out_bytes",
+               "replicated_bytes", "zero_headroom_bytes",
+               "buffers_at_peak")
+
+
+def compare_memory_budgets(measurements: Dict[str, Dict],
+                           budgets_path: Optional[str] = None,
+                           update: bool = False,
+                           full_run: bool = False
+                           ) -> Tuple[List[Finding], Dict]:
+    """Measured memory models vs the ledger's ``memory`` section.
+
+    Rows key on the entry name exactly (like ``entries``); every field
+    is a deterministic integer, so comparison is exact — any drift is
+    ``stale-memory-model`` at the ledger line (the graph the row
+    modeled no longer exists).  ``update=True`` merge-writes the
+    section; with ``full_run`` the write also prunes rows whose entry
+    left the registry, each dropped row a note finding — engine 5's
+    prune semantics applied to the memory model.
+    """
+    if not measurements and not update:
+        return [], {}
+    ledger_path = budgets_path or budgets_mod.default_budgets_path()
+    ledger = budgets_mod.load_budgets(ledger_path) or {}
+    section = ledger.get("memory", {})
+    findings: List[Finding] = []
+    report: Dict = {}
+
+    clean = {k: {f: v for f, v in rec.items() if not f.startswith("_")}
+             for k, rec in measurements.items()}
+    report["measured"] = clean
+
+    if update:
+        if not clean:
+            report["budgets_written"] = {"rows": []}
+            return findings, report
+        prune: List[str] = []
+        if full_run:
+            sanctioned = set(registry.expected_budget_rows("memory"))
+            for row in sorted(section):
+                if row in clean or row in sanctioned:
+                    continue
+                prune.append(row)
+                findings.append(Finding(
+                    engine="shard", rule="budget-pruned",
+                    path=budgets_mod.display_path(ledger_path),
+                    line=budgets_mod.budget_line(ledger_path, row),
+                    message=f"pruned memory row '{row}' — its entry "
+                            f"left the registry; dropped record: "
+                            f"{json.dumps(section[row], sort_keys=True)}",
+                    severity="note", data={"row": row}))
+        meta = ledger.get("meta") or {}
+        budgets_mod.save_budgets(ledger_path, meta or None, clean,
+                                 section="memory", prune=prune)
+        report["budgets_written"] = {
+            "path": budgets_mod.display_path(ledger_path),
+            "rows": sorted(clean),
+            "pruned": prune}
+        return findings, report
+
+    disp = budgets_mod.display_path(ledger_path)
+    for key, m in sorted(clean.items()):
+        rec = section.get(key)
+        if rec is None:
+            findings.append(Finding(
+                engine="shard", rule="budget-missing", path=disp,
+                line=0,
+                message=f"entry '{key}' has no memory ledger row — "
+                        f"run `python -m raft_tpu.analysis --engine "
+                        f"shard --update-budgets` and commit the "
+                        f"budgets.json diff",
+                data={"row": key}))
+            continue
+        drifts = [f"{f} {rec.get(f)} -> {m.get(f)}"
+                  for f in sorted(set(m) | set(rec))
+                  if m.get(f) != rec.get(f)]
+        if drifts:
+            findings.append(Finding(
+                engine="shard", rule="stale-memory-model", path=disp,
+                line=budgets_mod.budget_line(ledger_path, key),
+                message=f"{key}: memory model drifted "
+                        f"({'; '.join(drifts)}) — the graph this row "
+                        f"modeled no longer exists; re-baseline with "
+                        f"`--engine shard --update-budgets` and "
+                        f"re-review the diff",
+                data={"row": key, "drift": drifts}))
+
+    sanctioned = set(registry.expected_budget_rows("memory"))
+    stale: List[str] = []
+    for row in sorted(section):
+        if row in clean:
+            continue
+        if row not in sanctioned:
+            findings.append(Finding(
+                engine="shard", rule="stale-memory-model", path=disp,
+                line=budgets_mod.budget_line(ledger_path, row),
+                message=f"memory row '{row}' models nothing — its "
+                        f"entry left the registry; prune it with a "
+                        f"full `--engine shard --update-budgets` run",
+                data={"row": row}))
+        else:
+            stale.append(row)
+    if stale and clean:
+        report["not_measured"] = stale
+    return findings, report
+
+
+def predicted_peak_map(lane_entries: Dict[str, str],
+                       budgets_path: Optional[str] = None
+                       ) -> Dict[str, Optional[int]]:
+    """lane -> predicted peak HBM bytes from the COMMITTED ``memory``
+    ledger rows (no tracing: bench.py stamps this next to the measured
+    watermark each run; a lane whose entry has no row maps to None)."""
+    ledger = budgets_mod.load_budgets(
+        budgets_path or budgets_mod.default_budgets_path()) or {}
+    mem = ledger.get("memory", {})
+    return {lane: mem.get(entry, {}).get("peak_bytes")
+            for lane, entry in sorted(lane_entries.items())}
+
+
+# --------------------------------------------------------------------------
+# entries
+# --------------------------------------------------------------------------
+
+SkipEntry = registry.SkipEntry
+
+
+def _fn_anchor(fn) -> Tuple[str, int]:
+    try:
+        path = inspect.getsourcefile(fn)
+        line = inspect.getsourcelines(fn)[1]
+        return budgets_mod.display_path(path), line
+    except (OSError, TypeError):
+        return "raft_tpu/analysis/shard_audit.py", 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardEntry:
+    name: str
+    builder: Callable[[], Tuple]      # () -> (fn, args[, ctx])
+    anchor: Callable[[], Tuple[str, int]]
+    placement: Optional[str] = None   # PLACEMENT_RECIPES key; None =
+    #                                   propagation family off
+    overlap: bool = False             # compile + schedule-distance audit
+    donated: bool = False             # builder already donates its args
+    rules: frozenset = ALL_SHARD_RULES
+    budgeted: bool = True             # fixtures never get ledger rows
+
+
+def _from_registry(e: "registry.EntryPoint") -> ShardEntry:
+    def build():
+        fn, args = e.build()
+        if e.needs_mesh:
+            return fn, args, registry.trace_context(e)
+        return fn, args
+
+    return ShardEntry(
+        e.name, build,
+        anchor=lambda e=e: registry.entry_anchor(e),
+        placement=e.shard_placement,
+        overlap="collective-permute" in e.require,
+        donated=e.donated, budgeted=e.budgeted)
+
+
+# entry enumeration — derived from raft_tpu/entrypoints.py (engine 5
+# cross-checks this derivation against the declared participation)
+ENTRIES: Dict[str, ShardEntry] = {
+    name: _from_registry(e)
+    for name, e in registry.shard_entries().items()}
+
+
+# --------------------------------------------------------------------------
+# seeded fixtures — deliberately broken, never run by default
+# --------------------------------------------------------------------------
+
+def _fixture_shard_replicated():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(w, x):
+        # the 2 MiB weight rides along fully replicated while the
+        # batch is sharded — the ZeRO shape of waste, in miniature
+        return w * 2.0, x + 1.0
+
+    w = jax.ShapeDtypeStruct((512, 1024), jnp.float32)   # 2 MiB
+    x = jax.ShapeDtypeStruct((4, 1024), jnp.float32)
+    return jax.jit(fn), (w, x)
+
+
+def _fixture_shard_drop():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = registry.audit_mesh()
+    repl = NamedSharding(mesh, P())
+
+    def fn(x):
+        # the input arrives batch-sharded; this constraint gathers the
+        # full 4 MiB onto every device for no stated reason
+        return jax.lax.with_sharding_constraint(x * 2.0, repl) + 1.0
+
+    x = jax.ShapeDtypeStruct((8, 512, 256), jnp.float32)  # 4 MiB
+    from raft_tpu.parallel.mesh import set_mesh
+
+    return jax.jit(fn), (x,), set_mesh(mesh)
+
+
+def _fixture_shard_serialized():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = registry.audit_mesh()
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:                            # newer spelling
+        from jax.experimental import shard_map as _sm
+        shard_map = _sm.shard_map
+    data = mesh.shape["data"]
+    perm = [(i, (i + 1) % data) for i in range(data)]
+
+    def body(x):
+        # a ring hop with NOTHING scheduled between start and done —
+        # the serialized baseline this rule exists to flag
+        return jax.lax.ppermute(x, "data", perm)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"),
+                           out_specs=P("data"), check_rep=False))
+    x = jax.ShapeDtypeStruct((8, 64), jnp.float32)
+    from raft_tpu.parallel.mesh import set_mesh
+
+    return fn, (x,), set_mesh(mesh)
+
+
+def _fixture_shard_nodonate():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x, y):
+        # x dies after this one add and the first output has its exact
+        # shape/dtype — an alias the executable never gets
+        return x + 1.0, jnp.sum(y)
+
+    x = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    y = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    return jax.jit(fn), (x, y)
+
+
+FIXTURE_ENTRIES: Dict[str, ShardEntry] = {
+    # each fixture runs ONLY its own rule family, so the test that
+    # selects it proves exactly one rule fires (and nothing else rides
+    # along when a fixture trips a second family incidentally)
+    "seeded_shard_replicated": ShardEntry(
+        "seeded_shard_replicated", _fixture_shard_replicated,
+        anchor=lambda: _fn_anchor(_fixture_shard_replicated),
+        placement="first_replicated", budgeted=False,
+        rules=frozenset({"implicit-replication"})),
+    "seeded_shard_drop": ShardEntry(
+        "seeded_shard_drop", _fixture_shard_drop,
+        anchor=lambda: _fn_anchor(_fixture_shard_drop),
+        placement="batch", budgeted=False,
+        rules=frozenset({"sharding-drop"})),
+    "seeded_shard_serialized": ShardEntry(
+        "seeded_shard_serialized", _fixture_shard_serialized,
+        anchor=lambda: _fn_anchor(_fixture_shard_serialized),
+        overlap=True, budgeted=False,
+        rules=frozenset({"serialized-collective"})),
+    "seeded_shard_nodonate": ShardEntry(
+        "seeded_shard_nodonate", _fixture_shard_nodonate,
+        anchor=lambda: _fn_anchor(_fixture_shard_nodonate),
+        budgeted=False,
+        rules=frozenset({"missed-donation"})),
+}
+
+
+# --------------------------------------------------------------------------
+# the audit
+# --------------------------------------------------------------------------
+
+def _note(entry: str, message: str) -> Finding:
+    return Finding(engine="shard", rule="shard-audit", path=entry,
+                   line=0, message=message, severity="note")
+
+
+def _entry_finding(entry: ShardEntry, rule: str, message: str,
+                   data: Optional[Dict] = None) -> Finding:
+    path, line = entry.anchor()
+    return Finding(engine="shard", rule=rule, path=path, line=line,
+                   message=f"{entry.name}: {message}",
+                   data=dict(data or {}, entry=entry.name))
+
+
+def _apply_inline_waivers(findings: List[Finding]) -> List[Finding]:
+    """Apply the shared ``# graftlint: disable=`` syntax against each
+    finding's own file (engine 6's convention): the waived
+    serialized-collective / implicit-replication findings ARE the
+    reasoned baseline waivers ROADMAP item 2 must retire, and engine
+    5's stale-waiver gate counts them as active."""
+    from raft_tpu.analysis.lint import apply_waivers, parse_waivers
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for rel, fs in by_path.items():
+        ap = rel if os.path.isabs(rel) else os.path.join(root, rel)
+        try:
+            with open(os.path.abspath(ap), encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            out += fs
+            continue
+        waivers, _ = parse_waivers(source, ap)
+        out += apply_waivers(fs, waivers)
+    return out
+
+
+def _apply_waivers(findings: List[Finding]) -> List[Finding]:
+    return _apply_inline_waivers(apply_data_waivers(findings, WAIVERS))
+
+
+def _arg_labels(args) -> List[str]:
+    import jax
+
+    return ["arg" + (jax.tree_util.keystr(path) or str(i))
+            for i, (path, _) in enumerate(
+                jax.tree_util.tree_flatten_with_path(args)[0])]
+
+
+def _check_replication(entry: ShardEntry, model: _GraphModel,
+                       findings: List[Finding]) -> int:
+    repl = model.replicated()
+    total = sum(b for _, b in repl)
+    if repl and "implicit-replication" in entry.rules:
+        top = ", ".join(
+            f"{model.label[cid].strip()}={_human(b)}"
+            for cid, b in repl[:TOP_K])
+        findings.append(_entry_finding(
+            entry, "implicit-replication",
+            f"{len(repl)} tensor(s) >= "
+            f"{_human(REPLICATION_THRESHOLD_BYTES)} materialize fully "
+            f"replicated along the data axis ({_human(total)} total "
+            f"per process; top: {top}) — ZeRO-shard the optimizer "
+            f"state / grads over 'data' (ROADMAP item 2) or waive the "
+            f"deliberate data-parallel baseline here",
+            data={"replicated": len(repl), "bytes": total}))
+    return total
+
+
+def _check_drops(entry: ShardEntry, model: _GraphModel,
+                 findings: List[Finding]) -> None:
+    if "sharding-drop" not in entry.rules:
+        return
+    for eqn, size in model.drops:
+        prov = provenance(eqn)
+        path, line = finding_anchor(prov)
+        if not line:
+            path, line = entry.anchor()
+        findings.append(Finding(
+            engine="shard", rule="sharding-drop", path=path, line=line,
+            message=f"{entry.name}: with_sharding_constraint drops a "
+                    f"live data-axis sharding on a {_human(size)} "
+                    f"tensor (constrained back to fully replicated) — "
+                    f"keep the axis in the out-sharding or state why "
+                    f"the gather is wanted [at {prov}]",
+            data={"entry": entry.name, "bytes": size}))
+
+
+def _check_donation(entry: ShardEntry, model: _GraphModel,
+                    labels: Sequence[str],
+                    findings: List[Finding]) -> None:
+    if "missed-donation" not in entry.rules or entry.donated:
+        return
+    out_sigs = {}
+    for cid in model.output_cells:
+        aval = model.avals[cid]
+        out_sigs[(tuple(getattr(aval, "shape", ())),
+                  _dtype_str(aval))] = True
+    missed = []
+    for i, cid in enumerate(model.input_cells):
+        aval = model.avals[cid]
+        sig = (tuple(getattr(aval, "shape", ())), _dtype_str(aval))
+        if (model.uses[cid] == 1 and sig in out_sigs
+                and _aval_bytes(aval) >= DONATION_MIN_BYTES):
+            lab = labels[i] if i < len(labels) else f"arg{i}"
+            missed.append((lab, _aval_bytes(aval)))
+    if missed:
+        total = sum(b for _, b in missed)
+        args = ", ".join(f"{lab}={_human(b)}" for lab, b in missed[:8])
+        findings.append(_entry_finding(
+            entry, "missed-donation",
+            f"{len(missed)} argument(s) die after first use and match "
+            f"an output shape/dtype but are not donated "
+            f"({_human(total)} of holdable buffers: {args}) — donate "
+            f"them so XLA aliases the buffers",
+            data={"args": [lab for lab, _ in missed],
+                  "bytes": total}))
+
+
+def _check_overlap(entry: ShardEntry, fn, args, ctx,
+                   findings: List[Finding]) -> Optional[Dict]:
+    import contextlib
+
+    import jax
+
+    from raft_tpu.analysis.hlo_audit import COMPILER_OPTIONS
+
+    try:
+        with (ctx or contextlib.nullcontext()):
+            lowered = fn.lower(*args)
+            compiled = lowered.compile(
+                compiler_options=dict(COMPILER_OPTIONS))
+        text = compiled.as_text()
+    except (TypeError, ValueError, NotImplementedError,
+            RuntimeError, jax.errors.JAXTypeError) as e:
+        findings.append(_note(
+            entry.name, f"overlap audit skipped: does not compile "
+                        f"here ({type(e).__name__}: {e})"))
+        return None
+    stats = overlap_from_hlo(text)
+    if stats["serialized"] and "serialized-collective" in entry.rules:
+        findings.append(_entry_finding(
+            entry, "serialized-collective",
+            f"{stats['serialized']} of {stats['pairs']} "
+            f"collective-permute(s) in the scheduled HLO have ZERO "
+            f"compute between start and done — the ring transfer is "
+            f"serialized against the einsum it should hide behind "
+            f"(ROADMAP item 2's overlap rewrite retires this)",
+            data=stats))
+    return stats
+
+
+def run_shard_audit(names: Optional[Sequence[str]] = None,
+                    budgets_path: Optional[str] = None,
+                    update: bool = False
+                    ) -> Tuple[List[Finding], Dict]:
+    """Run the named shard audits (default: every non-fixture entry).
+
+    Traces each entry's builder, walks the jaxpr once for the
+    sharding-propagation / liveness / donation facts, compiles the
+    overlap entries' scheduled HLO, and compares the memory model
+    against the ``memory`` section of budgets.json (``update=True``
+    re-baselines it, merge semantics).  Returns ``(findings,
+    report)`` — ``report["zero_headroom"]`` is the per-entry ZeRO
+    case ROADMAP item 2 is built against.
+    """
+    import jax
+
+    all_entries = dict(ENTRIES)
+    all_entries.update(FIXTURE_ENTRIES)
+    if names is None:
+        selected = list(ENTRIES)
+    else:
+        unknown = [n for n in names if n not in all_entries]
+        if unknown:
+            raise KeyError(f"unknown shard audit(s) {unknown}; known: "
+                           f"{sorted(all_entries)}")
+        selected = list(names)
+
+    findings: List[Finding] = []
+    report: Dict = {}
+    measurements: Dict[str, Dict] = {}
+    headroom: Dict[str, Dict] = {}
+    for name in selected:
+        entry = all_entries[name]
+        t0 = time.monotonic()
+        try:
+            built = entry.builder()
+        except SkipEntry as e:
+            findings.append(_note(name, f"skipped: {e}"))
+            continue
+        except ImportError as e:
+            findings.append(_note(name,
+                                  f"skipped: unavailable here ({e})"))
+            continue
+        if len(built) == 3:
+            fn, args, ctx = built
+        else:
+            fn, args = built
+            ctx = None
+        try:
+            if ctx is not None:
+                with ctx:
+                    closed = jax.make_jaxpr(fn)(*args)
+            else:
+                closed = jax.make_jaxpr(fn)(*args)
+        except (TypeError, ValueError, NotImplementedError,
+                jax.errors.JAXTypeError) as e:
+            findings.append(_note(
+                name, f"skipped: does not trace on this jax "
+                      f"({type(e).__name__}: {e})"))
+            continue
+        labels = _arg_labels(args)
+        placements = None
+        if entry.placement is not None:
+            placements = PLACEMENT_RECIPES[entry.placement](args)
+        model = _GraphModel()
+        model.run(closed, labels, placements)
+
+        replicated_bytes = 0
+        if entry.placement is not None:
+            replicated_bytes = _check_replication(entry, model,
+                                                  findings)
+        _check_drops(entry, model, findings)
+        _check_donation(entry, model, labels, findings)
+        overlap_stats = None
+        if entry.overlap:
+            overlap_stats = _check_overlap(entry, fn, args, ctx,
+                                           findings)
+
+        peak, peak_idx, live = model.peak()
+        args_bytes = sum(model.cell_bytes(c)
+                         for c in model.input_cells)
+        out_bytes = sum(model.cell_bytes(c)
+                        for c in set(model.output_cells))
+        opt_bytes, reclaim = zero_headroom(args)
+        if opt_bytes:
+            headroom[name] = {
+                "opt_state_bytes": opt_bytes,
+                "data_axis_size": DATA_AXIS_SIZE,
+                "reclaimable_bytes_per_process": reclaim,
+                "peak_bytes_before": peak,
+                "peak_bytes_after": peak - reclaim,
+            }
+        row = {
+            "peak_bytes": peak,
+            "args_bytes": args_bytes,
+            "out_bytes": out_bytes,
+            "replicated_bytes": replicated_bytes,
+            "zero_headroom_bytes": reclaim,
+            "buffers_at_peak": len(live),
+        }
+        if entry.budgeted:
+            measurements[name] = row
+        top = [f"{_human(b)} {model.label[cid].strip()}"
+               for cid, b in live[:TOP_K]]
+        report[name] = dict(
+            row, eqns=model.eqn_count, top_live=top,
+            findings=len([f for f in findings
+                          if f.data and f.data.get("entry") == name]),
+            seconds=round(time.monotonic() - t0, 2))
+        if overlap_stats is not None:
+            report[name]["overlap"] = overlap_stats
+
+    cfs, creport = compare_memory_budgets(
+        measurements, budgets_path=budgets_path, update=update,
+        full_run=names is None)
+    findings.extend(cfs)
+    if creport:
+        report["memory_ledger"] = creport
+    if headroom:
+        report["zero_headroom"] = headroom
+    findings = _apply_waivers(findings)
+    return findings, report
+
+
+def render_zero_headroom(report: Dict) -> str:
+    """Human lines for the ZeRO-headroom report (text mode)."""
+    lines = []
+    for entry, h in sorted(report.get("zero_headroom", {}).items()):
+        lines.append(
+            f"zero-headroom {entry}: optimizer state "
+            f"{_human(h['opt_state_bytes'])} replicated over "
+            f"data={h['data_axis_size']} -> "
+            f"{_human(h['reclaimable_bytes_per_process'])}/process "
+            f"reclaimable (predicted peak "
+            f"{_human(h['peak_bytes_before'])} -> "
+            f"{_human(h['peak_bytes_after'])})")
+    return "\n".join(lines)

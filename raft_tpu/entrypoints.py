@@ -167,6 +167,11 @@ class EntryPoint:
     numerics: bool = False        # engine 4 interprets it
     pallas: bool = False          # engine 4's Pallas verifier walks it
     quant: bool = False           # engine 7 certifies its quantize sites
+    shard: bool = False           # engine 8 audits sharding/memory/overlap
+    # engine-8 placement recipe (shard_audit.PLACEMENT_RECIPES key):
+    # how this entry's inputs arrive on the mesh; None leaves the
+    # sharding-propagation family off (memory/donation still run)
+    shard_placement: Optional[str] = None
     # --- budgets.json participation -------------------------------------
     budgeted: bool = True         # measurements may enter the ledger
     # --- engine-3 structural facts --------------------------------------
@@ -193,6 +198,8 @@ class EntryPoint:
             sections += ("pallas_vmem",)
         if self.quant:
             sections += ("quant",)
+        if self.shard:
+            sections += ("memory",)
         return sections
 
 
@@ -509,13 +516,19 @@ ENTRYPOINTS: Dict[str, EntryPoint] = {e.name: e for e in (
         # resharding traffic are ledger-pinned EXACTLY; all-to-all has
         # no sanctioned source in this program, so it is forbidden
         # structurally on top of the ledger
-        forbid=("all-to-all", "ragged-all-to-all"), deep=True),
+        forbid=("all-to-all", "ragged-all-to-all"), deep=True,
+        # engine 8: (state, batch) arrive replicated/batch-sharded —
+        # the data-parallel baseline whose replicated optimizer state
+        # the ZeRO-headroom report quantifies (ROADMAP item 2).  The
+        # abstract build donates the state like production does
+        # (cli/train.py runs linear-flow with donate=True).
+        donated=True, shard=True, shard_placement="state_batch"),
     EntryPoint(
         "eval_forward",
         anchor=("raft_tpu.evaluation.evaluate", "abstract_eval_forward"),
         build=_build_eval_forward,
         jaxpr=("eval_forward",), hlo=True, numerics=True, deep=True,
-        cache_tag="eval_forward"),
+        cache_tag="eval_forward", shard=True),
     EntryPoint(
         "eval_forward_bf16",
         anchor=("raft_tpu.evaluation.evaluate", "abstract_eval_forward"),
@@ -525,13 +538,16 @@ ENTRYPOINTS: Dict[str, EntryPoint] = {e.name: e for e in (
         anchor=("raft_tpu.serve.engine", "abstract_serve_forward"),
         build=_build_serve_forward,
         jaxpr=("serve_forward",), hlo=True, numerics=True, deep=True,
-        cache_tag="serve_forward", bench_lane="serve"),
+        cache_tag="serve_forward", bench_lane="serve", shard=True),
     EntryPoint(
         "serve_forward_warm",
         anchor=("raft_tpu.serve.engine", "abstract_serve_forward"),
         build=_build_serve_forward_warm,
         jaxpr=("serve_forward",), hlo=True, numerics=True, deep=True,
-        cache_tag="serve_forward"),
+        # donated: the warm forward donates flow_init (consumed at
+        # graph entry, replaced by the returned flow — engine 8's
+        # missed-donation rule found it, serve/engine.py fixed it)
+        cache_tag="serve_forward", shard=True, donated=True),
     # the int8 serving pair (serve/quant.py): the serve forward with
     # QTensor weights + the i8·i8→i32 corr contraction and the runtime
     # range-tripwire output.  jaxpr rides the GENERIC workload audit
@@ -614,7 +630,10 @@ ENTRYPOINTS: Dict[str, EntryPoint] = {e.name: e for e in (
         build=_build_corr_ring, needs_mesh=True, hlo=True,
         forbid=("all-gather", "all-gather-start", "all-to-all",
                 "ragged-all-to-all"),
-        require=("collective-permute",)),
+        require=("collective-permute",),
+        # engine 8: overlap-audits the ring's scheduled HLO (the
+        # require= fact above is what routes it to that family)
+        shard=True),
     # the h2d-lane augmentation graphs (data/device_aug.py): strictly
     # single-device programs — any collective means a sharding
     # annotation leaked into the input pipeline
@@ -736,6 +755,10 @@ def quant_entries() -> Dict[str, EntryPoint]:
     return {n: e for n, e in ENTRYPOINTS.items() if e.quant}
 
 
+def shard_entries() -> Dict[str, EntryPoint]:
+    return {n: e for n, e in ENTRYPOINTS.items() if e.shard}
+
+
 def expected_budget_rows(section: str) -> List[str]:
     """Registry-sanctioned row names (entry names for ``entries``,
     ``entry/`` prefixes for ``pallas_vmem``) — what engine 5's ledger
@@ -749,6 +772,9 @@ def expected_budget_rows(section: str) -> List[str]:
     if section == "quant":
         return [n for n, e in ENTRYPOINTS.items()
                 if e.quant and e.budgeted]
+    if section == "memory":
+        return [n for n, e in ENTRYPOINTS.items()
+                if e.shard and e.budgeted]
     raise KeyError(f"unknown budgets section {section!r}")
 
 

@@ -204,6 +204,32 @@ def build_report(records: List[Dict]) -> Dict:
                 and p95 == p95:
             serving["slo_ok"] = bool(p95 <= slo)
 
+    # predicted-vs-measured peak: graftlint engine 8's memory model
+    # (bench.py stamps `predicted_peak_hbm_bytes` per lane into the
+    # run_end summary from the committed budgets.json "memory"
+    # section) against this run's measured watermark.  ADVISORY only:
+    # a CPU host's watermark is host RSS — the whole process, not one
+    # graph's HBM — so exceeding the prediction is a note, never a
+    # gate (the gating comparison lives in engine 8's ledger check).
+    memory_model: Dict[str, Dict] = {}
+    predicted = (summary or {}).get("predicted_peak_hbm_bytes") or {}
+    if predicted and watermarks:
+        measured_peak = max(wm["peak_bytes_in_use"]
+                            for wm in watermarks.values())
+        host_only = set(watermarks) == {"host"}
+        for lane, pred in sorted(predicted.items()):
+            row = {"predicted_peak_bytes": int(pred),
+                   "measured_peak_bytes": int(measured_peak)}
+            if measured_peak > pred:
+                row["note"] = (
+                    "memory-model-drift: measured peak exceeds the "
+                    "engine-8 prediction"
+                    + (" (host-RSS watermark covers the whole "
+                       "process, not one graph)" if host_only
+                       else " — re-baseline with `--engine shard "
+                            "--update-budgets` if the graph grew"))
+            memory_model[lane] = row
+
     return {
         "meta": meta,
         "serving": serving,
@@ -220,6 +246,7 @@ def build_report(records: List[Dict]) -> Dict:
         "phase_seconds_incl": {k: round(v, 6)
                                for k, v in phase_incl.items()},
         "memory_watermarks": watermarks,
+        "memory_model": memory_model,
         "incidents": incident_rows,
         "resilience": resilience,
         "last_window_means": last_means,
@@ -878,6 +905,17 @@ def render_report(report: Dict) -> str:
                 f"limit {_fmt_bytes(wm.get('bytes_limit', -1))}")
     else:
         lines.append("memory watermarks: none recorded")
+
+    mm = report.get("memory_model") or {}
+    if mm:
+        lines.append("predicted vs measured peak (engine-8 memory "
+                     "model):")
+        for lane, row in mm.items():
+            note = f"  [{row['note']}]" if row.get("note") else ""
+            lines.append(
+                f"  {lane}: predicted "
+                f"{_fmt_bytes(row['predicted_peak_bytes'])}  measured "
+                f"{_fmt_bytes(row['measured_peak_bytes'])}{note}")
 
     lines.append("")
     incidents = report["incidents"]

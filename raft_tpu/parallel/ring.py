@@ -126,6 +126,7 @@ def ring_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array, mesh: Mesh,
     return [constrain(p, P(DATA_AXIS, axis, None, None)) for p in pyr]
 
 
+# graftlint: disable=serialized-collective -- the baseline ring schedules each permute hop synchronously (no double-buffered next-chunk transfer behind the local einsum yet); ROADMAP item 2's overlap rewrite retires this waiver, and engine 8 holds the line meanwhile
 def abstract_ring_lookup(mesh: Mesh, batch: int = 2, hw=(8, 16),
                          channels: int = 16, radius: int = 4,
                          num_levels: int = 4):

@@ -101,12 +101,14 @@ def make_parallel_train_step(model, mesh: Mesh, iters: int, gamma: float,
     return step
 
 
+# graftlint: disable=implicit-replication -- deliberate data-parallel baseline: params, grads and AdamW moments replicate over 'data' (engine 8's ZeRO-headroom report quantifies the reclaimable bytes); ROADMAP item 2's optimizer-state sharding retires this waiver
 def abstract_parallel_step(mesh: Mesh, iters: int = 2,
                            overrides: Dict = None,
                            batch_size: int = 2,
                            hw=(64, 64), gamma: float = 0.8,
                            max_flow: float = 400.0,
-                           shard_inputs: bool = False):
+                           shard_inputs: bool = False,
+                           donate: bool = True):
     """The sharded train step over abstract inputs on ``mesh``: the
     lowerable entry point behind the ``parallel_step`` record in
     ``raft_tpu/entrypoints.py`` (its mesh recipe is the registry's
@@ -139,9 +141,15 @@ def abstract_parallel_step(mesh: Mesh, iters: int = 2,
                                               iters=iters),
             jax.random.PRNGKey(0), batch_sds)
         step = make_parallel_train_step(model, mesh, iters=iters,
-                                        gamma=gamma, max_flow=max_flow)
+                                        gamma=gamma, max_flow=max_flow,
+                                        donate=donate)
     if shard_inputs:
+        # donate on the OUTER jit too: that is the lowering engine 3
+        # measures, and the aliasing must be declared at the level
+        # that compiles (the production contract — cli/train.py runs
+        # the step linear-flow with donate=True)
         step = jax.jit(step,
                        in_shardings=(NamedSharding(mesh, P()),
-                                     NamedSharding(mesh, batch_spec())))
+                                     NamedSharding(mesh, batch_spec())),
+                       donate_argnums=(0,) if donate else ())
     return step, (state_sds, batch_sds)

@@ -139,8 +139,13 @@ def make_test_forward(model, iters: int, warm: bool):
     import jax
 
     if warm:
+        # flow_init is consumed at graph entry and replaced by the
+        # returned flow of the same shape/dtype — donate it so XLA
+        # aliases the buffers (every caller passes a fresh host array
+        # or the previous output it is about to overwrite)
         return jax.jit(lambda v, a, b, f: model.apply(
-            v, a, b, iters=iters, flow_init=f, test_mode=True))
+            v, a, b, iters=iters, flow_init=f, test_mode=True),
+            donate_argnums=(3,))
     return jax.jit(lambda v, a, b: model.apply(
         v, a, b, iters=iters, test_mode=True))
 

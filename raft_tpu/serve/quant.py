@@ -33,7 +33,7 @@ drives exactly this path end to end).
 ``abstract_serve_forward_q8`` is the lowerable entry behind the
 ``serve_forward_q8``/``serve_forward_q8_warm`` registry records —
 exactly the graph :class:`QuantServeEngine` compiles, audited by all
-seven engines.
+eight engines.
 """
 
 from __future__ import annotations

@@ -1,29 +1,32 @@
 #!/usr/bin/env python
-"""graftlint gate: all seven analysis engines, exit nonzero on findings.
+"""graftlint gate: all eight analysis engines, exit nonzero on findings.
 
 Thin wrapper over ``python -m raft_tpu.analysis`` so CI lanes and
 pre-push hooks have a stable entry point:
 
-    python scripts/graftlint.py                      # full gate: lint + jaxpr + hlo + numerics + quant + registry + concurrency
+    python scripts/graftlint.py                      # full gate: lint + jaxpr + hlo + numerics + quant + registry + concurrency + shard
     python scripts/graftlint.py --engine lint        # sub-second, jax-free
     python scripts/graftlint.py --engine numerics    # dtype/range + Pallas verifier
     python scripts/graftlint.py --engine quant       # int8 quantization certifier vs the quant calibration ledger
     python scripts/graftlint.py --engine registry    # entry-point coverage vs entrypoints.py
     python scripts/graftlint.py --engine concurrency # lock/incident/exit-code/terminal/thread-io audit, jax-free
+    python scripts/graftlint.py --engine shard       # sharding/peak-HBM/overlap/donation vs the memory ledger
     python scripts/graftlint.py --json               # machine-readable, with a per-engine "engines" summary
     python scripts/graftlint.py --list-waivers       # waiver inventory
 
-The full gate fans the seven engines out as PARALLEL subprocesses —
+The full gate fans the eight engines out as PARALLEL subprocesses —
 they are independent (each jax engine forces its own 8-virtual-device
 CPU backend; lint and concurrency never import jax), so the wall
 clock is max(engine) rather than sum(engine): the HLO engine's
 compiles dominate (numerics traces in ~25-40 s, quant ~10 s, the
-registry auditor ~20 s, concurrency ~3 s), keeping the whole gate around ~100 s wall
-vs ~150 s serial and inside the tier-1 timeout budget.  A per-engine
+registry auditor ~20 s, concurrency ~3 s, the shard auditor's
+parallel_step trace + ring compile ~40 s), keeping the whole gate
+around ~100 s wall vs ~190 s serial and inside the tier-1 timeout
+budget.  A per-engine
 timing line is printed either way.  Under ``--json`` the merged
 report carries an ``engines`` map — one row per engine with
 ``status`` ("clean" | "findings" | "timeout" | "crash"), finding
-counts, and wall seconds — so CI consumes ONE summary instead of seven
+counts, and wall seconds — so CI consumes ONE summary instead of eight
 interleaved blobs.  Any other flag combination (a single --engine,
 --update-budgets, --list-waivers, explicit paths) delegates to the
 module CLI in-process.
@@ -50,7 +53,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
 ENGINES = ("lint", "jaxpr", "hlo", "numerics", "quant", "registry",
-           "concurrency")
+           "concurrency", "shard")
 
 # Per-engine subprocess budget, measured from the common spawn point.
 # Generous vs the slowest engine (hlo ~100 s): tripping it means a
@@ -129,7 +132,7 @@ def parallel_gate(json_out: bool, verbose: bool) -> int:
         timings[engine] = engine_report.pop("engine_timings",
                                             {}).get(engine, 0.0)
         # each child reports its OWN "engines" row; merge them by hand
-        # (report.update below would clobber six of the seven)
+        # (report.update below would clobber seven of the eight)
         engines_summary.update(engine_report.pop("engines", {}))
         # merge at top level so the wrapper's --json schema is identical
         # to `python -m raft_tpu.analysis --engine all --json` (jaxpr

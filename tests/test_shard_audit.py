@@ -1,0 +1,381 @@
+"""Engine 8 (the sharding & memory scale-readiness auditor).
+
+Tier-1 proofs for ISSUE 19:
+
+- one seeded failing fixture per rule family — ``implicit-replication``,
+  ``sharding-drop``, ``serialized-collective``, ``missed-donation`` —
+  each exits 1 through the CLI with file:line attribution;
+- THE clean gate: the committed tree's shard entries audit with zero
+  unwaived findings against the committed ``memory`` ledger, and the
+  two deliberate-baseline waivers (data-parallel replication in
+  parallel/step.py, the synchronous ring in parallel/ring.py) are
+  visible as WAIVED findings — engine 5's staleness gate keeps them
+  honest;
+- ``memory``-ledger semantics: round-trip is silent, drift trips
+  ``stale-memory-model`` at the ledger line, orphan rows prune on a
+  full ``--update-budgets`` run (other sections survive
+  byte-identical), an unledgered entry trips ``budget-missing``;
+- the ZeRO-headroom arithmetic on a toy AdamW tree (exact integer
+  pin) plus the repo entry's internal consistency — the per-process
+  reclaimable bytes ROADMAP item 2 is built against;
+- ``overlap_from_hlo`` schedule-distance parsing on synthetic HLO;
+- ``predicted_peak_map`` (the bench.py stamp) from a tmp ledger, and
+  the obs report's advisory predicted-vs-measured ``memory-model``
+  section.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from raft_tpu.analysis import findings as fmod
+from raft_tpu.analysis import shard_audit as sa
+import raft_tpu.entrypoints as ep
+
+
+# ---------------------------------------------------------------------------
+# seeded fixtures: one failing program per rule family, exit 1, file:line
+# ---------------------------------------------------------------------------
+
+def test_seeded_shard_replicated_exits_1_with_file_line(capsys):
+    """The 4 MiB fully-replicated tensor fixture through the REAL CLI:
+    exit 1, implicit-replication, anchored at a shard_audit.py line."""
+    from raft_tpu.analysis.__main__ import main
+
+    rc = main(["--engine", "shard", "--audits",
+               "seeded_shard_replicated", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    hits = [f for f in payload["findings"]
+            if f["rule"] == "implicit-replication" and not f["waived"]]
+    assert hits, payload["findings"]
+    assert hits[0]["path"].endswith("shard_audit.py")
+    assert hits[0]["line"] > 0
+
+
+def _shard_fixture_findings(name):
+    findings, _ = sa.run_shard_audit([name])
+    return [f for f in findings if not f.waived and f.severity == "error"]
+
+
+@pytest.mark.parametrize("name,rule", [
+    ("seeded_shard_drop", "sharding-drop"),
+    ("seeded_shard_serialized", "serialized-collective"),
+    ("seeded_shard_nodonate", "missed-donation"),
+])
+def test_seeded_shard_fixture_trips(name, rule):
+    out = _shard_fixture_findings(name)
+    hits = [f for f in out if f.rule == rule]
+    assert hits, [f.render() for f in out]
+    assert hits[0].path.endswith("shard_audit.py") and hits[0].line > 0
+
+
+# ---------------------------------------------------------------------------
+# THE clean gate: the committed tree audits against the committed ledger
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def repo_shard_audit():
+    import time
+
+    t0 = time.monotonic()
+    findings, report = sa.run_shard_audit()
+    return findings, report, time.monotonic() - t0
+
+
+def test_shard_gate_repo_clean(repo_shard_audit):
+    """Every registered shard entry audits with zero unwaived findings
+    and the committed ``memory`` ledger matches what the graphs
+    measure — the scale-readiness baseline holds."""
+    findings, report, wall = repo_shard_audit
+    assert fmod.gate(findings) == [], [
+        f"{f.rule} {f.path}:{f.line} {f.message}"
+        for f in fmod.gate(findings)]
+    # the audit really covered every registered entry
+    for entry in ep.shard_entries():
+        assert report[entry]["eqns"] > 0, entry
+        assert report[entry]["peak_bytes"] > 0, entry
+    # pinned ceiling: the small audit config's train step must model
+    # well under one device's HBM (the audit catching a runaway peak
+    # is the point of the liveness sweep)
+    assert report["parallel_step"]["peak_bytes"] < (1 << 28)  # 256 MiB
+    assert wall < 300.0, f"shard audit took {wall:.1f}s"
+
+
+def test_shard_gate_baseline_waivers_are_visible(repo_shard_audit):
+    """Satellite 1: the two deliberate-baseline findings survive as
+    WAIVED — the data-parallel replication (parallel/step.py) and the
+    synchronous ring (parallel/ring.py).  ROADMAP item 2 retires both;
+    meanwhile the waiver text carries the reason and engine 5's
+    staleness gate notices if the finding ever stops firing."""
+    findings, report, _ = repo_shard_audit
+    waived = {f.rule for f in findings if f.waived}
+    assert "implicit-replication" in waived
+    assert "serialized-collective" in waived
+    for f in findings:
+        if f.waived:
+            assert f.waiver_reason, f.rule
+    # the ring's overlap stats rode into the report (every permute hop
+    # measured; on this backend they schedule synchronously — waived)
+    overlap = report["corr_ring"]["overlap"]
+    assert overlap["pairs"] >= 1
+    assert len(overlap["gaps"]) == overlap["pairs"]
+
+
+def test_shard_zero_headroom_report(repo_shard_audit):
+    """ACCEPTANCE: the ZeRO-headroom report prints concrete
+    per-process reclaimable bytes for parallel_step — optimizer state
+    fully replicated over the data axis, reclaim = opt*(d-1)/d."""
+    findings, report, _ = repo_shard_audit
+    h = report["zero_headroom"]["parallel_step"]
+    d = h["data_axis_size"]
+    assert d == sa.DATA_AXIS_SIZE >= 2
+    assert h["reclaimable_bytes_per_process"] == \
+        h["opt_state_bytes"] * (d - 1) // d
+    assert h["peak_bytes_after"] == \
+        h["peak_bytes_before"] - h["reclaimable_bytes_per_process"]
+    # AdamW doubles the param bytes; at the audit config that is tens
+    # of MiB — the report must name a concrete, material number
+    assert h["reclaimable_bytes_per_process"] > (1 << 24)  # > 16 MiB
+    text = sa.render_zero_headroom(report)
+    assert "zero-headroom parallel_step" in text
+    assert "/process reclaimable" in text
+
+
+# ---------------------------------------------------------------------------
+# ZeRO arithmetic pin (toy AdamW tree: exact integers, no tracing)
+# ---------------------------------------------------------------------------
+
+def test_zero_headroom_toy_arithmetic():
+    """mu+nu of a (4,4) f32 kernel = 128 bytes of optimizer state;
+    sharded over data=2 each process keeps half -> 64 reclaimable.
+    Non-moment leaves never count."""
+    args = ({"params": {"w": np.zeros((8, 8), np.float32)},
+             "mu": {"w": np.zeros((4, 4), np.float32)},
+             "nu": {"w": np.zeros((4, 4), np.float32)}},)
+    opt, reclaim = sa.zero_headroom(args, data_size=2)
+    assert opt == 128
+    assert reclaim == 64
+    opt, reclaim = sa.zero_headroom(args, data_size=4)
+    assert reclaim == 96          # opt * 3 // 4
+    # a tree with no moments has zero headroom
+    assert sa.zero_headroom(({"params": {"w": np.zeros((4,), np.float32)}},),
+                            data_size=2) == (0, 0)
+    # \b guards: mu_conv / emu are NOT optimizer moments
+    assert sa.zero_headroom(({"mu_conv": np.zeros((4,), np.float32),
+                              "emu": np.zeros((4,), np.float32)},),
+                            data_size=2) == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# overlap_from_hlo: schedule-distance parsing on synthetic HLO
+# ---------------------------------------------------------------------------
+
+_SYNC_HLO = """\
+  %p0 = f32[8]{0} parameter(0)
+  %cp = f32[8]{0} collective-permute(%p0), source_target_pairs={{0,1},{1,0}}
+  %mul = f32[8]{0} multiply(%cp, %cp)
+"""
+
+_OVERLAPPED_HLO = """\
+  %p0 = f32[8]{0} parameter(0)
+  %start = (f32[8]{0}, f32[8]{0}, u32[], u32[]) collective-permute-start(%p0)
+  %mm = f32[8]{0} multiply(%p0, %p0)
+  %gte = f32[8]{0} get-tuple-element(%start), index=1
+  %acc = f32[8]{0} add(%mm, %p0)
+  %done = f32[8]{0} collective-permute-done(%start)
+"""
+
+_SERIAL_ASYNC_HLO = """\
+  %p0 = f32[8]{0} parameter(0)
+  %start = (f32[8]{0}, f32[8]{0}, u32[], u32[]) collective-permute-start(%p0)
+  %done = f32[8]{0} collective-permute-done(%start)
+  %mul = f32[8]{0} multiply(%done, %done)
+"""
+
+
+def test_overlap_from_hlo_sync_permute_is_serialized():
+    stats = sa.overlap_from_hlo(_SYNC_HLO)
+    assert stats == {"pairs": 1, "serialized": 1, "gaps": [0]}
+
+
+def test_overlap_from_hlo_counts_compute_between_start_done():
+    """Two compute ops (multiply, add) land between start and done;
+    get-tuple-element is bookkeeping and must not count."""
+    stats = sa.overlap_from_hlo(_OVERLAPPED_HLO)
+    assert stats == {"pairs": 1, "serialized": 0, "gaps": [2]}
+
+
+def test_overlap_from_hlo_adjacent_async_pair_is_serialized():
+    stats = sa.overlap_from_hlo(_SERIAL_ASYNC_HLO)
+    assert stats == {"pairs": 1, "serialized": 1, "gaps": [0]}
+
+
+# ---------------------------------------------------------------------------
+# memory-ledger semantics (pure-dict lane: no tracing)
+# ---------------------------------------------------------------------------
+
+_M = {"parallel_step": {
+    "peak_bytes": 1000, "args_bytes": 600, "out_bytes": 500,
+    "replicated_bytes": 800, "zero_headroom_bytes": 200,
+    "buffers_at_peak": 7}}
+
+
+def _write_ledger(tmp_path, payload):
+    p = tmp_path / "budgets.json"
+    p.write_text(json.dumps(payload, indent=1, sort_keys=True))
+    return str(p)
+
+
+def test_memory_ledger_roundtrip_is_silent(tmp_path):
+    path = _write_ledger(tmp_path, {})
+    fs, rep = sa.compare_memory_budgets(dict(_M), budgets_path=path,
+                                        update=True, full_run=True)
+    assert [f for f in fs if f.severity != "note"] == []
+    assert rep["budgets_written"]["rows"] == sorted(_M)
+    fs, rep = sa.compare_memory_budgets(dict(_M), budgets_path=path)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_memory_ledger_drift_trips_stale_memory_model(tmp_path):
+    path = _write_ledger(tmp_path, {})
+    sa.compare_memory_budgets(dict(_M), budgets_path=path, update=True)
+    drifted = {k: dict(v) for k, v in _M.items()}
+    drifted["parallel_step"]["peak_bytes"] = 2000
+    drifted["parallel_step"]["buffers_at_peak"] = 9
+    fs, _ = sa.compare_memory_budgets(drifted, budgets_path=path)
+    hits = [f for f in fs if f.rule == "stale-memory-model"]
+    assert hits and hits[0].line > 0       # anchored at the ledger row
+    assert any("peak_bytes" in d for d in hits[0].data["drift"])
+    assert any("buffers_at_peak" in d for d in hits[0].data["drift"])
+
+
+def test_memory_ledger_full_update_prunes_orphans(tmp_path):
+    """Full-run --update-budgets drops rows whose entry left the
+    registry (noted), and a PARTIAL update merges: unrelated sections
+    and the ghost row survive byte-identical."""
+    other = {"entries": {"train_step": {"flops": 1.0}},
+             "memory": {"ghost_entry": dict(_M["parallel_step"])}}
+    path = _write_ledger(tmp_path, dict(other))
+    # partial (non-full) update: the ghost row is NOT pruned
+    fs, rep = sa.compare_memory_budgets(dict(_M), budgets_path=path,
+                                        update=True, full_run=False)
+    after = json.load(open(path))
+    assert after["entries"] == other["entries"]
+    assert "ghost_entry" in after["memory"]
+    assert "parallel_step" in after["memory"]
+    # full-run update: the ghost row prunes, with a note naming it
+    fs, rep = sa.compare_memory_budgets(dict(_M), budgets_path=path,
+                                        update=True, full_run=True)
+    notes = [f for f in fs if f.rule == "budget-pruned"]
+    assert notes and "ghost_entry" in notes[0].message
+    assert notes[0].severity == "note"
+    after = json.load(open(path))
+    assert "ghost_entry" not in after["memory"]
+    assert after["entries"] == other["entries"]
+    assert rep["budgets_written"]["pruned"] == ["ghost_entry"]
+
+
+def test_memory_ledger_orphan_row_trips_in_compare_mode(tmp_path):
+    path = _write_ledger(tmp_path, {"memory": {
+        "ghost_entry": dict(_M["parallel_step"])}})
+    fs, _ = sa.compare_memory_budgets(dict(_M), budgets_path=path)
+    hits = [f for f in fs if f.rule == "stale-memory-model"
+            and "ghost_entry" in f.message]
+    assert hits, [f.render() for f in fs]
+
+
+def test_memory_ledger_unmeasured_sanctioned_row_is_reported(tmp_path):
+    """A row whose entry IS registered but was not in this (partial)
+    run's selection is not an orphan — it lands in ``not_measured``,
+    no finding."""
+    path = _write_ledger(tmp_path, {"memory": {
+        "parallel_step": dict(_M["parallel_step"]),
+        "eval_forward": dict(_M["parallel_step"])}})
+    fs, rep = sa.compare_memory_budgets(dict(_M), budgets_path=path)
+    assert fs == [], [f.render() for f in fs]
+    assert rep["not_measured"] == ["eval_forward"]
+
+
+def test_memory_ledger_unledgered_entry_trips_budget_missing(tmp_path):
+    path = _write_ledger(tmp_path, {})
+    fs, _ = sa.compare_memory_budgets(dict(_M), budgets_path=path)
+    hits = [f for f in fs if f.rule == "budget-missing"]
+    assert hits and hits[0].line == 0
+    assert "--update-budgets" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# predicted_peak_map (the bench.py stamp) + the obs report's advisory
+# ---------------------------------------------------------------------------
+
+def test_predicted_peak_map_reads_committed_ledger(tmp_path):
+    path = _write_ledger(tmp_path, {"memory": {
+        "parallel_step": dict(_M["parallel_step"])}})
+    lanes = {"train": "parallel_step", "serve": "serve_forward_q8"}
+    got = sa.predicted_peak_map(lanes, budgets_path=path)
+    assert got == {"train": 1000, "serve": None}
+
+
+def _ledger_records(predicted, memory_rec):
+    return [
+        {"kind": "run_start", "run": "r1", "meta": {}},
+        dict(memory_rec, kind="memory", run="r1"),
+        {"kind": "run_end", "run": "r1",
+         "summary": {"predicted_peak_hbm_bytes": predicted}},
+    ]
+
+
+def test_obs_report_memory_model_drift_note_host_only():
+    """Measured (host-RSS) peak above the engine-8 prediction yields
+    the advisory ``memory-model-drift`` note with the host-RSS caveat;
+    a prediction above the watermark yields no note."""
+    from raft_tpu.obs.report import build_report, render_report
+
+    rep = build_report(_ledger_records({"train": 100},
+                                       {"host_rss_bytes": 200}))
+    row = rep["memory_model"]["train"]
+    assert row["measured_peak_bytes"] == 200
+    assert row["note"].startswith("memory-model-drift")
+    assert "host-RSS" in row["note"]
+    text = render_report(rep)
+    assert "predicted vs measured peak (engine-8 memory model)" in text
+    assert "[memory-model-drift" in text
+
+    rep = build_report(_ledger_records({"train": 10 ** 9},
+                                       {"host_rss_bytes": 200}))
+    assert "note" not in rep["memory_model"]["train"]
+
+
+def test_obs_report_memory_model_device_watermark_says_rebaseline():
+    from raft_tpu.obs.report import build_report
+
+    rep = build_report(_ledger_records(
+        {"train": 100},
+        {"devices": {"tpu:0": {"bytes_in_use": 50,
+                               "peak_bytes_in_use": 500,
+                               "bytes_limit": 1000}}}))
+    note = rep["memory_model"]["train"]["note"]
+    assert "re-baseline" in note and "host-RSS" not in note
+
+
+# ---------------------------------------------------------------------------
+# registry derivation: the engine's tables come from entrypoints.py
+# ---------------------------------------------------------------------------
+
+def test_shard_tables_derive_from_registry():
+    assert list(sa.ENTRIES) == list(ep.shard_entries())
+    rows = ep.expected_budget_rows("memory")
+    assert rows == [n for n, e in ep.ENTRYPOINTS.items()
+                    if e.shard and e.budgeted]
+    assert set(rows) == {"parallel_step", "corr_ring", "eval_forward",
+                         "serve_forward", "serve_forward_warm"}
+    assert "memory" in ep.ENTRYPOINTS["parallel_step"].budget_sections
+    # fixtures never write ledger rows
+    for f in sa.FIXTURE_ENTRIES.values():
+        assert not f.budgeted
+    # each fixture exercises exactly one rule family
+    fams = [next(iter(f.rules)) for f in sa.FIXTURE_ENTRIES.values()
+            if len(f.rules) == 1]
+    assert sorted(fams) == sorted(sa.ALL_SHARD_RULES)

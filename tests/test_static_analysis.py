@@ -1267,12 +1267,12 @@ def _load_graftlint_script():
     return mod
 
 
-def test_graftlint_wrapper_fans_out_seven_engines():
-    """The CI wrapper must run all seven engines in parallel — the
+def test_graftlint_wrapper_fans_out_eight_engines():
+    """The CI wrapper must run all eight engines in parallel — the
     per-engine timing line is its contract with the tier-1 budget."""
     mod = _load_graftlint_script()
     assert mod.ENGINES == ("lint", "jaxpr", "hlo", "numerics", "quant",
-                           "registry", "concurrency")
+                           "registry", "concurrency", "shard")
     # the per-engine timeout exists and is generous vs the slowest
     # engine (hlo ~100 s) — tripping it means wedged, not slow
     assert mod.ENGINE_TIMEOUT_S >= 300
@@ -1303,20 +1303,26 @@ def test_engines_enumerate_from_registry():
     """No hand-maintained entry lists remain in analysis/: all the
     engines' tables derive from raft_tpu/entrypoints.py."""
     from raft_tpu.analysis import quant_audit as qa
+    from raft_tpu.analysis import shard_audit as sa
 
     assert list(ja.ENTRY_AUDITS) == ep.jaxpr_audit_names()
     assert list(ha.ENTRIES) == list(ep.hlo_entries())
     assert list(na.ENTRIES) == list(ep.numerics_entries())
     assert list(qa.ENTRIES) == list(ep.quant_entries())
+    assert list(sa.ENTRIES) == list(ep.shard_entries())
     # structural facts ride the registry into the engines
     assert ha.ENTRIES["corr_ring"].require == ("collective-permute",)
     assert ha.ENTRIES["train_step"].donated
     assert na.ENTRIES["corr_lookup_pallas"].pallas
     assert na.ENTRIES["train_step"].rules == na.DEEP_RULES
     assert qa.ENTRIES["serve_forward_q8"].rules == qa.ALL_QUANT_RULES
+    assert sa.ENTRIES["corr_ring"].overlap          # require= rides in
+    assert sa.ENTRIES["parallel_step"].placement == "state_batch"
+    assert sa.ENTRIES["parallel_step"].donated
+    assert sa.ENTRIES["serve_forward_warm"].donated
     # every entry is audited by at least one engine
     for e in ep.ENTRYPOINTS.values():
-        assert e.jaxpr or e.hlo or e.numerics or e.quant, e.name
+        assert e.jaxpr or e.hlo or e.numerics or e.quant or e.shard, e.name
 
 
 def test_cache_key_recipe_single_definition():
@@ -1860,8 +1866,8 @@ def test_graftlint_json_merged_engine_summary(tmp_path, capsys):
     (status/findings/unwaived/seconds per engine) built by hand-merging
     each child's "engines" row — report.update alone would keep only
     the last child's.  Exercised with the two jax-free engines so the
-    real subprocess fan-out stays cheap; the seven-tuple itself is
-    pinned by test_graftlint_wrapper_fans_out_seven_engines."""
+    real subprocess fan-out stays cheap; the eight-tuple itself is
+    pinned by test_graftlint_wrapper_fans_out_eight_engines."""
     mod = _load_graftlint_script()
     mod.ENGINES = ("lint", "concurrency")
     rc = mod.parallel_gate(json_out=True, verbose=False)
