@@ -7,32 +7,39 @@ rewrite so the baseline's waste is proven and pinned, not guessed.  It
 walks each registered shard entry (``registry.shard_entries()``) and
 asks four questions engines 2-7 cannot:
 
-- ``implicit-replication`` — which tensors at or above
-  :data:`REPLICATION_THRESHOLD_BYTES` are materialized fully
-  replicated along the data axis?  The propagation is a
-  dimension-witness abstract interpretation of the entry's jaxpr:
-  every input leaf is seeded from the entry's declared placement
-  recipe (``shard_placement``), and data-sharding survives an
-  equation only while a batch-sized dimension does (transpose /
-  broadcast_in_dim carry the dimension through their permutation
-  maps; a reduction that consumes it loses it — exactly what GSPMD
-  does to per-example gradients at the first contraction over batch).
-  Optimizer moments and gradients are the known offenders; the ONE
-  aggregated finding per entry (top offenders + total replicated
-  bytes) is the quantified ZeRO case (Rajbhandari et al. 2020), and
-  today's deliberate data-parallel baseline carries a reasoned inline
-  waiver at the entry anchor that the item-2 rewrite must retire.
+- ``implicit-replication`` — which RESIDENT INPUT tensors at or above
+  :data:`REPLICATION_THRESHOLD_BYTES` arrive fully replicated along
+  the data axis?  The propagation is a dimension-witness abstract
+  interpretation of the entry's jaxpr: every input leaf is seeded
+  from the entry's declared placement recipe (``shard_placement``),
+  data-sharding survives an equation only while a batch-sized
+  dimension does (transpose / broadcast_in_dim carry the dimension
+  through their permutation maps; a reduction that consumes it loses
+  it — exactly what GSPMD does to per-example gradients at the first
+  contraction over batch), and a ``with_sharding_constraint`` that
+  PINS the data axis is a sharding source (that is how the ZeRO
+  re-shard constraints mark grads/moments sharded past AD's witness
+  break).  The rule prices arrival state only — bytes held between
+  steps on every process; transient full-size intermediates (a
+  gathered param, an unreduced gradient) are priced exactly by the
+  peak-liveness model instead.  The ONE aggregated finding per entry
+  (top offenders + total replicated bytes) was the quantified ZeRO
+  case (Rajbhandari et al. 2020) that ROADMAP item 2's
+  ``--zero_shard`` layout retired: params and AdamW moments now
+  arrive partitioned per ``mesh.py zero_partition_spec``.
 - ``sharding-drop`` — a ``with_sharding_constraint`` that discards a
   live data-axis sharding (constrains a sharded tensor at or above
   the threshold back to fully replicated) on a hot path.  Anchored at
   the constraint's own provenance line.
 - ``serialized-collective`` — on the ring entry's scheduled HLO
   (compiled under engine 3's pinned ``COMPILER_OPTIONS``), a
-  collective-permute with ZERO compute scheduled between its start
-  and done (a synchronous ``collective-permute`` instruction is
-  serialized by construction).  Today's CPU baseline schedules the
-  ring transfer synchronously — parallel/ring.py carries the one
-  reasoned waiver; the item-2 overlap rewrite must retire it.
+  collective-permute with ZERO compute scheduled between its issue
+  and the first use of its result (async backends split the pair as
+  start/done; a synchronous backend schedules one instruction, so
+  the window is issue -> first consumer in the linear schedule).
+  The item-2 double-buffered ring (parallel/ring.py) issues hop k+1
+  before block k's einsum, which retired the serialized-baseline
+  waiver this rule used to carry.
 - ``missed-donation`` — an entry argument that dies after its first
   use, matches an output's shape/dtype, and is not donated: a whole
   buffer of HBM the executable holds for no reason.  Anchored at the
@@ -145,21 +152,32 @@ def _human(n: int) -> str:
     return f"{n}B"
 
 
-def zero_headroom(args, data_size: int = DATA_AXIS_SIZE
+def zero_headroom(args, data_size: int = DATA_AXIS_SIZE,
+                  placements: Optional[Sequence[Optional[int]]] = None
                   ) -> Tuple[int, int]:
-    """(optimizer-state bytes, per-process bytes reclaimable were that
-    state sharded over the data axis) for an entry's argument tree.
+    """(replicated optimizer-state bytes, per-process bytes reclaimable
+    were that state sharded over the data axis) for an entry's argument
+    tree.
 
     The moments are found structurally (``mu``/``nu`` path segments —
     AdamW's trees); reclaimable = ``opt * (data-1)/data`` exactly, in
     integer bytes.  This IS the arithmetic the ZeRO-headroom report
-    prints and the toy-entry test pins.
+    prints and the toy-entry test pins.  ``placements`` (the entry's
+    flat placement list, aligned with the flattened args) scopes the
+    count to moments that ARRIVE replicated: a ZeRO-sharded entry has
+    already banked its headroom, so its reclaimable reads 0 instead of
+    double-counting bytes the layout no longer holds.
     """
     import jax
 
     opt = 0
-    for path, leaf in jax.tree_util.tree_flatten_with_path(args)[0]:
-        if _OPT_STATE_RE.search(jax.tree_util.keystr(path)):
+    flat = jax.tree_util.tree_flatten_with_path(args)[0]
+    pl = list(placements) if placements is not None else []
+    if len(pl) != len(flat):
+        pl = [None] * len(flat)
+    for (path, leaf), d in zip(flat, pl):
+        if d is None and _OPT_STATE_RE.search(
+                jax.tree_util.keystr(path)):
             opt += _aval_bytes(leaf)
     return opt, opt * (data_size - 1) // data_size
 
@@ -185,6 +203,30 @@ def _placements_state_batch(args) -> List[Optional[int]]:
     return out
 
 
+def _placements_state_zero_batch(args) -> List[Optional[int]]:
+    """``(state, batch)`` in the ZeRO-1 resident layout: params and
+    AdamW mu/nu arrive partitioned over ``data`` on their
+    ``zero_partition_dim`` (mesh.py — the same single-source recipe
+    ``zero_shard_state`` places at runtime), every other state leaf
+    replicated, every batch leaf sharded on its leading dimension.
+    The production ``--zero_shard`` placement (ROADMAP item 2)."""
+    import jax
+
+    from raft_tpu.parallel.mesh import ZERO_STATE_RE, zero_partition_dim
+
+    state, batch = args[0], args[1:]
+    out: List[Optional[int]] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if ZERO_STATE_RE.search(jax.tree_util.keystr(path)):
+            out.append(zero_partition_dim(
+                getattr(leaf, "shape", ()), DATA_AXIS_SIZE))
+        else:
+            out.append(None)
+    for a in batch:
+        out.extend([0] * _leaf_count(a))
+    return out
+
+
 def _placements_batch(args) -> List[Optional[int]]:
     """Every leaf batch-sharded on dim 0."""
     return [0] * sum(_leaf_count(a) for a in args)
@@ -200,6 +242,7 @@ def _placements_first_replicated(args) -> List[Optional[int]]:
 
 PLACEMENT_RECIPES: Dict[str, Callable] = {
     "state_batch": _placements_state_batch,
+    "state_zero_batch": _placements_state_zero_batch,
     "batch": _placements_batch,
     "first_replicated": _placements_first_replicated,
 }
@@ -328,6 +371,21 @@ class _GraphModel:
         return None
 
     @staticmethod
+    def _constraint_data_dim(sharding, aval) -> Optional[int]:
+        """The dimension a with_sharding_constraint pins to the data
+        axis, or None when the spec does not mention ``data``."""
+        spec = getattr(sharding, "spec", None)
+        shape = getattr(aval, "shape", None)
+        if spec is None or shape is None:
+            return None
+        for i, entry in enumerate(tuple(spec)):
+            names = (entry if isinstance(entry, (tuple, list))
+                     else (entry,))
+            if "data" in [n for n in names if n]:
+                return i if i < len(shape) else None
+        return None
+
+    @staticmethod
     def _constraint_axes(sharding) -> Optional[frozenset]:
         """Mesh axes a with_sharding_constraint pins, or None when the
         sharding object carries no recoverable spec (legacy GSPMD
@@ -373,9 +431,18 @@ class _GraphModel:
         for ov in eqn.outvars:
             aval = getattr(ov, "aval", None)
             d = self._out_sdim(eqn, in_avals, in_sdims, aval)
-            if constraint_axes is not None and "data" not in \
-                    constraint_axes:
-                d = None
+            if constraint_axes is not None:
+                if "data" in constraint_axes:
+                    # a constraint that PINS the data axis is a
+                    # sharding SOURCE (GSPMD enforces it), not just a
+                    # witness filter — the ZeRO re-shard constraints
+                    # (training/step.py) mark grads/moments sharded
+                    # here even where AD broke the dimension witness
+                    nd = self._constraint_data_dim(
+                        eqn.params.get("sharding"), aval)
+                    d = nd if nd is not None else d
+                else:
+                    d = None
             cid = self._new_cell(aval, d,
                                  f"{_dtype_str(aval)}"
                                  f"{list(getattr(aval, 'shape', ()))} "
@@ -558,11 +625,21 @@ class _GraphModel:
         return peak, peak_idx, live
 
     def replicated(self) -> List[Tuple[int, int]]:
-        """[(cell, global bytes)] at/above the threshold NOT sharded
-        over the data axis, largest first."""
+        """[(cell, global bytes)] of INPUT cells at/above the threshold
+        NOT sharded over the data axis, largest first.
+
+        Scoped to inputs deliberately: the placement recipe declares
+        the entry's RESIDENT arrival state, and that is what this rule
+        prices — a replicated param/moment tree is bytes held between
+        steps on every process.  Transient full-size intermediates (a
+        gathered param, an unreduced gradient) are the price of the
+        compute that touches them and are priced by the peak-liveness
+        model (the ledger's ``peak_bytes`` row pins them exactly)
+        rather than flagged here."""
         out = [(cid, _aval_bytes(self.avals[cid]))
                for cid in range(len(self.avals))
-               if self.sdim[cid] is None
+               if self.is_input[cid]
+               and self.sdim[cid] is None
                and _aval_bytes(self.avals[cid])
                >= REPLICATION_THRESHOLD_BYTES]
         out.sort(key=lambda t: (-t[1], t[0]))
@@ -573,31 +650,102 @@ class _GraphModel:
 # overlap audit (scheduled-HLO side)
 # --------------------------------------------------------------------------
 
+_INSTR_NAME_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=")
+
+
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _entry_lines(text: str) -> List[str]:
+    """The instruction lines of the ENTRY computation, or every line
+    when the text has no ENTRY header (synthetic test snippets)."""
+    lines = text.splitlines()
+    for i, ln in enumerate(lines):
+        if ln.lstrip().startswith("ENTRY "):
+            body = []
+            for ln2 in lines[i + 1:]:
+                if ln2.strip() == "}":
+                    return body
+                body.append(ln2)
+            return body
+    return lines
+
+
 def overlap_from_hlo(text: str) -> Dict:
-    """Schedule distance between each collective-permute start/done
-    pair in an optimized HLO module text.  A synchronous
-    ``collective-permute`` instruction (what a backend emits when it
-    does not split the collective) is a zero-overlap pair by
-    construction.  Returns ``{"pairs": n, "serialized": k,
-    "gaps": [...]}}`` — ``gaps`` is compute-ops-between per pair."""
+    """Per-collective-permute overlap headroom in an optimized HLO
+    module's ENTRY computation.
+
+    Backends that split the collective (``collective-permute-start`` /
+    ``-done``) get the positional metric: compute ops scheduled
+    between the pair — real, chosen overlap.  A backend that emits one
+    synchronous ``collective-permute`` (CPU) serializes by construction,
+    so its linear order proves nothing; there the metric is
+    DEPENDENCE-level concurrency: the number of compute ops in the
+    entry schedule that are neither ancestors nor descendants of the
+    permute — the work an asynchronous runtime is FREE to hide the
+    transfer behind.  A straight-line hop whose result feeds all
+    downstream compute (the serialized-ring shape this rule exists to
+    flag) has zero such ops; a double-buffered ring leaves every
+    block-k einsum independent of hop k+1.  Bookkeeping ops and other
+    collectives never count as hideable compute.  Returns
+    ``{"pairs": n, "serialized": k, "gaps": [...]}``."""
     from raft_tpu.analysis.hlo_audit import _INSTR_RE
 
-    gaps: List[int] = []
-    open_counts: List[int] = []
-    for line in text.splitlines():
+    def _is_compute(op: str) -> bool:
+        return (op not in _NON_COMPUTE_OPS
+                and "collective" not in op
+                and not op.startswith("all-")
+                and op != "reduce-scatter")
+
+    instrs: List[Tuple[str, str, List[str]]] = []  # (name, op, operands)
+    for line in _entry_lines(text):
         m = _INSTR_RE.match(line)
         if not m:
             continue
-        op = m.group(1)
+        nm = _INSTR_NAME_RE.match(line)
+        rhs = line.split("=", 1)[-1]
+        instrs.append((nm.group(1) if nm else f"_anon{len(instrs)}",
+                       m.group(1), _OPERAND_RE.findall(rhs)))
+
+    defined = {name: i for i, (name, _, _) in enumerate(instrs)}
+    async_gaps: List[int] = []
+    open_pairs: List[int] = []
+    permutes: List[int] = []
+    for i, (name, op, _) in enumerate(instrs):
         if op == "collective-permute-start":
-            open_counts.append(0)
+            open_pairs.append(0)
         elif op == "collective-permute-done":
-            if open_counts:
-                gaps.append(open_counts.pop(0))
+            if open_pairs:
+                async_gaps.append(open_pairs.pop(0))
         elif op == "collective-permute":
-            gaps.append(0)
-        elif op not in _NON_COMPUTE_OPS and open_counts:
-            open_counts = [c + 1 for c in open_counts]
+            permutes.append(i)
+        elif _is_compute(op) and open_pairs:
+            open_pairs = [c + 1 for c in open_pairs]
+    async_gaps.extend(open_pairs)  # unclosed pair keeps its tail count
+
+    sync_gaps: List[int] = []
+    for pi in permutes:
+        # ancestors: everything the permute transitively reads
+        anc: set = set()
+        stack = [pi]
+        while stack:
+            for o in instrs[stack.pop()][2]:
+                j = defined.get(o)
+                if j is not None and j not in anc:
+                    anc.add(j)
+                    stack.append(j)
+        # descendants: everything that transitively reads its result
+        desc: set = {pi}
+        for i, (_, _, operands) in enumerate(instrs):
+            if i == pi:
+                continue
+            if any(defined.get(o) in desc for o in operands):
+                desc.add(i)
+        sync_gaps.append(sum(
+            1 for i, (_, op, _) in enumerate(instrs)
+            if _is_compute(op) and i not in anc and i not in desc))
+
+    gaps = async_gaps + sync_gaps
     return {"pairs": len(gaps),
             "serialized": sum(1 for g in gaps if g == 0),
             "gaps": gaps}
@@ -949,12 +1097,13 @@ def _check_replication(entry: ShardEntry, model: _GraphModel,
             for cid, b in repl[:TOP_K])
         findings.append(_entry_finding(
             entry, "implicit-replication",
-            f"{len(repl)} tensor(s) >= "
-            f"{_human(REPLICATION_THRESHOLD_BYTES)} materialize fully "
+            f"{len(repl)} resident input tensor(s) >= "
+            f"{_human(REPLICATION_THRESHOLD_BYTES)} arrive fully "
             f"replicated along the data axis ({_human(total)} total "
             f"per process; top: {top}) — ZeRO-shard the optimizer "
-            f"state / grads over 'data' (ROADMAP item 2) or waive the "
-            f"deliberate data-parallel baseline here",
+            f"state / params over 'data' (mesh.py "
+            f"zero_partition_spec) or waive the deliberate "
+            f"replicated arrival here",
             data={"replicated": len(repl), "bytes": total}))
     return total
 
@@ -1035,9 +1184,10 @@ def _check_overlap(entry: ShardEntry, fn, args, ctx,
             entry, "serialized-collective",
             f"{stats['serialized']} of {stats['pairs']} "
             f"collective-permute(s) in the scheduled HLO have ZERO "
-            f"compute between start and done — the ring transfer is "
+            f"compute between issue and completion (start/done or "
+            f"first use of the result) — the ring transfer is "
             f"serialized against the einsum it should hide behind "
-            f"(ROADMAP item 2's overlap rewrite retires this)",
+            f"(double-buffer the next hop before the block compute)",
             data=stats))
     return stats
 
@@ -1125,12 +1275,18 @@ def run_shard_audit(names: Optional[Sequence[str]] = None,
                          for c in model.input_cells)
         out_bytes = sum(model.cell_bytes(c)
                         for c in set(model.output_cells))
-        opt_bytes, reclaim = zero_headroom(args)
-        if opt_bytes:
+        # placement-blind totals say how big the moment trees ARE;
+        # placement-aware says how much still arrives replicated — the
+        # difference is the headroom ZeRO sharding has already banked
+        total_opt, total_reclaim = zero_headroom(args)
+        opt_bytes, reclaim = zero_headroom(args, placements=placements)
+        if total_opt:
             headroom[name] = {
-                "opt_state_bytes": opt_bytes,
+                "opt_state_bytes": total_opt,
                 "data_axis_size": DATA_AXIS_SIZE,
+                "replicated_opt_bytes": opt_bytes,
                 "reclaimable_bytes_per_process": reclaim,
+                "reclaimed_bytes_per_process": total_reclaim - reclaim,
                 "peak_bytes_before": peak,
                 "peak_bytes_after": peak - reclaim,
             }
@@ -1172,10 +1328,12 @@ def render_zero_headroom(report: Dict) -> str:
     for entry, h in sorted(report.get("zero_headroom", {}).items()):
         lines.append(
             f"zero-headroom {entry}: optimizer state "
-            f"{_human(h['opt_state_bytes'])} replicated over "
+            f"{_human(h['opt_state_bytes'])} over "
             f"data={h['data_axis_size']} -> "
             f"{_human(h['reclaimable_bytes_per_process'])}/process "
-            f"reclaimable (predicted peak "
+            f"reclaimable, "
+            f"{_human(h['reclaimed_bytes_per_process'])}/process "
+            f"already banked by the arrival layout (predicted peak "
             f"{_human(h['peak_bytes_before'])} -> "
             f"{_human(h['peak_bytes_after'])})")
     return "\n".join(lines)

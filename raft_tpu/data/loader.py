@@ -319,9 +319,30 @@ def host_local_to_global(batch: Dict, sharding) -> Dict:
     return out
 
 
+_PREFETCH_DONE = object()          # producer exhausted its iterator
+
+
+class _PrefetchError:
+    """Exception raised on the producer thread, carried to the consumer."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 def prefetch_to_device(iterator, size: int = 2, sharding=None, spans=None,
                        device_fn=None):
-    """Move batches to device ahead of compute.
+    """Move batches to device ahead of compute, on a pipeline thread.
+
+    A background producer thread pulls host batches from ``iterator``
+    and dispatches their device_put into a bounded queue of depth
+    ``size``, so host decode + h2d dispatch for batch k+1 run WHILE the
+    consumer's step computes on batch k — the consuming loop only
+    blocks when the host pipeline genuinely cannot keep up.  Batches
+    are yielded in iterator order (single producer, FIFO queue); an
+    exception on the producer thread (decode error, OOM during
+    device_put) is re-raised at the consumer's ``next()`` so failures
+    keep their step attribution.  Abandoning the generator (break /
+    GC) stops the producer promptly via its close hook.
 
     With ``sharding`` (a jax.sharding.Sharding), batches land already laid
     out for the mesh (data-parallel batch axis).  Under multi-host
@@ -337,18 +358,20 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None, spans=None,
     consuming step exactly like the raw transfer.
 
     ``spans`` (an obs.SpanRecorder) attributes each device_put to the
-    ``h2d`` phase.  device_put is asynchronous, so the span measures
-    transfer *dispatch*; a bytes-limited link shows up here only when
-    the transfer queue backs up — the steady-state symptom of a starved
-    link is ``data`` time (this generator blocking on the host
-    pipeline), which the caller's span sees.
+    ``h2d`` phase — recorded from the producer thread (SpanRecorder is
+    thread-safe; per-thread span stacks).  device_put is asynchronous,
+    so the span measures transfer *dispatch*; the steady-state symptom
+    of a starved link is ``data`` time (the consumer blocking on this
+    generator), which the caller's span sees.
     """
+    import queue as queue_mod
+    import threading
+
     import jax
 
     from raft_tpu.obs.spans import NULL
 
     spans = spans if spans is not None else NULL
-    queue = collections.deque()
     multihost = jax.process_count() > 1
     if multihost and sharding is None:
         raise ValueError(
@@ -378,10 +401,44 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None, spans=None,
             placed.update(rest)
         return placed
 
-    for batch in iterator:
-        with spans.span("h2d"):
-            queue.append(_put(batch))
-        if len(queue) >= size:
-            yield queue.popleft()
-    while queue:
-        yield queue.popleft()
+    out_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max(1, size))
+    stop = threading.Event()
+
+    def _offer(item) -> bool:
+        """put() that yields to ``stop`` so an abandoned consumer never
+        strands the producer blocked on a full queue."""
+        while not stop.is_set():
+            try:
+                out_q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _producer():
+        try:
+            for batch in iterator:
+                if stop.is_set():
+                    return
+                with spans.span("h2d"):
+                    placed = _put(batch)
+                if not _offer(placed):
+                    return
+            _offer(_PREFETCH_DONE)
+        except BaseException as e:  # re-raised at the consumer's next()
+            _offer(_PrefetchError(e))
+
+    thread = threading.Thread(target=_producer, name="prefetch-h2d",
+                              daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = out_q.get()
+            if item is _PREFETCH_DONE:
+                break
+            if isinstance(item, _PrefetchError):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()
+        thread.join(timeout=5.0)

@@ -517,12 +517,15 @@ ENTRYPOINTS: Dict[str, EntryPoint] = {e.name: e for e in (
         # no sanctioned source in this program, so it is forbidden
         # structurally on top of the ledger
         forbid=("all-to-all", "ragged-all-to-all"), deep=True,
-        # engine 8: (state, batch) arrive replicated/batch-sharded —
-        # the data-parallel baseline whose replicated optimizer state
-        # the ZeRO-headroom report quantifies (ROADMAP item 2).  The
-        # abstract build donates the state like production does
-        # (cli/train.py runs linear-flow with donate=True).
-        donated=True, shard=True, shard_placement="state_batch"),
+        # engine 8: (state, batch) arrive in the ZeRO-1 resident
+        # layout — AdamW mu/nu partitioned over 'data' per
+        # mesh.py's zero_partition_spec, params replicated (the
+        # classic flavor), batch sharded on dim 0 —
+        # the production --zero_shard placement (ROADMAP item 2
+        # retired the replicated-moments baseline).  The abstract
+        # build donates the state like production does (cli/train.py
+        # runs linear-flow with donate=True).
+        donated=True, shard=True, shard_placement="state_zero_batch"),
     EntryPoint(
         "eval_forward",
         anchor=("raft_tpu.evaluation.evaluate", "abstract_eval_forward"),

@@ -656,10 +656,20 @@ def build_pod_report(per_process_records: Dict[int, List[Dict]]) -> Dict:
     if front_traces or replica_traces:
         tracing = {"front": build_trace_section(front_traces),
                    "replicas": build_trace_section(replica_traces)}
+    # pod span attribution: each process's phase percentages kept
+    # SIDE BY SIDE (never pooled — two hosts with different stalls
+    # averaged together would hide exactly the skew this view exists
+    # to show: one process h2d-bound while its peer is compute-bound
+    # is the classic unbalanced-feed signature)
+    span_attribution = {
+        pid: rep.get("stall_attribution_pct", {})
+        for pid, rep in processes.items()
+        if rep.get("stall_attribution_pct")}
     return {
         "processes": processes,
         "process_count": len(processes),
         "steps": max((r["steps"] for r in processes.values()), default=0),
+        "span_attribution": span_attribution,
         "incidents": incidents,
         "serving": (merge_serving_sections(per_serving)
                     if per_serving else None),
@@ -700,6 +710,29 @@ def render_pod_report(report: Dict) -> str:
             f"{rep['wall_seconds']:.2f}s  step p50 {_fmt_ms(pct['p50'])}"
             f"  incidents: {inc}"
             + (f"  [{meta.get('entry', '?')}]" if meta else ""))
+    attribution = report.get("span_attribution") or {}
+    if attribution:
+        from raft_tpu.obs.spans import PHASES
+
+        # canonical phases first, extras alphabetically, "other" last
+        names = [n for n in PHASES
+                 if any(n in a for a in attribution.values())]
+        extras = sorted({k for a in attribution.values() for k in a}
+                        - set(PHASES) - {"other"})
+        names += extras + ["other"]
+        pids = list(attribution)
+        lines.append("")
+        lines.append("span attribution (% of each process's wall, "
+                     "exclusive):")
+        lines.append("  " + "phase".ljust(10) + "".join(
+            _plabel(pid).rjust(9) for pid in pids))
+        for name in names:
+            row = "  " + name.ljust(10)
+            for pid in pids:
+                v = attribution[pid].get(name)
+                row += (f"{v:8.1f}%" if isinstance(v, (int, float))
+                        else "       --")
+            lines.append(row)
     lines.append("")
     incidents = report["incidents"]
     if incidents:

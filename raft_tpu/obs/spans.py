@@ -31,6 +31,7 @@ the inclusive total for nesting-aware consumers.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -73,6 +74,13 @@ class SpanRecorder:
     ``clock`` is injectable for deterministic tests; ``annotate=False``
     drops the jax TraceAnnotation wrapping (and the jax import with it —
     the recorder itself is pure stdlib).
+
+    Thread-safe: the span stack is per-thread (a producer thread's
+    ``h2d`` span can never become a child of the main thread's
+    ``dispatch``), and the phase ledger is lock-guarded so concurrent
+    span exits and window flushes never drop or double-count a
+    record.  Step boundaries remain a main-loop concept — call
+    ``step_boundary``/``flush`` from one thread.
     """
 
     def __init__(self, ledger=None, clock=time.perf_counter,
@@ -81,11 +89,19 @@ class SpanRecorder:
         self._clock = clock
         self._annotate = annotate
         self._annotation_cls = None     # resolved lazily on first span
-        self._stack: List[_Frame] = []
+        self._local = threading.local()  # per-thread span stack
+        self._lock = threading.Lock()
         self._window_t0 = clock()
         self._last_boundary: Optional[float] = None
         self._phases: Dict[str, Dict[str, float]] = {}
         self._step_times: List[float] = []
+
+    @property
+    def _stack(self) -> List[_Frame]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
 
     def _annotation(self, name: str):
         if not self._annotate:
@@ -109,21 +125,23 @@ class SpanRecorder:
 
     @contextlib.contextmanager
     def span(self, name: str):
+        stack = self._stack
         frame = _Frame(name, self._clock())
-        self._stack.append(frame)
+        stack.append(frame)
         try:
             with self._annotation(name):
                 yield
         finally:
-            self._stack.pop()
+            stack.pop()
             elapsed = self._clock() - frame.t0
-            if self._stack:
-                self._stack[-1].child += elapsed
-            rec = self._phases.setdefault(
-                name, {"excl": 0.0, "incl": 0.0, "n": 0})
-            rec["excl"] += max(elapsed - frame.child, 0.0)
-            rec["incl"] += elapsed
-            rec["n"] += 1
+            if stack:
+                stack[-1].child += elapsed
+            with self._lock:
+                rec = self._phases.setdefault(
+                    name, {"excl": 0.0, "incl": 0.0, "n": 0})
+                rec["excl"] += max(elapsed - frame.child, 0.0)
+                rec["incl"] += elapsed
+                rec["n"] += 1
 
     def step_boundary(self) -> Optional[float]:
         """Mark the end of one loop iteration; returns that step's wall
@@ -132,20 +150,22 @@ class SpanRecorder:
         dt = None
         if self._last_boundary is not None:
             dt = now - self._last_boundary
-            self._step_times.append(dt)
+            with self._lock:
+                self._step_times.append(dt)
         self._last_boundary = now
         return dt
 
     def window_record(self) -> Dict:
         """The current window's span summary (without resetting)."""
-        return {
-            "wall": self._clock() - self._window_t0,
-            "phases": {k: {"excl": round(v["excl"], 6),
-                           "incl": round(v["incl"], 6),
-                           "n": int(v["n"])}
-                       for k, v in self._phases.items()},
-            "step_times": [round(t, 6) for t in self._step_times],
-        }
+        with self._lock:
+            return {
+                "wall": self._clock() - self._window_t0,
+                "phases": {k: {"excl": round(v["excl"], 6),
+                               "incl": round(v["incl"], 6),
+                               "n": int(v["n"])}
+                           for k, v in self._phases.items()},
+                "step_times": [round(t, 6) for t in self._step_times],
+            }
 
     def reanchor(self) -> None:
         """Drop the step-boundary anchor so the NEXT boundary only
@@ -164,9 +184,10 @@ class SpanRecorder:
         record = self.window_record()
         if self._ledger is not None:
             self._ledger.spans(step, record)
-        self._phases = {}
-        self._step_times = []
-        self._window_t0 = self._clock()
+        with self._lock:
+            self._phases = {}
+            self._step_times = []
+            self._window_t0 = self._clock()
         self.reanchor()
         return record
 

@@ -16,6 +16,7 @@ Axes:
 from __future__ import annotations
 
 import contextlib
+import re
 from typing import Dict, Optional
 
 import jax
@@ -24,6 +25,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 SPATIAL_AXIS = "spatial"
+
+# ZeRO-1 resident-state selector: the leaves partitioned over ``data``
+# at rest are AdamW's mu/nu moment trees (path-segment match on the
+# state pytree keystr); params, step counters, PRNG key and BatchNorm
+# running stats stay replicated.  Params are deliberately NOT in this
+# set (classic ZeRO-1): the forward must see replicated params, and on
+# legacy GSPMD (jax 0.4.x) 'data'-sharded param INPUTS meeting the
+# corr pyramid's 'spatial' constraints either miscompile (wrong loss,
+# measured 71.95 vs 73.78 on the audit mesh) or — with an explicit
+# entry gather — drag 23 forbidden all-to-alls into the activation
+# layouts.  Sharding only the moments sidesteps both while keeping
+# the dominant memory win (mu+nu is 2/3 of optimizer-adjacent state).
+# Single source — the runtime placement (parallel/step.py), the
+# in-step re-shard constraints (training/step.py) and engine 8's
+# audit recipe (analysis/shard_audit.py) all resolve here.
+ZERO_STATE_RE = re.compile(r"\b(mu|nu)\b")
+# The param subtree: pinned REPLICATED at rest and at step exit (the
+# exit pin is what realizes ZeRO-1's updated-param all-gather).
+ZERO_PARAM_RE = re.compile(r"\bparams\b")
 
 # --- version-compat shims -------------------------------------------------
 # The deployment image carries a current JAX; CI/dev containers may run an
@@ -115,6 +135,96 @@ def shard_batch(batch: Dict, mesh: Mesh) -> Dict:
     sharding = NamedSharding(mesh, batch_spec())
     return {k: jax.device_put(v, sharding) if hasattr(v, "shape") else v
             for k, v in batch.items()}
+
+
+def zero_partition_dim(shape, data_size: int) -> Optional[int]:
+    """The dimension a ZeRO-1 leaf shards over ``data``, or None.
+
+    Recipe: the LAST dimension divisible by ``data_size`` (innermost
+    dims are the largest fan-out axes on conv kernels, and a trailing
+    shard keeps the leading dims' memory layout contiguous per
+    process); a leaf with no divisible dimension stays replicated.
+    ``data_size <= 1`` degenerates to replicated everywhere, so the
+    recipe composes with single-process and spatial-only meshes.
+    """
+    if data_size <= 1:
+        return None
+    for d in range(len(shape) - 1, -1, -1):
+        dim = int(shape[d])
+        if dim >= data_size and dim % data_size == 0:
+            return d
+    return None
+
+
+def zero_partition_spec(shape, data_size: int) -> P:
+    """PartitionSpec form of ``zero_partition_dim``."""
+    d = zero_partition_dim(shape, data_size)
+    if d is None:
+        return P()
+    return P(*([None] * d + [DATA_AXIS]))
+
+
+def zero_state_shardings(state, mesh: Mesh):
+    """Tree of NamedShardings for a ZeRO-1 resident train state.
+
+    AdamW moments (``ZERO_STATE_RE`` leaves) get their
+    ``zero_partition_spec`` over ``data``; every other leaf — params,
+    step, rng, batch_stats, optimizer counters — is replicated.  This
+    IS the placement ``parallel/step.py``'s ``zero_shard_state``
+    applies and the in-shardings the audited entry lowers with.
+    """
+    data = mesh.shape.get(DATA_AXIS, 1)
+
+    def one(path, x):
+        if ZERO_STATE_RE.search(jax.tree_util.keystr(path)):
+            spec = zero_partition_spec(getattr(x, "shape", ()), data)
+        else:
+            spec = P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, state)
+
+
+def constrain_zero(tree, data_size: int, state_selected: bool = False):
+    """with_sharding_constraint each leaf to its ZeRO partition spec.
+
+    ``state_selected=True`` constrains a full train state to the
+    resident layout: mu/nu (``ZERO_STATE_RE``) re-shard, params
+    (``ZERO_PARAM_RE``) pin REPLICATED — on the output state this is
+    the all-gather that re-materializes the updated params from the
+    shard-local optimizer update — and counters/batch_stats are left
+    alone.  ``False`` constrains every leaf to its shard spec (a
+    gradient tree, whose structure is the param tree).  Uses the
+    ambient-mesh-aware ``constrain``, so it is a no-op outside
+    ``set_mesh`` — callers keep it in the code path unconditionally.
+    """
+    def one(path, x):
+        if state_selected:
+            key = jax.tree_util.keystr(path)
+            if ZERO_PARAM_RE.search(key):
+                return gather_replicated(x)
+            if not ZERO_STATE_RE.search(key):
+                return x
+        return constrain(x, zero_partition_spec(
+            getattr(x, "shape", ()), data_size))
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def gather_replicated(x: jax.Array) -> jax.Array:
+    """ZeRO-1's deliberate exit gather: pin an updated-param leaf back
+    to fully replicated.  The optimizer delta was computed shard-local
+    from the 'data'-partitioned mu/nu, so this constraint IS the one
+    all-gather that re-materializes full params for the next step's
+    forward.  Dedicated call site (not routed through ``constrain``)
+    so engine 8's sharding-drop waiver scopes to exactly this gather
+    and nothing else.
+    """
+    mesh = get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    # graftlint: disable=sharding-drop -- ZeRO-1's updated-param all-gather: the shard-local optimizer delta re-materializes into full replicated params once per step, by design
+    return jax.lax.with_sharding_constraint(x, replicated_spec())
 
 
 def constrain(x: jax.Array, spec: P) -> jax.Array:

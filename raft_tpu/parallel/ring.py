@@ -59,6 +59,13 @@ def _ring_rows(f1_local: jax.Array, f2_shard: jax.Array,
     perm = [(j, (j + 1) % num_shards) for j in range(num_shards)]
     f2_cur = f2_shard
     for i in range(num_shards):
+        # double-buffered hop: issue block i+1's permute BEFORE block i's
+        # einsum — the transfer reads f2_cur, which the einsum only reads,
+        # so the permute has no data dependence on this block's compute
+        # and the scheduler can keep the hop in flight behind the matmul
+        # (engine 8's scheduled-HLO overlap check measures the window)
+        f2_next = (jax.lax.ppermute(f2_cur, axis_name, perm)
+                   if i + 1 < num_shards else None)
         block = jnp.einsum("bqc,btc->bqt", f1, f2_cur.astype(jnp.float32),
                            preferred_element_type=jnp.float32) * scale
         # after i forward rotations, this device holds global shard
@@ -66,8 +73,8 @@ def _ring_rows(f1_local: jax.Array, f2_shard: jax.Array,
         src = (idx - i) % num_shards
         out = jax.lax.dynamic_update_slice(
             out, block, (0, 0, src * Ts))
-        if i + 1 < num_shards:
-            f2_cur = jax.lax.ppermute(f2_cur, axis_name, perm)
+        if f2_next is not None:
+            f2_cur = f2_next
     return out
 
 
@@ -126,7 +133,6 @@ def ring_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array, mesh: Mesh,
     return [constrain(p, P(DATA_AXIS, axis, None, None)) for p in pyr]
 
 
-# graftlint: disable=serialized-collective -- the baseline ring schedules each permute hop synchronously (no double-buffered next-chunk transfer behind the local einsum yet); ROADMAP item 2's overlap rewrite retires this waiver, and engine 8 holds the line meanwhile
 def abstract_ring_lookup(mesh: Mesh, batch: int = 2, hw=(8, 16),
                          channels: int = 16, radius: int = 4,
                          num_levels: int = 4):

@@ -255,9 +255,9 @@ class SDCPolicy:
         plus the batch reference (batches are never donated).  Cost: one
         ``device_get`` per cadence step — the dominant term in the
         digest-cadence overhead, which bench.py stamps."""
-        import jax
+        from raft_tpu.training.state import to_host_state
 
-        self._captured = (int(step), jax.device_get(state), batch)
+        self._captured = (int(step), to_host_state(state), batch)
 
     # -- the boundary decision ----------------------------------------------
 

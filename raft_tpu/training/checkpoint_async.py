@@ -109,7 +109,11 @@ class AsyncCheckpointer:
                                              save_checkpoint_sharded)
 
         self.wait()  # serialize in-flight saves; surfaces prior errors
-        host_state = jax.device_get(state)
+        from raft_tpu.training.state import to_host_state
+
+        # layout-independent pull: re-materializes ZeRO-sharded leaves
+        # that a pod process cannot address directly
+        host_state = to_host_state(state)
         shard = self._shard
 
         def _write():

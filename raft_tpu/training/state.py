@@ -276,6 +276,34 @@ def shard_path(base_path: str, shard_index: int, shard_count: int) -> str:
     return f"{stem}.shard{shard_index}of{shard_count}.msgpack"
 
 
+def to_host_state(state: TrainState) -> TrainState:
+    """``device_get`` that also handles a pod's ZeRO-sharded state.
+
+    Fully-addressable leaves (single process, any layout — the runtime
+    assembles sharded arrays on the host) pull directly.  Under a
+    multi-host mesh a ZeRO-partitioned leaf is NOT fully addressable
+    — ``device_get`` would refuse — so the state is first
+    re-materialized replicated by a jitted identity with replicated
+    out-shardings (one all-gather over ICI, the same collective the
+    step's forward pays), then pulled.  Either path yields the full
+    host values bit-exactly, so checkpoint payloads, the param-digest
+    fence and the SDC capture are layout-independent.
+    """
+    leaves = [x for x in jax.tree.leaves(state)
+              if isinstance(x, jax.Array)]
+    if all(x.is_fully_addressable for x in leaves):
+        return jax.device_get(state)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = next(x.sharding.mesh for x in leaves
+                if not x.is_fully_addressable)
+    repl = NamedSharding(mesh, PartitionSpec())
+    gathered = jax.jit(
+        lambda s: s,
+        out_shardings=jax.tree.map(lambda _: repl, state))(state)
+    return jax.device_get(gathered)
+
+
 def _state_payload(state: TrainState) -> Dict:
     """Host-side state dict of the full train state (plain nested dicts;
     optax NamedTuples converted for msgpack)."""

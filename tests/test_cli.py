@@ -226,3 +226,36 @@ def test_train_cli_end_to_end_with_resume(tmp_path):
     train_cli.main(common + ["--num_steps", "5", "--resume"])
     payload = flax.serialization.msgpack_restore(open(final, "rb").read())
     assert int(np.asarray(payload["step"])) == 5
+
+
+def test_bench_pod_scaling_stamp(tmp_path):
+    """bench.py's pod_scaling stamp lifts the ZeRO scaling curve from
+    the newest MULTICHIP artifact's MULTICHIP_SCALING tail line (the
+    bench owns one chip; the 1->n curve is the driver dryrun's), and
+    returns None when no artifact carries one."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(__file__), "..",
+                                  "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    assert bench.pod_scaling_stamp(repo=str(tmp_path)) is None
+
+    import json
+    scaling = {"devices": {"1": {"items_per_s": 2.0,
+                                 "scaling_efficiency": 1.0},
+                           "8": {"items_per_s": 4.0,
+                                 "scaling_efficiency": 0.25}},
+               "layout": "zero1", "weak_scaling": True}
+    # older artifact without a scaling line is skipped, newest wins
+    (tmp_path / "MULTICHIP_r05.json").write_text(
+        json.dumps({"tail": "dryrun OK\n"}))
+    (tmp_path / "MULTICHIP_r06.json").write_text(json.dumps(
+        {"tail": "stuff\nMULTICHIP_SCALING " + json.dumps(scaling)
+                 + "\nmore\n"}))
+    stamp = bench.pod_scaling_stamp(repo=str(tmp_path))
+    assert stamp["source"] == "MULTICHIP_r06.json"
+    assert stamp["layout"] == "zero1"
+    assert stamp["devices"]["8"]["scaling_efficiency"] == 0.25

@@ -536,6 +536,38 @@ def test_pod_report_merges_with_process_attribution(tmp_path):
     assert "UNRECOVERED" in rendered
 
 
+def test_pod_report_span_attribution_side_by_side(tmp_path):
+    """--merge surfaces each process's h2d/dispatch stall attribution
+    in ONE table, column per process — the unbalanced-feed signature
+    (p1 h2d-bound while p0 is not) must be readable without diffing
+    two single-process reports."""
+    from raft_tpu.obs.events import RunLedger, read_ledger
+    from raft_tpu.obs.report import build_pod_report, render_pod_report
+
+    mixes = {0: {"h2d": 0.5, "dispatch": 8.0},
+             1: {"h2d": 6.0, "dispatch": 1.5}}
+    for pid, mix in mixes.items():
+        led = RunLedger(str(tmp_path / f"events.jsonl.p{pid}"),
+                        meta={"entry": "train", "process_index": pid})
+        led.spans(10, {"wall": 10.0,
+                       "phases": {k: {"excl": v, "incl": v, "n": 5}
+                                  for k, v in mix.items()},
+                       "step_times": [1.0] * 10})
+        led.close(summary={})
+    report = build_pod_report({
+        pid: read_ledger(str(tmp_path / f"events.jsonl.p{pid}"))
+        for pid in mixes})
+    att = report["span_attribution"]
+    assert att[0]["h2d"] == 5.0 and att[0]["dispatch"] == 80.0
+    assert att[1]["h2d"] == 60.0 and att[1]["dispatch"] == 15.0
+    rendered = render_pod_report(report)
+    assert "span attribution" in rendered
+    # one row per phase, a column per process, in pid order
+    h2d_row = next(ln for ln in rendered.splitlines()
+                   if ln.strip().startswith("h2d"))
+    assert h2d_row.index("5.0%") < h2d_row.index("60.0%")
+
+
 def test_pod_report_cli_gates_across_processes(tmp_path):
     """--merge + --fail-on-incident fatal: one host's fatal fails the
     pod; all-recovered pods pass."""
@@ -762,21 +794,49 @@ def _losses_by_step(ledger_path, run_index=-1):
 
 @pytest.mark.slow
 @requires_cpu_multiprocess
-def test_elastic_kill_one_host_and_resume_matches_unkilled(tmp_path):
+@pytest.mark.parametrize("zero", [False, True],
+                         ids=["replicated", "zero_shard"])
+def test_elastic_kill_one_host_and_resume_matches_unkilled(tmp_path,
+                                                           zero):
     """THE pod resilience flagship gate: 2 gloo processes on the
     synthetic stage, process 0 SIGTERM-killed at step K via --inject;
     the pod COORDINATES the rescue (both processes save their
     checkpoint shards at the same boundary and exit 0), then the run
     elastically resumes as ONE process with 2 virtual devices
     (re-shard restore 2->1).  The merged loss trajectory must match
-    the unkilled twin exactly pre-kill and within 1e-6 rtol
-    post-resume."""
+    the unkilled twin exactly pre-kill and within tolerance
+    post-resume.
+
+    The ``zero_shard`` variant runs the whole choreography on the
+    ZeRO-1 layout: optimizer moments sharded over the data axis at
+    rest, rescue saves re-materializing via ``to_host_state``'s
+    collective gather (each process addresses only its slice), and the
+    elastic resume re-placing the re-sharded restore back onto the
+    partitioned layout.  Checkpoint BYTES are layout-blind (exact —
+    the pre-kill prefix and the shard/unshard round-trip unit test pin
+    that); the post-resume TRAJECTORY is not bit-portable across
+    process topologies under ZeRO: a fresh 2-proc x 1-dev vs
+    1-proc x 2-dev pair (no kill, no checkpoint) already differs at
+    rel ~1.3e-7 on step 1, amplifying to ~2.6e-5 by step 2 through
+    the recurrent refinement — the partitioner lowers the
+    shard-local-update/param-gather neighborhood differently when
+    every device is host-local.  The replicated layout reassociates
+    across topologies too, just less: the 2-proc -> 1-proc resume
+    drifts a deterministic, bit-reproducible max rel ~4.7e-6 (same
+    digits on the pre-ZeRO tree, so it is the gloo-vs-ICI all-reduce
+    lowering, not a layout effect), which the historical 1e-6 gate sat
+    UNDER — it only went unnoticed because the slow lane is excluded
+    from tier-1.  Each gate pins its measured reassociation envelope:
+    replicated 1e-5 (~2x observed), zero 1e-4 (~4x observed, ~100x
+    below any real restore bug)."""
     workdir = str(tmp_path)
     N, K = 6, 3
+    zf = ["--zero_shard"] if zero else []
+    post_rtol = 1e-4 if zero else 1e-5
 
-    _run_pod_twin(workdir, "unkilled", N, [[], []])
+    _run_pod_twin(workdir, "unkilled", N, [zf, zf])
     outs = _run_pod_twin(workdir, "killed", N,
-                         [["--inject", f"sigterm@{K}"], []])
+                         [["--inject", f"sigterm@{K}"] + zf, zf])
     # BOTH processes rescued (coordinated preemption): a full shard set
     assert all("preempted: saved" in o for o in outs), outs[0][-2000:]
     ckpts = sorted(os.listdir(os.path.join(workdir, "killed", "ckpts")))
@@ -785,7 +845,7 @@ def test_elastic_kill_one_host_and_resume_matches_unkilled(tmp_path):
 
     # elastic resume: ONE process, 2 virtual devices, same global mesh
     proc = subprocess.run(
-        _twin_cli(workdir, "killed", N, ["--resume"]),
+        _twin_cli(workdir, "killed", N, ["--resume"] + zf),
         cwd=REPO, env=_pod_env(None, devcount=2), stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True, timeout=600)
     assert proc.returncode == 0, proc.stdout[-3000:]
@@ -805,7 +865,7 @@ def test_elastic_kill_one_host_and_resume_matches_unkilled(tmp_path):
     # post-resume across the 2-process -> 1-process re-shard: pinned
     post_arr = np.asarray([post[s] for s in range(K + 1, N + 1)])
     ref = np.asarray([unkilled[s] for s in range(K + 1, N + 1)])
-    np.testing.assert_allclose(post_arr, ref, rtol=1e-6, atol=0,
+    np.testing.assert_allclose(post_arr, ref, rtol=post_rtol, atol=0,
                                err_msg="elastic resume diverged from "
                                        "the unkilled twin")
     # typed trail: preempted on both processes, ckpt-reshard on resume

@@ -25,6 +25,7 @@ Tier-1 proofs for ISSUE 19:
 """
 
 import json
+import re
 
 import numpy as np
 import pytest
@@ -103,44 +104,70 @@ def test_shard_gate_repo_clean(repo_shard_audit):
     assert wall < 300.0, f"shard audit took {wall:.1f}s"
 
 
-def test_shard_gate_baseline_waivers_are_visible(repo_shard_audit):
-    """Satellite 1: the two deliberate-baseline findings survive as
-    WAIVED — the data-parallel replication (parallel/step.py) and the
-    synchronous ring (parallel/ring.py).  ROADMAP item 2 retires both;
-    meanwhile the waiver text carries the reason and engine 5's
-    staleness gate notices if the finding ever stops firing."""
+def test_shard_gate_baseline_findings_retired(repo_shard_audit):
+    """ROADMAP item 2 retired both deliberate-baseline findings.  The
+    ring retirement is total: the double-buffered rewrite leaves
+    independent compute for every hop, serialized-collective never
+    fires and its waiver is deleted (engine 5's staleness gate would
+    flag one left behind).  The memory retirement is the classic
+    ZeRO-1 flavor: the 40.1MiB data-parallel AdamW-moment replication
+    is GONE (no finding cites a mu/nu leaf any more — the moments
+    arrive partitioned), while params DELIBERATELY stay replicated at
+    rest (sharded param inputs miscompile under the corr pyramid's
+    'spatial' constraints on this legacy-GSPMD jax), so what survives
+    is a handful of WAIVED findings pinned to the two classic-flavor
+    choices: the replicated arrival of the big conv kernels
+    (parallel/step.py) and the once-per-step exit param all-gather
+    (mesh.py gather_replicated)."""
     findings, report, _ = repo_shard_audit
-    waived = {f.rule for f in findings if f.waived}
-    assert "implicit-replication" in waived
-    assert "serialized-collective" in waived
+    fired = {f.rule for f in findings}
+    assert "serialized-collective" not in fired
+    # every survivor is a waived, deliberate classic-flavor choice
+    assert findings and all(f.waived for f in findings), \
+        [f"{f.rule} {f.path}:{f.line}" for f in findings
+         if not f.waived]
+    # ... and none of them is the retired moment replication: the
+    # old baseline finding named mu/nu leaves and 40.1MiB of them
+    # (same \b-guarded leaf match the placement recipe uses)
     for f in findings:
-        if f.waived:
-            assert f.waiver_reason, f.rule
-    # the ring's overlap stats rode into the report (every permute hop
-    # measured; on this backend they schedule synchronously — waived)
+        assert not re.search(r"\b(mu|nu)\b", f.message), f.message
+    repl = [f for f in findings if f.rule == "implicit-replication"]
+    assert all(f.path == "raft_tpu/parallel/step.py" for f in repl)
+    drops = [f for f in findings if f.rule == "sharding-drop"]
+    assert drops and all(f.path == "raft_tpu/parallel/mesh.py"
+                         for f in drops)
+    # every ring hop measured, every hop with hideable compute
     overlap = report["corr_ring"]["overlap"]
     assert overlap["pairs"] >= 1
     assert len(overlap["gaps"]) == overlap["pairs"]
+    assert overlap["serialized"] == 0
+    assert all(g >= 1 for g in overlap["gaps"])
 
 
 def test_shard_zero_headroom_report(repo_shard_audit):
-    """ACCEPTANCE: the ZeRO-headroom report prints concrete
-    per-process reclaimable bytes for parallel_step — optimizer state
-    fully replicated over the data axis, reclaim = opt*(d-1)/d."""
+    """ACCEPTANCE: the ZeRO-headroom report shows the headroom
+    REALIZED for parallel_step — the state_zero_batch arrival layout
+    shards every partitionable moment leaf over the data axis, so
+    nothing material is left reclaimable and the banked savings are
+    the tens of MiB the old fully-replicated layout paid."""
     findings, report, _ = repo_shard_audit
     h = report["zero_headroom"]["parallel_step"]
     d = h["data_axis_size"]
     assert d == sa.DATA_AXIS_SIZE >= 2
-    assert h["reclaimable_bytes_per_process"] == \
-        h["opt_state_bytes"] * (d - 1) // d
     assert h["peak_bytes_after"] == \
         h["peak_bytes_before"] - h["reclaimable_bytes_per_process"]
-    # AdamW doubles the param bytes; at the audit config that is tens
-    # of MiB — the report must name a concrete, material number
-    assert h["reclaimable_bytes_per_process"] > (1 << 24)  # > 16 MiB
+    # AdamW doubles the param bytes; at the audit config the sharded
+    # arrival layout banks tens of MiB/process (the >=15 MiB
+    # acceptance floor for this optimization)
+    assert h["reclaimed_bytes_per_process"] > 15 * (1 << 20)
+    # what still arrives replicated is the non-partitionable remnant
+    # (scalars, tiny leaves) — immaterial next to the banked savings
+    assert h["replicated_opt_bytes"] < (1 << 20)
+    assert h["reclaimable_bytes_per_process"] < (1 << 20)
     text = sa.render_zero_headroom(report)
     assert "zero-headroom parallel_step" in text
     assert "/process reclaimable" in text
+    assert "banked by the arrival layout" in text
 
 
 # ---------------------------------------------------------------------------

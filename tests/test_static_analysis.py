@@ -1317,7 +1317,9 @@ def test_engines_enumerate_from_registry():
     assert na.ENTRIES["train_step"].rules == na.DEEP_RULES
     assert qa.ENTRIES["serve_forward_q8"].rules == qa.ALL_QUANT_RULES
     assert sa.ENTRIES["corr_ring"].overlap          # require= rides in
-    assert sa.ENTRIES["parallel_step"].placement == "state_batch"
+    # ZeRO-1 arrival layout (ROADMAP item 2): moments partitioned,
+    # params replicated — the audited step's placement recipe
+    assert sa.ENTRIES["parallel_step"].placement == "state_zero_batch"
     assert sa.ENTRIES["parallel_step"].donated
     assert sa.ENTRIES["serve_forward_warm"].donated
     # every entry is audited by at least one engine
